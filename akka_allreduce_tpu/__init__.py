@@ -1,0 +1,45 @@
+"""akka_allreduce_tpu — a TPU-native threshold-completion allreduce framework.
+
+A brand-new framework with the capabilities of the reference
+``mike199515/akka-allreduce-1`` (JVM/Scala/Akka; see /root/repo/SURVEY.md), rebuilt
+idiomatically for TPU:
+
+- **Data plane**: XLA collectives (``jax.lax.psum`` under ``shard_map``/``pjit``)
+  over the ICI mesh — payloads stay in HBM (BASELINE.json:5 north star). The
+  reference's JVM float-sum hot loop (``ScatteredDataBuffer.reduce``) and Netty TCP
+  chunk transport are replaced wholesale by compiled collectives.
+- **Control plane**: Python services playing the reference's ``Master`` /
+  ``LineMaster`` actor roles — round scheduling with a bounded in-flight window,
+  threshold-completion counting, membership, and the prepare/confirm re-mesh
+  handshake. Only small control messages cross the host network.
+- **Threshold semantics** (the capability that distinguishes this from a vanilla
+  ``psum``): contributors supply ``(payload, 1)``, non-contributors ``(zeros, 0)``;
+  one fused psum over both; consumers divide sum by count. ``th_reduce`` /
+  ``th_complete`` / ``th_allreduce`` govern when the control plane launches with
+  whichever contributor mask is ready (SURVEY.md §8.1 step 3).
+
+Layout (mirrors SURVEY.md §2's layer map):
+
+- ``config``   — typed configs (``ThresholdConfig``, ``MetaDataConfig``, ...)
+- ``protocol`` — round wire protocol (``StartAllreduce``, ``ScatterBlock``, ...)
+- ``buffers``  — per-round chunk buffers with threshold accounting (host engine)
+- ``comm``     — ICI data plane: mesh, bucketing, masked allreduce, schedules
+- ``control``  — LineMaster / GridMaster / membership / worker engine
+- ``binder``   — dataSource/dataSink integration seam (grad-sync, elastic-average)
+- ``models``   — MLP (MNIST) and ResNet-50 model families
+- ``train``    — data-parallel trainer, checkpointing, metrics
+- ``ops``      — Pallas/XLA kernels for the hot ops
+- ``parallel`` — mesh + sharding helpers
+- ``utils``    — logging, metrics JSONL, timing
+"""
+
+__version__ = "0.1.0"
+
+from akka_allreduce_tpu.config import (  # noqa: F401
+    LineMasterConfig,
+    MasterConfig,
+    MetaDataConfig,
+    NodeConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)

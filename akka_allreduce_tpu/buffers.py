@@ -1,0 +1,284 @@
+"""Per-round data-plane buffers with threshold accounting.
+
+Host-side equivalents of the reference's L1 layer (SURVEY.md §2-3):
+
+- ``ScatteredDataBuffer`` — storage for incoming scatter chunks of *this worker's*
+  block; counts contributions per chunk; answers "reached reduce threshold?";
+  performs the sum reduction. In the reference this ``reduce`` is the JVM hot loop;
+  here it is a vectorized accumulate (and the ICI path bypasses these buffers
+  entirely — XLA's AllReduce is the reduction executor).
+- ``ReducedDataBuffer`` — storage for reduced blocks received back from peers;
+  tracks fill fraction vs ``th_complete``; exposes output + per-chunk counts for
+  normalization.
+- ``RoundBuffers`` — the bounded out-of-order round window the worker keeps so
+  future-round messages are buffered rather than dropped (SURVEY.md §3
+  ``AllreduceWorker`` "out-of-order round buffering").
+
+These run the engine data path (unit tests, CPU fallback, DCN chunk movement); the
+optional C++ accumulator in ``akka_allreduce_tpu/native`` accelerates ``store``'s
+accumulate when built.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from akka_allreduce_tpu.config import MetaDataConfig, ThresholdConfig
+
+
+class RoundOutOfWindowError(Exception):
+    """A message referenced a round outside the bounded out-of-order window —
+    either already flushed (stale duplicate) or too far in the future."""
+
+
+class ScatteredDataBuffer:
+    """Accumulates scatter contributions for one worker's block in one round.
+
+    The owner's block is partitioned into chunks of at most ``max_chunk_size``.
+    Each peer (including the owner) sends one contribution per chunk; when a
+    chunk's contribution count reaches ``ceil(th_reduce * peer_size)`` the chunk
+    is ready to reduce. ``reduce`` returns the running sum and the contributor
+    count (late contributions after the threshold still accumulate until reduce
+    is called, matching the reference's "reduce at threshold, not at totality").
+    """
+
+    def __init__(
+        self,
+        metadata: MetaDataConfig,
+        threshold: ThresholdConfig,
+        peer_size: int,
+        block_size: int | None = None,
+    ) -> None:
+        if peer_size <= 0:
+            raise ValueError(f"peer_size must be positive, got {peer_size}")
+        self.metadata = metadata
+        self.threshold = threshold
+        self.peer_size = peer_size
+        self.block_size = (
+            metadata.block_size(peer_size) if block_size is None else block_size
+        )
+        self.num_chunks = max(
+            1, -(-self.block_size // metadata.max_chunk_size)
+        )  # ceil div
+        self._sums = np.zeros(self.block_size, dtype=np.float32)
+        self._counts = np.zeros(self.num_chunks, dtype=np.int32)
+        self._contributed = np.zeros((self.num_chunks, peer_size), dtype=bool)
+        self._reduced = np.zeros(self.num_chunks, dtype=bool)
+        self.reduce_trigger = threshold.reduce_count(peer_size)
+
+    def _chunk_bounds(self, chunk_id: int) -> tuple[int, int]:
+        if not 0 <= chunk_id < self.num_chunks:
+            raise IndexError(f"chunk_id {chunk_id} out of [0, {self.num_chunks})")
+        start = chunk_id * self.metadata.max_chunk_size
+        return start, min(start + self.metadata.max_chunk_size, self.block_size)
+
+    def chunk_size(self, chunk_id: int) -> int:
+        start, stop = self._chunk_bounds(chunk_id)
+        return stop - start
+
+    def store(self, value: np.ndarray, src_id: int, chunk_id: int) -> bool:
+        """Accumulate one peer's contribution to one chunk (idempotent per src).
+
+        Returns True iff this store just *crossed* the reduce trigger — the
+        edge-triggered signal the worker uses to reduce-and-broadcast exactly
+        once per chunk. Duplicate deliveries return False without accumulating.
+        """
+        start, stop = self._chunk_bounds(chunk_id)  # validates chunk_id
+        if not 0 <= src_id < self.peer_size:
+            raise IndexError(f"src_id {src_id} out of [0, {self.peer_size})")
+        if self._contributed[chunk_id, src_id]:
+            return False  # duplicate delivery — at-least-once transports are fine
+        value = np.asarray(value, dtype=np.float32)
+        if value.shape != (stop - start,):
+            raise ValueError(
+                f"chunk {chunk_id} expects shape ({stop - start},), got {value.shape}"
+            )
+        self._sums[start:stop] += value
+        self._counts[chunk_id] += 1
+        self._contributed[chunk_id, src_id] = True
+        return (
+            not self._reduced[chunk_id]
+            and int(self._counts[chunk_id]) == self.reduce_trigger
+        )
+
+    def count(self, chunk_id: int) -> int:
+        self._chunk_bounds(chunk_id)
+        return int(self._counts[chunk_id])
+
+    def reach_reducing_threshold(self, chunk_id: int) -> bool:
+        """Level query: chunk has enough contributions and awaits ``reduce``.
+
+        Stays True from the trigger crossing until ``reduce`` is called; for the
+        once-only broadcast decision use ``store``'s return value instead.
+        """
+        return (
+            not self._reduced[chunk_id]
+            and int(self._counts[chunk_id]) >= self.reduce_trigger
+        )
+
+    def reduce(self, chunk_id: int) -> tuple[np.ndarray, int]:
+        """Return (summed chunk, contributor count) and mark the chunk reduced."""
+        start, stop = self._chunk_bounds(chunk_id)
+        self._reduced[chunk_id] = True
+        return self._sums[start:stop].copy(), int(self._counts[chunk_id])
+
+
+class ReducedDataBuffer:
+    """Assembles reduced blocks received back from peers into the round output.
+
+    The full output buffer (size ``data_size``) is the concatenation of every
+    peer's block. Each incoming ``ReduceBlock`` fills one chunk of one block and
+    carries the contributor count for that chunk; completion fires when the
+    number of filled chunks reaches ``ceil(th_complete * total_chunks)``.
+    """
+
+    def __init__(
+        self,
+        metadata: MetaDataConfig,
+        threshold: ThresholdConfig,
+        peer_size: int,
+    ) -> None:
+        if peer_size <= 0:
+            raise ValueError(f"peer_size must be positive, got {peer_size}")
+        self.metadata = metadata
+        self.threshold = threshold
+        self.peer_size = peer_size
+        self.block_size = metadata.block_size(peer_size)
+        self.chunks_per_block = max(
+            1, -(-self.block_size // metadata.max_chunk_size)
+        )
+        self.total_chunks = self.chunks_per_block * peer_size
+        # Output covers peer_size * block_size >= data_size; trailing pad ignored.
+        self._data = np.zeros(peer_size * self.block_size, dtype=np.float32)
+        # Contributor counts are one integer per chunk (expanded to elements
+        # lazily in get_with_counts) — per-element storage would add O(data)
+        # host RAM per round buffer for nothing.
+        self._chunk_counts = np.zeros(
+            (peer_size, self.chunks_per_block), dtype=np.int32
+        )
+        self._filled = np.zeros((peer_size, self.chunks_per_block), dtype=bool)
+        self.completion_trigger = threshold.complete_count(self.total_chunks)
+        # chunk lengths within one block (same for every block): full chunks
+        # then a possibly-short tail.
+        self._chunk_lengths = np.array(
+            [
+                min(
+                    metadata.max_chunk_size,
+                    self.block_size - c * metadata.max_chunk_size,
+                )
+                for c in range(self.chunks_per_block)
+            ],
+            dtype=np.int64,
+        )
+
+    def _bounds(self, src_id: int, chunk_id: int) -> tuple[int, int]:
+        if not 0 <= src_id < self.peer_size:
+            raise IndexError(f"src_id {src_id} out of [0, {self.peer_size})")
+        if not 0 <= chunk_id < self.chunks_per_block:
+            raise IndexError(
+                f"chunk_id {chunk_id} out of [0, {self.chunks_per_block})"
+            )
+        start = src_id * self.block_size + chunk_id * self.metadata.max_chunk_size
+        stop = min(
+            start + self.metadata.max_chunk_size, (src_id + 1) * self.block_size
+        )
+        return start, stop
+
+    def store(
+        self, value: np.ndarray, src_id: int, chunk_id: int, count: int
+    ) -> None:
+        """Place a reduced chunk from peer ``src_id`` into the output buffer."""
+        start, stop = self._bounds(src_id, chunk_id)  # validates ids first
+        if self._filled[src_id, chunk_id]:
+            return  # duplicate delivery
+        value = np.asarray(value, dtype=np.float32)
+        if value.shape != (stop - start,):
+            raise ValueError(
+                f"block {src_id} chunk {chunk_id} expects shape ({stop - start},),"
+                f" got {value.shape}"
+            )
+        self._data[start:stop] = value
+        self._chunk_counts[src_id, chunk_id] = count
+        self._filled[src_id, chunk_id] = True
+
+    @property
+    def filled_chunks(self) -> int:
+        return int(self._filled.sum())
+
+    def reach_completion_threshold(self) -> bool:
+        return self.filled_chunks >= self.completion_trigger
+
+    def get_with_counts(self) -> tuple[np.ndarray, np.ndarray]:
+        """(data, per-element contributor counts), trimmed to ``data_size``.
+
+        Unfilled chunks read as zeros with count 0 — the consumer's divide
+        leaves them untouched (partial completion is visible in the counts).
+        """
+        n = self.metadata.data_size
+        lengths = np.tile(self._chunk_lengths, self.peer_size)
+        counts = np.repeat(self._chunk_counts.reshape(-1), lengths)
+        return self._data[:n].copy(), counts[:n].astype(np.int32)
+
+
+class RoundBuffers:
+    """Bounded out-of-order window of per-round buffer pairs.
+
+    The worker may receive ``ScatterBlock``/``ReduceBlock`` for rounds it has not
+    started yet (peers run ahead within the line master's round window); those
+    land in buffers created on demand. Rounds older than the completed horizon
+    are dropped.
+    """
+
+    def __init__(
+        self,
+        metadata: MetaDataConfig,
+        threshold: ThresholdConfig,
+        peer_size: int,
+        window: int = 4,
+    ) -> None:
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        self.metadata = metadata
+        self.threshold = threshold
+        self.peer_size = peer_size
+        self.window = window
+        self._scattered: dict[int, ScatteredDataBuffer] = {}
+        self._reduced: dict[int, ReducedDataBuffer] = {}
+        self.completed_up_to = -1  # all rounds <= this are flushed
+
+    def in_window(self, round_num: int) -> bool:
+        return (
+            self.completed_up_to
+            < round_num
+            <= self.completed_up_to + self.window
+        )
+
+    def _check_window(self, round_num: int) -> None:
+        if not self.in_window(round_num):
+            raise RoundOutOfWindowError(
+                f"round {round_num} outside window "
+                f"({self.completed_up_to}, {self.completed_up_to + self.window}]"
+            )
+
+    def scattered(self, round_num: int) -> ScatteredDataBuffer:
+        self._check_window(round_num)
+        if round_num not in self._scattered:
+            self._scattered[round_num] = ScatteredDataBuffer(
+                self.metadata, self.threshold, self.peer_size
+            )
+        return self._scattered[round_num]
+
+    def reduced(self, round_num: int) -> ReducedDataBuffer:
+        self._check_window(round_num)
+        if round_num not in self._reduced:
+            self._reduced[round_num] = ReducedDataBuffer(
+                self.metadata, self.threshold, self.peer_size
+            )
+        return self._reduced[round_num]
+
+    def complete(self, round_num: int) -> None:
+        """Mark ``round_num`` flushed and evict everything at or below it."""
+        self.completed_up_to = max(self.completed_up_to, round_num)
+        for store in (self._scattered, self._reduced):
+            for r in [r for r in store if r <= self.completed_up_to]:
+                del store[r]

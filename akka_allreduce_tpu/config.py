@@ -1,0 +1,166 @@
+"""Typed configuration for the framework.
+
+Mirrors the reference's config case classes by name (SURVEY.md §3 "Config types":
+``ThresholdConfig(thAllreduce, thReduce, thComplete)``, ``MetaDataConfig(dataSize,
+maxChunkSize)``, ``WorkerConfig``, ``LineMasterConfig``, ``NodeConfig``,
+``MasterConfig``) so users of the reference find the same knobs by the same names.
+The three threshold fractions are the heart of the fault-tolerance model
+(BASELINE.json:10-11).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any
+
+
+def _check_fraction(name: str, value: float) -> None:
+    if not (0.0 < value <= 1.0):
+        raise ValueError(f"{name} must be in (0, 1], got {value}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ThresholdConfig:
+    """The three completion fractions governing partial (threshold) completion.
+
+    - ``th_reduce``: fraction of peers whose scatter contribution must arrive
+      before a chunk is reduced and broadcast back.
+    - ``th_complete``: fraction of expected reduced chunks that must arrive
+      before a worker flushes its output and reports ``CompleteAllreduce``.
+    - ``th_allreduce``: fraction of workers that must report completion before
+      the line master starts the next round.
+
+    A reduce round therefore completes when a configurable *fraction* of workers
+    have contributed, tolerating stragglers, dropout, and late joiners without
+    stalling training (BASELINE.json:5).
+    """
+
+    th_allreduce: float = 1.0
+    th_reduce: float = 1.0
+    th_complete: float = 1.0
+
+    def __post_init__(self) -> None:
+        _check_fraction("th_allreduce", self.th_allreduce)
+        _check_fraction("th_reduce", self.th_reduce)
+        _check_fraction("th_complete", self.th_complete)
+
+    def reduce_count(self, peer_size: int) -> int:
+        """Contributions required before a chunk may be reduced."""
+        return max(1, math.ceil(self.th_reduce * peer_size))
+
+    def complete_count(self, total_chunks: int) -> int:
+        """Reduced chunks required before a worker flushes its round output."""
+        return max(1, math.ceil(self.th_complete * total_chunks))
+
+    def allreduce_count(self, num_workers: int) -> int:
+        """Worker completions required before the next round starts."""
+        return max(1, math.ceil(self.th_allreduce * num_workers))
+
+
+@dataclasses.dataclass(frozen=True)
+class MetaDataConfig:
+    """Payload geometry: total element count and chunking granularity.
+
+    ``max_chunk_size`` plays the reference's role (scatter chunk granularity) and,
+    on the XLA path, becomes the gradient *bucket* size for overlapping collectives
+    with compute (SURVEY.md §3 "chunking via maxChunkSize").
+    """
+
+    data_size: int
+    max_chunk_size: int = 262_144
+
+    def __post_init__(self) -> None:
+        if self.data_size <= 0:
+            raise ValueError(f"data_size must be positive, got {self.data_size}")
+        if self.max_chunk_size <= 0:
+            raise ValueError(
+                f"max_chunk_size must be positive, got {self.max_chunk_size}"
+            )
+
+    def block_size(self, peer_size: int) -> int:
+        """Size of one worker's block when data is partitioned across peers."""
+        return math.ceil(self.data_size / peer_size)
+
+    def chunks_per_block(self, peer_size: int) -> int:
+        return math.ceil(self.block_size(peer_size) / self.max_chunk_size)
+
+    def chunk_size(self, peer_size: int, chunk_id: int) -> int:
+        """Length of ``chunk_id`` within a block (the last chunk may be short)."""
+        block = self.block_size(peer_size)
+        n_chunks = self.chunks_per_block(peer_size)
+        if not 0 <= chunk_id < n_chunks:
+            raise IndexError(f"chunk_id {chunk_id} out of range [0, {n_chunks})")
+        start = chunk_id * self.max_chunk_size
+        return min(self.max_chunk_size, block - start)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerConfig:
+    """Per-worker engine config (reference ``WorkerConfig``)."""
+
+    stats_reporting_round_frequency: int = 10
+    round_window: int = 4  # max out-of-order rounds buffered concurrently
+
+
+@dataclasses.dataclass(frozen=True)
+class LineMasterConfig:
+    """Per-line control-plane config (reference ``LineMasterConfig``)."""
+
+    round_window: int = 4  # bounded number of rounds in flight
+    max_rounds: int = -1  # -1 = unbounded
+    start_up_time_ms: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeConfig:
+    """Per-host supervisor config (reference ``NodeConfig``): how many grid
+    dimensions this node participates in (dim 0 = rows, dim 1 = cols, ...)."""
+
+    dimensions: int = 1
+    report_stats: bool = True
+    elastic_rate: float = 1.0  # elastic-averaging alpha for the weight binder
+
+
+@dataclasses.dataclass(frozen=True)
+class MasterConfig:
+    """Cluster-wide control-plane config (reference ``MasterConfig``)."""
+
+    node_num: int = 1  # expected nodes before lines are organized
+    dimensions: int = 1  # grid dimensionality (2 => butterfly)
+    heartbeat_interval_s: float = 1.0
+    heartbeat_timeout_s: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class AllreduceConfig:
+    """Bundle threading every layer's knobs together (bootstrap convenience)."""
+
+    threshold: ThresholdConfig = dataclasses.field(default_factory=ThresholdConfig)
+    metadata: MetaDataConfig = dataclasses.field(
+        default_factory=lambda: MetaDataConfig(data_size=1_048_576)
+    )
+    worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)
+    line_master: LineMasterConfig = dataclasses.field(default_factory=LineMasterConfig)
+    node: NodeConfig = dataclasses.field(default_factory=NodeConfig)
+    master: MasterConfig = dataclasses.field(default_factory=MasterConfig)
+
+    @classmethod
+    def from_json(cls, text: str) -> "AllreduceConfig":
+        raw: dict[str, Any] = json.loads(text)
+        kwargs: dict[str, Any] = {}
+        for field, klass in (
+            ("threshold", ThresholdConfig),
+            ("metadata", MetaDataConfig),
+            ("worker", WorkerConfig),
+            ("line_master", LineMasterConfig),
+            ("node", NodeConfig),
+            ("master", MasterConfig),
+        ):
+            if field in raw:
+                kwargs[field] = klass(**raw[field])
+        return cls(**kwargs)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)

@@ -1,0 +1,148 @@
+"""Round wire protocol.
+
+The reference's actor message protocol (SURVEY.md §3 "Message protocol":
+``StartAllreduce``, ``ScatterBlock``, ``ReduceBlock``, ``CompleteAllreduce``,
+``PrepareAllreduce``, ``ConfirmPreparation``), kept message-for-message so the
+control plane can be unit-tested exactly the way the reference's is (SURVEY.md §5:
+hand-deliver messages to one real worker wired to fake peers, assert emitted
+messages).
+
+On TPU these messages carry *control* information only. In the host (engine) data
+path — used for tests, CPU fallback, and DCN-side chunk movement — ``ScatterBlock``
+/ ``ReduceBlock`` carry numpy payloads; on the ICI path payloads never appear in
+messages at all (they stay in HBM and move inside one fused XLA collective,
+BASELINE.json:5).
+
+Messages are frozen dataclasses: picklable (so they can cross process boundaries
+over any host transport). Payload-carrying messages (``ScatterBlock``,
+``ReduceBlock``, ``AllReduceInput``, ``AllReduceOutput``) use ``eq=False`` —
+ndarray fields make generated equality raise — so they compare and hash by
+identity; pure-control messages compare by value (handy in tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class StartAllreduce:
+    """LineMaster -> worker: begin round ``round_num``."""
+
+    round_num: int
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ScatterBlock:
+    """Worker -> peer: one chunk of the sender's partition of its input.
+
+    ``value`` is the chunk destined for ``dest_id``'s block, chunk ``chunk_id``.
+    """
+
+    value: np.ndarray
+    src_id: int
+    dest_id: int
+    chunk_id: int
+    round_num: int
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "value", np.asarray(self.value, dtype=np.float32))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ReduceBlock:
+    """Worker -> peer: a reduced (summed) chunk plus its contributor count.
+
+    ``count`` is the number of peers whose scatter contribution made it into the
+    sum before ``th_reduce`` fired — consumers divide by it to get the partial
+    average (threshold semantics, SURVEY.md §3 "Collective semantics").
+    """
+
+    value: np.ndarray
+    src_id: int
+    dest_id: int
+    chunk_id: int
+    round_num: int
+    count: int
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "value", np.asarray(self.value, dtype=np.float32))
+
+
+@dataclasses.dataclass(frozen=True)
+class CompleteAllreduce:
+    """Worker -> LineMaster: this worker's round output is flushed."""
+
+    src_id: int
+    round_num: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PrepareAllreduce:
+    """Master/LineMaster -> worker: (re)configuration handshake.
+
+    Sent on membership change (dropout, late joiner): workers rebuild buffers for
+    the new peer list and confirm before rounds resume (SURVEY.md §4.5).
+    """
+
+    config_id: int
+    peer_ids: Sequence[int]
+    worker_id: int
+    round_num: int
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "peer_ids", tuple(self.peer_ids))
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfirmPreparation:
+    """Worker -> master: buffers rebuilt for ``config_id``; ready to resume."""
+
+    config_id: int
+    worker_id: int
+
+
+# --- dataSource / dataSink seam (SURVEY.md §3 "Data source/sink API") ---------
+
+
+@dataclasses.dataclass(frozen=True)
+class AllReduceInputRequest:
+    """Engine -> dataSource: pull the payload for ``iteration``."""
+
+    iteration: int
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class AllReduceInput:
+    """dataSource -> engine: the flat float payload for one round."""
+
+    data: np.ndarray
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "data", np.asarray(self.data, dtype=np.float32))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class AllReduceOutput:
+    """Engine -> dataSink: reduced sums plus per-element contributor counts.
+
+    The consumer divides ``data`` by ``count`` (elementwise, guarding zeros) to
+    obtain the partial average — the reference's ``ReduceBlock.count``
+    normalization generalized to the whole buffer.
+    """
+
+    data: np.ndarray
+    count: np.ndarray
+    iteration: int
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "data", np.asarray(self.data, dtype=np.float32))
+        object.__setattr__(self, "count", np.asarray(self.count, dtype=np.int32))
+
+    def average(self) -> np.ndarray:
+        """Sum / count with zero-contribution elements left at 0."""
+        safe = np.maximum(self.count, 1).astype(np.float32)
+        return self.data / safe

@@ -1,0 +1,19 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax is imported.
+
+This mirrors the reference's test philosophy (SURVEY.md §5): multi-node behavior is
+tested without any real cluster. Here "multi-node" data-plane tests run on one host
+via ``xla_force_host_platform_device_count=8``; control-plane tests use in-process
+fake peers. Numeric oracle throughout: numpy masked-sum / count.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

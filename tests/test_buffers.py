@@ -1,0 +1,203 @@
+"""Unit tests for the round buffers — the reference's dominant test mode
+(SURVEY.md §5: ScatteredDataBufferSpec / ReducedDataBufferSpec equivalents),
+including threshold/fault cases expressed as message omission."""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.buffers import (
+    ReducedDataBuffer,
+    RoundBuffers,
+    ScatteredDataBuffer,
+)
+from akka_allreduce_tpu.config import MetaDataConfig, ThresholdConfig
+
+
+def make_scattered(data_size=64, chunk=16, peers=4, th_reduce=1.0):
+    return ScatteredDataBuffer(
+        MetaDataConfig(data_size=data_size, max_chunk_size=chunk),
+        ThresholdConfig(th_reduce=th_reduce),
+        peer_size=peers,
+    )
+
+
+class TestScatteredDataBuffer:
+    def test_accumulates_sum_and_count(self):
+        buf = make_scattered()  # block=16, 1 chunk of 16
+        a = np.arange(16, dtype=np.float32)
+        b = np.ones(16, dtype=np.float32)
+        buf.store(a, src_id=0, chunk_id=0)
+        buf.store(b, src_id=1, chunk_id=0)
+        out, count = buf.reduce(0)
+        np.testing.assert_allclose(out, a + b)
+        assert count == 2
+
+    def test_threshold_fires_once(self):
+        buf = make_scattered(peers=4, th_reduce=0.5)  # trigger at 2
+        chunk = np.ones(16, dtype=np.float32)
+        assert not buf.store(chunk, 0, 0)
+        assert not buf.reach_reducing_threshold(0)
+        assert buf.store(chunk, 1, 0)  # edge: crossed the trigger now
+        assert buf.reach_reducing_threshold(0)
+        buf.reduce(0)
+        # late contribution after reduce: still counted, but no re-broadcast
+        assert not buf.store(chunk, 2, 0)
+        assert not buf.reach_reducing_threshold(0)
+
+    def test_store_edge_fires_even_if_reduce_deferred(self):
+        # store() signals the crossing exactly once even when the caller does
+        # not reduce immediately (level query stays True, edge does not repeat).
+        buf = make_scattered(peers=4, th_reduce=0.5)
+        chunk = np.ones(16, dtype=np.float32)
+        buf.store(chunk, 0, 0)
+        assert buf.store(chunk, 1, 0)
+        assert not buf.store(chunk, 2, 0)  # past trigger: no second edge
+        assert buf.reach_reducing_threshold(0)
+        out, count = buf.reduce(0)
+        assert count == 3  # late contribution still in the sum
+
+    def test_duplicate_delivery_is_idempotent(self):
+        buf = make_scattered()
+        chunk = np.ones(16, dtype=np.float32)
+        buf.store(chunk, 0, 0)
+        assert not buf.store(chunk, 0, 0)
+        out, count = buf.reduce(0)
+        assert count == 1
+        np.testing.assert_allclose(out, chunk)
+
+    def test_invalid_ids_raise_even_when_slot_filled(self):
+        # bounds are validated before the duplicate guard, so a corrupt id
+        # never silently reads the dedup bitmap via numpy wraparound
+        buf = make_scattered()
+        buf.store(np.ones(16, np.float32), 3, 0)  # fills _contributed[0, -1]
+        with pytest.raises(IndexError):
+            buf.store(np.ones(16, np.float32), -1, 0)
+
+    def test_tail_chunk_shape(self):
+        # data_size=100, peers=4 -> block=25, chunks of 16 and 9
+        buf = make_scattered(data_size=100, chunk=16, peers=4)
+        assert buf.num_chunks == 2
+        buf.store(np.ones(9, dtype=np.float32), 0, 1)
+        with pytest.raises(ValueError):
+            buf.store(np.ones(16, dtype=np.float32), 1, 1)
+
+    def test_rejects_bad_ids(self):
+        buf = make_scattered()
+        with pytest.raises(IndexError):
+            buf.store(np.ones(16, dtype=np.float32), src_id=4, chunk_id=0)
+        with pytest.raises(IndexError):
+            buf.store(np.ones(16, dtype=np.float32), src_id=0, chunk_id=1)
+
+
+class TestReducedDataBuffer:
+    def make(self, data_size=64, chunk=16, peers=4, th_complete=1.0):
+        return ReducedDataBuffer(
+            MetaDataConfig(data_size=data_size, max_chunk_size=chunk),
+            ThresholdConfig(th_complete=th_complete),
+            peer_size=peers,
+        )
+
+    def test_assembles_blocks_in_order(self):
+        buf = self.make()  # block=16, 1 chunk/block, 4 blocks
+        for src in range(4):
+            buf.store(np.full(16, float(src), np.float32), src, 0, count=3)
+        assert buf.reach_completion_threshold()
+        data, counts = buf.get_with_counts()
+        expected = np.concatenate(
+            [np.full(16, float(s), np.float32) for s in range(4)]
+        )
+        np.testing.assert_allclose(data, expected)
+        assert (counts == 3).all()
+
+    def test_partial_completion_by_omission(self):
+        # th_complete=0.5 of 4 chunks -> 2 chunks suffice; omitted chunks
+        # read back as zeros with count 0 (the fault-tolerance contract).
+        buf = self.make(th_complete=0.5)
+        buf.store(np.ones(16, np.float32), 0, 0, count=4)
+        assert not buf.reach_completion_threshold()
+        buf.store(np.ones(16, np.float32), 2, 0, count=2)
+        assert buf.reach_completion_threshold()
+        data, counts = buf.get_with_counts()
+        np.testing.assert_allclose(data[:16], 1.0)
+        assert (counts[16:32] == 0).all()
+        np.testing.assert_allclose(data[16:32], 0.0)
+        assert (counts[32:48] == 2).all()
+
+    def test_duplicate_store_ignored(self):
+        buf = self.make()
+        buf.store(np.ones(16, np.float32), 0, 0, count=1)
+        buf.store(np.full(16, 9.0, np.float32), 0, 0, count=4)
+        data, counts = buf.get_with_counts()
+        np.testing.assert_allclose(data[:16], 1.0)
+        assert (counts[:16] == 1).all()
+
+    def test_invalid_ids_raise_even_when_slot_filled(self):
+        buf = self.make()
+        buf.store(np.ones(16, np.float32), 3, 0, count=1)
+        with pytest.raises(IndexError):
+            buf.store(np.ones(16, np.float32), -1, 0, count=1)
+
+    def test_per_chunk_counts_expand_over_tail_chunks(self):
+        # data_size=100, peers=2 -> block=50, chunks 16/16/16/2 per block
+        buf = ReducedDataBuffer(
+            MetaDataConfig(data_size=100, max_chunk_size=16),
+            ThresholdConfig(),
+            peer_size=2,
+        )
+        buf.store(np.ones(2, np.float32), src_id=1, chunk_id=3, count=7)
+        data, counts = buf.get_with_counts()
+        assert counts.shape == (100,)
+        assert (counts[98:100] == 7).all()  # block 1 tail chunk
+        assert (counts[:98] == 0).all()
+
+    def test_trims_padding_to_data_size(self):
+        # data_size=100, peers=4 -> block=25, padded output 100 == data_size here;
+        # use data_size=98 to get real padding (block=25, 4*25=100 > 98).
+        buf = self.make(data_size=98, chunk=25)
+        data, counts = buf.get_with_counts()
+        assert data.shape == (98,)
+        assert counts.shape == (98,)
+
+
+class TestRoundBuffers:
+    def make(self, window=2):
+        return RoundBuffers(
+            MetaDataConfig(data_size=64, max_chunk_size=16),
+            ThresholdConfig(),
+            peer_size=4,
+            window=window,
+        )
+
+    def test_window_admits_future_rounds(self):
+        rb = self.make(window=2)
+        assert rb.in_window(0) and rb.in_window(1)
+        assert not rb.in_window(2)
+        rb.complete(0)
+        assert not rb.in_window(0)
+        assert rb.in_window(2)
+
+    def test_buffers_created_on_demand_and_evicted(self):
+        rb = self.make(window=2)
+        s0 = rb.scattered(0)
+        assert rb.scattered(0) is s0  # cached
+        rb.reduced(1)
+        rb.complete(0)
+        assert 0 not in rb._scattered
+        assert 1 in rb._reduced
+
+    def test_out_of_order_completion(self):
+        rb = self.make(window=4)
+        rb.scattered(0), rb.scattered(1), rb.scattered(2)
+        rb.complete(2)  # th_allreduce may let round 2 finish before 0/1 flush
+        assert rb.completed_up_to == 2
+        assert not rb._scattered
+
+    def test_out_of_window_rounds_rejected(self):
+        from akka_allreduce_tpu.buffers import RoundOutOfWindowError
+
+        rb = self.make(window=2)
+        with pytest.raises(RoundOutOfWindowError):
+            rb.scattered(2)  # too far ahead
+        rb.complete(3)
+        with pytest.raises(RoundOutOfWindowError):
+            rb.reduced(3)  # already flushed: stale duplicate must not resurrect

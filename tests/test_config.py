@@ -1,0 +1,76 @@
+"""Unit tests for the typed configs (threshold math is load-bearing)."""
+
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    MetaDataConfig,
+    ThresholdConfig,
+)
+
+
+class TestThresholdConfig:
+    def test_defaults_are_full_completion(self):
+        t = ThresholdConfig()
+        assert t.reduce_count(8) == 8
+        assert t.complete_count(16) == 16
+        assert t.allreduce_count(4) == 4
+
+    def test_fractional_thresholds_ceil(self):
+        t = ThresholdConfig(th_allreduce=0.75, th_reduce=0.5, th_complete=0.9)
+        assert t.reduce_count(8) == 4
+        assert t.reduce_count(7) == 4  # ceil(3.5)
+        assert t.complete_count(10) == 9
+        assert t.allreduce_count(4) == 3
+
+    def test_at_least_one(self):
+        t = ThresholdConfig(th_allreduce=0.01, th_reduce=0.01, th_complete=0.01)
+        assert t.reduce_count(4) == 1
+        assert t.complete_count(4) == 1
+        assert t.allreduce_count(4) == 1
+
+    @pytest.mark.parametrize("bad", [0.0, -0.5, 1.5])
+    def test_rejects_out_of_range(self, bad):
+        with pytest.raises(ValueError):
+            ThresholdConfig(th_reduce=bad)
+
+
+class TestMetaDataConfig:
+    def test_block_and_chunk_geometry(self):
+        m = MetaDataConfig(data_size=100, max_chunk_size=16)
+        assert m.block_size(peer_size=4) == 25
+        assert m.chunks_per_block(peer_size=4) == 2
+        assert m.chunk_size(4, 0) == 16
+        assert m.chunk_size(4, 1) == 9  # tail chunk
+
+    def test_exact_division(self):
+        m = MetaDataConfig(data_size=64, max_chunk_size=8)
+        assert m.block_size(4) == 16
+        assert m.chunks_per_block(4) == 2
+        assert m.chunk_size(4, 1) == 8
+
+    def test_chunk_id_out_of_range(self):
+        m = MetaDataConfig(data_size=64, max_chunk_size=8)
+        with pytest.raises(IndexError):
+            m.chunk_size(4, 2)
+
+    def test_rejects_bad_sizes(self):
+        with pytest.raises(ValueError):
+            MetaDataConfig(data_size=0)
+        with pytest.raises(ValueError):
+            MetaDataConfig(data_size=10, max_chunk_size=0)
+
+
+class TestAllreduceConfig:
+    def test_json_round_trip(self):
+        cfg = AllreduceConfig(
+            threshold=ThresholdConfig(0.8, 0.75, 0.9),
+            metadata=MetaDataConfig(data_size=1000, max_chunk_size=100),
+        )
+        back = AllreduceConfig.from_json(cfg.to_json())
+        assert back == cfg
+
+    def test_partial_json(self):
+        cfg = AllreduceConfig.from_json('{"threshold": {"th_reduce": 0.5}}')
+        assert cfg.threshold.th_reduce == 0.5
+        assert cfg.metadata.data_size == 1_048_576
