@@ -51,6 +51,8 @@ class ScatteredDataBuffer:
     ) -> None:
         if peer_size <= 0:
             raise ValueError(f"peer_size must be positive, got {peer_size}")
+        if block_size is not None and block_size <= 0:
+            raise ValueError(f"block_size must be positive, got {block_size}")
         self.metadata = metadata
         self.threshold = threshold
         self.peer_size = peer_size
@@ -111,6 +113,7 @@ class ScatteredDataBuffer:
         Stays True from the trigger crossing until ``reduce`` is called; for the
         once-only broadcast decision use ``store``'s return value instead.
         """
+        self._chunk_bounds(chunk_id)  # reject out-of-range (incl. negative) ids
         return (
             not self._reduced[chunk_id]
             and int(self._counts[chunk_id]) >= self.reduce_trigger
@@ -144,9 +147,7 @@ class ReducedDataBuffer:
         self.threshold = threshold
         self.peer_size = peer_size
         self.block_size = metadata.block_size(peer_size)
-        self.chunks_per_block = max(
-            1, -(-self.block_size // metadata.max_chunk_size)
-        )
+        self.chunks_per_block = metadata.chunks_per_block(peer_size)
         self.total_chunks = self.chunks_per_block * peer_size
         # Output covers peer_size * block_size >= data_size; trailing pad ignored.
         self._data = np.zeros(peer_size * self.block_size, dtype=np.float32)
@@ -162,10 +163,7 @@ class ReducedDataBuffer:
         # then a possibly-short tail.
         self._chunk_lengths = np.array(
             [
-                min(
-                    metadata.max_chunk_size,
-                    self.block_size - c * metadata.max_chunk_size,
-                )
+                metadata.chunk_size(peer_size, c)
                 for c in range(self.chunks_per_block)
             ],
             dtype=np.int64,
@@ -282,3 +280,10 @@ class RoundBuffers:
         for store in (self._scattered, self._reduced):
             for r in [r for r in store if r <= self.completed_up_to]:
                 del store[r]
+
+    def fast_forward(self, round_num: int) -> None:
+        """Re-sync a lagging worker: abandon all rounds that can no longer fit
+        in the window once ``round_num`` is admitted. Only call on
+        master-authoritative evidence (a ``StartAllreduce``) that older rounds
+        are already abandoned cluster-wide."""
+        self.complete(round_num - self.window)

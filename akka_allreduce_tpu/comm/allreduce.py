@@ -1,0 +1,317 @@
+"""Threshold-masked allreduce over a device mesh.
+
+Semantics (the reference's, recast in SPMD — SURVEY.md §3 "Collective semantics"):
+every device contributes ``(payload, valid)`` where ``valid`` is 1.0 for a live
+contributor and 0.0 for a straggler/dropout whose data must not count. One fused
+collective computes ``sum = psum(payload * valid)`` and ``count = psum(valid)``;
+consumers divide sum by count to get the partial average. This reproduces the
+reference's ``ReduceBlock.count`` normalization without leaving XLA, and the
+validity mask may be per *bucket* (the ``max_chunk_size`` granularity), matching
+the reference's per-chunk contribution counting.
+
+Chip loss is NOT handled here — XLA collectives are all-or-nothing across the
+mesh. Masks absorb within-round straggling/invalid data; actual membership change
+is the control plane's job (re-mesh via the PrepareAllreduce handshake,
+SURVEY.md §8.4).
+
+Schedules:
+
+- ``"psum"``      — single fused AllReduce over all given axes (XLA picks the
+  ICI algorithm: ring on a 1D torus axis, combined for 2D). The fast default.
+- ``"butterfly"`` — staged per-axis psums on a 2D grid mesh: reduce along
+  ``rows`` then ``cols``, the reference's two-stage grid/butterfly
+  (SURVEY.md §4.3; BASELINE.json:8).
+- ``"ring"``      — explicit ppermute ring (reduce-scatter + all-gather),
+  the reference's "ring schedule" for large chunked buffers (BASELINE.json:9);
+  also the substrate for later overlap/pipelining work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import OrderedDict
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.parallel.mesh import LINE_AXIS
+
+Axes = tuple[str, ...]
+
+
+def _normalize_axes(mesh: Mesh, axes: str | Sequence[str] | None) -> Axes:
+    if axes is None:
+        names = tuple(mesh.axis_names)
+    elif isinstance(axes, str):
+        names = (axes,)
+    else:
+        names = tuple(axes)
+    for name in names:
+        if name not in mesh.axis_names:
+            raise ValueError(f"axis {name!r} not in mesh axes {mesh.axis_names}")
+    return names
+
+
+def _num_buckets(data_size: int, bucket_size: int | None) -> int:
+    if bucket_size is None:
+        return 1
+    if bucket_size <= 0:
+        raise ValueError(f"bucket_size must be positive, got {bucket_size}")
+    return math.ceil(data_size / bucket_size)
+
+
+# --------------------------------------------------------------------------
+# Inner primitives — call these INSIDE shard_map / a pjit-ed step.
+# --------------------------------------------------------------------------
+
+
+def masked_psum(
+    x: jax.Array,
+    valid: jax.Array,
+    axis_names: str | Axes,
+    *,
+    bucket_size: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused threshold-masked allreduce; use inside ``shard_map``.
+
+    Args:
+      x: this device's flat payload, shape ``(data,)``.
+      valid: scalar 0/1 contribution mask, or per-bucket mask ``(n_buckets,)``
+        when ``bucket_size`` is given.
+      axis_names: mesh axis (or axes) to reduce over.
+    Returns:
+      ``(sum, count)`` — both replicated across the axes; ``sum`` has x's shape,
+      ``count`` has the mask's shape (per-element expansion is the caller's
+      choice via :func:`expand_counts`).
+    """
+    valid = jnp.asarray(valid, dtype=x.dtype)
+    if bucket_size is None:
+        masked = x * valid
+    else:
+        n_buckets = _num_buckets(x.shape[0], bucket_size)
+        if valid.shape != (n_buckets,):
+            raise ValueError(
+                f"per-bucket mask must have shape ({n_buckets},), got {valid.shape}"
+            )
+        pad = n_buckets * bucket_size - x.shape[0]
+        xp = jnp.pad(x, (0, pad)).reshape(n_buckets, bucket_size)
+        masked = (xp * valid[:, None]).reshape(-1)[: x.shape[0]]
+    total = lax.psum(masked, axis_names)
+    count = lax.psum(valid, axis_names)
+    return total, count
+
+
+def expand_counts(
+    count: jax.Array, data_size: int, bucket_size: int | None
+) -> jax.Array:
+    """Expand a per-bucket count vector to per-element counts of ``data_size``."""
+    if count.ndim == 0:
+        return jnp.full((data_size,), count)
+    return jnp.repeat(count, bucket_size)[:data_size]
+
+
+def _staged_masked_psum(
+    x: jax.Array,
+    valid: jax.Array,
+    axis_names: Axes,
+    bucket_size: int | None,
+) -> tuple[jax.Array, jax.Array]:
+    """Butterfly: reduce one grid axis at a time (dim-0 sink feeds dim-1 source,
+    SURVEY.md §4.3). Numerically equals the fused psum; structurally it is the
+    reference's staged grid round and lets each stage ride a different ICI axis."""
+    total, count = x, jnp.asarray(valid, dtype=x.dtype)
+    if bucket_size is not None:
+        n_buckets = _num_buckets(x.shape[0], bucket_size)
+        pad = n_buckets * bucket_size - x.shape[0]
+        xp = jnp.pad(x, (0, pad)).reshape(n_buckets, bucket_size)
+        total = (xp * count[:, None]).reshape(-1)[: x.shape[0]]
+    else:
+        total = x * count
+    for name in axis_names:
+        total = lax.psum(total, name)
+        count = lax.psum(count, name)
+    return total, count
+
+
+def ring_allreduce_sum(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Explicit bidirectional-naive ring allreduce of ``x`` over ``axis_name``.
+
+    Reduce-scatter then all-gather via ``ppermute``, each in ``axis_size - 1``
+    steps — the reference's ring schedule for large buffers (BASELINE.json:9)
+    expressed as a compiled XLA loop. Payload is padded to ``axis_size`` equal
+    segments.
+    """
+    n = axis_size
+    if n == 1:
+        return x
+    data = x.shape[0]
+    seg = math.ceil(data / n)
+    segs = jnp.pad(x, (0, n * seg - data)).reshape(n, seg)
+    idx = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def rs_step(s, segs):
+        send_i = jnp.mod(idx - s, n)
+        block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
+        recv = lax.ppermute(block, axis_name, fwd)
+        recv_i = jnp.mod(idx - s - 1, n)
+        cur = lax.dynamic_slice_in_dim(segs, recv_i, 1, axis=0)
+        return lax.dynamic_update_slice_in_dim(segs, cur + recv, recv_i, axis=0)
+
+    segs = lax.fori_loop(0, n - 1, rs_step, segs)
+    # device i now owns fully-reduced segment (i + 1) mod n
+
+    def ag_step(s, segs):
+        send_i = jnp.mod(idx + 1 - s, n)
+        block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
+        recv = lax.ppermute(block, axis_name, fwd)
+        recv_i = jnp.mod(idx - s, n)
+        return lax.dynamic_update_slice_in_dim(segs, recv, recv_i, axis=0)
+
+    segs = lax.fori_loop(0, n - 1, ag_step, segs)
+    return segs.reshape(-1)[:data]
+
+
+# --------------------------------------------------------------------------
+# Host-facing jitted collective
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AllreduceResult:
+    """Mirror of the sink payload (protocol.AllReduceOutput) on device."""
+
+    sum: jax.Array  # (data,) — masked sum across contributors
+    count: jax.Array  # (data,) — per-element contributor count
+
+    def average(self) -> jax.Array:
+        return self.sum / jnp.maximum(self.count, 1.0)
+
+
+_CACHE_MAX = 64
+_CACHE: OrderedDict = OrderedDict()
+
+
+def build_threshold_allreduce(
+    mesh: Mesh,
+    *,
+    axes: str | Sequence[str] | None = None,
+    bucket_size: int | None = None,
+    schedule: str = "psum",
+    donate: bool = True,
+):
+    """Build a jitted ``(xs, valid) -> (sum, count)`` collective over ``mesh``.
+
+    ``xs`` has shape ``(n_devices, data)`` sharded on its first dim across all
+    of ``axes``; ``valid`` is ``(n_devices,)`` (whole-payload mask) or
+    ``(n_devices, n_buckets)`` (per-chunk mask). Outputs are replicated.
+    """
+    axis_names = _normalize_axes(mesh, axes)
+    if set(axis_names) != set(mesh.axis_names):
+        raise ValueError(
+            "host-facing allreduce reduces over the full mesh (output is "
+            f"replicated); got axes {axis_names} of {mesh.axis_names}. For "
+            "partial-axis reduction call masked_psum inside your own shard_map."
+        )
+    n_devices = int(np.prod([mesh.shape[a] for a in axis_names]))
+    if schedule not in ("psum", "butterfly", "ring"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "butterfly" and len(axis_names) < 2:
+        raise ValueError("butterfly schedule needs a 2D grid mesh")
+    if schedule == "ring" and len(axis_names) != 1:
+        raise ValueError("ring schedule reduces over exactly one axis")
+
+    spec_in = P(axis_names if len(axis_names) > 1 else axis_names[0])
+
+    def kernel(xs, valid):
+        x = xs.reshape(xs.shape[-1])  # (1, data) block -> (data,)
+        data_size = x.shape[0]
+        if valid.ndim > 1:  # (1, n_buckets) block -> per-bucket mask
+            v = valid.reshape(valid.shape[1:])
+        else:  # (1,) block -> whole-payload scalar mask
+            v = valid.reshape(())
+        if bucket_size is not None and v.ndim == 0:
+            v = jnp.full((_num_buckets(data_size, bucket_size),), v)
+        if bucket_size is None and v.ndim != 0:
+            raise ValueError("per-bucket valid mask requires bucket_size")
+        if schedule == "ring":
+            if v.ndim == 0:
+                vx = x * v
+            else:
+                n_buckets = _num_buckets(data_size, bucket_size)
+                pad = n_buckets * bucket_size - data_size
+                xp = jnp.pad(x, (0, pad)).reshape(n_buckets, bucket_size)
+                vx = (xp * v[:, None]).reshape(-1)[:data_size]
+            total = ring_allreduce_sum(vx, axis_names[0], n_devices)
+            count = lax.psum(jnp.asarray(v, x.dtype), axis_names)
+        elif schedule == "butterfly":
+            total, count = _staged_masked_psum(x, v, axis_names, bucket_size)
+        else:
+            total, count = masked_psum(x, v, axis_names, bucket_size=bucket_size)
+        return total, expand_counts(count, data_size, bucket_size)
+
+    mapped = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec_in, spec_in),
+        out_specs=(P(), P()),
+        # The ring's ppermute all-gather produces a replicated result, but the
+        # static varying-axes check cannot prove it; the numeric tests do.
+        check_vma=(schedule != "ring"),
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def threshold_allreduce(
+    mesh: Mesh,
+    xs,
+    valid=None,
+    *,
+    axes: str | Sequence[str] | None = None,
+    bucket_size: int | None = None,
+    schedule: str = "psum",
+) -> AllreduceResult:
+    """Convenience entry: threshold-masked allreduce of per-device payloads.
+
+    ``xs``: ``(n_devices, data)`` (host or device). ``valid``: None (all
+    contribute), ``(n_devices,)``, or ``(n_devices, n_buckets)``.
+    """
+    axis_names = _normalize_axes(mesh, axes)
+    key = (mesh, axis_names, bucket_size, schedule)
+    if key not in _CACHE:
+        # full-mesh-axes validation happens inside the build
+        _CACHE[key] = build_threshold_allreduce(
+            mesh,
+            axes=axis_names,
+            bucket_size=bucket_size,
+            schedule=schedule,
+            # never donate here: the caller may hand us an already-correctly-
+            # sharded device array that device_put returns unchanged, and the
+            # convenience API must not invalidate the caller's buffer
+            donate=False,
+        )
+        if len(_CACHE) > _CACHE_MAX:
+            _CACHE.popitem(last=False)
+    else:
+        _CACHE.move_to_end(key)
+    fn = _CACHE[key]
+    n_devices = int(np.prod([mesh.shape[a] for a in axis_names]))
+    xs = jnp.asarray(xs, dtype=jnp.float32)
+    if xs.ndim != 2 or xs.shape[0] != n_devices:
+        raise ValueError(
+            f"xs must be (n_devices={n_devices}, data), got {xs.shape}"
+        )
+    if valid is None:
+        valid = jnp.ones((n_devices,), dtype=jnp.float32)
+    valid = jnp.asarray(valid, dtype=jnp.float32)
+    spec = P(axis_names if len(axis_names) > 1 else axis_names[0])
+    xs = jax.device_put(xs, NamedSharding(mesh, spec))
+    valid = jax.device_put(valid, NamedSharding(mesh, spec))
+    total, count = fn(xs, valid)
+    return AllreduceResult(sum=total, count=count)

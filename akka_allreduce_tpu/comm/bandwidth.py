@@ -1,0 +1,116 @@
+"""Bus-bandwidth measurement harness (SURVEY.md §8.1 step 1).
+
+Honest-measurement rules from BASELINE.md: exclude compilation (warmup first),
+donate the input buffer, time with ``block_until_ready``, and report *bus*
+bandwidth ``2*(n-1)/n * bytes / t`` — the standard allreduce wire-traffic
+metric — not algorithmic bandwidth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.comm.allreduce import (
+    _normalize_axes,
+    build_threshold_allreduce,
+)
+from akka_allreduce_tpu.utils.metrics import MetricsLogger, RoundMetrics
+
+
+def bus_bandwidth_gbps(n_devices: int, nbytes: int, seconds: float) -> float:
+    if seconds <= 0 or n_devices <= 0:
+        return 0.0
+    return 2.0 * (n_devices - 1) / n_devices * nbytes / seconds / 1e9
+
+
+@dataclasses.dataclass
+class BandwidthReport:
+    num_floats: int
+    n_devices: int
+    schedule: str
+    iters: int
+    mean_s: float
+    min_s: float
+    bus_gbps_mean: float
+    bus_gbps_best: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def measure_allreduce(
+    mesh: Mesh,
+    num_floats: int,
+    *,
+    axes=None,
+    bucket_size: int | None = None,
+    schedule: str = "psum",
+    iters: int = 10,
+    warmup: int = 2,
+    logger: MetricsLogger | None = None,
+    seed: int = 0,
+) -> BandwidthReport:
+    """Time the threshold allreduce at full participation and report bus GB/s."""
+    axis_names = _normalize_axes(mesh, axes)
+    n = int(np.prod([mesh.shape[a] for a in axis_names]))
+    fn = build_threshold_allreduce(
+        mesh, axes=axis_names, bucket_size=bucket_size, schedule=schedule
+    )
+    spec = P(axis_names if len(axis_names) > 1 else axis_names[0])
+    sharding = NamedSharding(mesh, spec)
+    rng = np.random.default_rng(seed)
+    host_x = rng.standard_normal((n, num_floats), dtype=np.float32)
+    host_v = np.ones((n,), dtype=np.float32)
+
+    def fresh_args():
+        return (
+            jax.device_put(host_x, sharding),
+            jax.device_put(host_v, sharding),
+        )
+
+    for _ in range(warmup):
+        s, c = fn(*fresh_args())
+        jax.block_until_ready((s, c))
+
+    nbytes = num_floats * 4
+    times = []
+    for i in range(iters):
+        args = fresh_args()
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        s, c = fn(*args)
+        jax.block_until_ready((s, c))
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        if logger is not None:
+            logger.log_round(
+                RoundMetrics(
+                    round_num=i,
+                    latency_s=dt,
+                    data_bytes=nbytes,
+                    n_devices=n,
+                    contributors=float(n),
+                    schedule=schedule,
+                    extra={"num_floats": num_floats},
+                )
+            )
+
+    mean_s = float(np.mean(times))
+    min_s = float(np.min(times))
+    return BandwidthReport(
+        num_floats=num_floats,
+        n_devices=n,
+        schedule=schedule,
+        iters=iters,
+        mean_s=mean_s,
+        min_s=min_s,
+        bus_gbps_mean=bus_bandwidth_gbps(n, nbytes, mean_s),
+        bus_gbps_best=bus_bandwidth_gbps(n, nbytes, min_s),
+    )

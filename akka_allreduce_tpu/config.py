@@ -149,18 +149,23 @@ class AllreduceConfig:
     @classmethod
     def from_json(cls, text: str) -> "AllreduceConfig":
         raw: dict[str, Any] = json.loads(text)
-        kwargs: dict[str, Any] = {}
-        for field, klass in (
-            ("threshold", ThresholdConfig),
-            ("metadata", MetaDataConfig),
-            ("worker", WorkerConfig),
-            ("line_master", LineMasterConfig),
-            ("node", NodeConfig),
-            ("master", MasterConfig),
-        ):
-            if field in raw:
-                kwargs[field] = klass(**raw[field])
-        return cls(**kwargs)
+        sections = {
+            "threshold": ThresholdConfig,
+            "metadata": MetaDataConfig,
+            "worker": WorkerConfig,
+            "line_master": LineMasterConfig,
+            "node": NodeConfig,
+            "master": MasterConfig,
+        }
+        unknown = set(raw) - set(sections)
+        if unknown:
+            raise ValueError(
+                f"unknown config section(s) {sorted(unknown)}; "
+                f"expected among {sorted(sections)}"
+            )
+        return cls(
+            **{name: klass(**raw[name]) for name, klass in sections.items() if name in raw}
+        )
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)

@@ -1,0 +1,20 @@
+"""Control plane: the reference's actor roles as transport-agnostic state machines.
+
+The reference's ``Master`` / ``LineMaster`` / ``AllreduceWorker`` actors
+(SURVEY.md §2 L2-L3) become pure-Python message handlers: each exposes
+``handle(msg) -> list[Envelope]`` and owns no thread — single-threaded message
+processing gives the same no-races-by-construction property as the actor model
+(SURVEY.md §6 "Race detection"). A router (in-process ``LocalRouter`` for the
+local dev mode, gRPC/TCP for multi-host) delivers envelopes.
+
+On TPU the worker's data plane is the XLA collective (``comm``); the host engine
+data path in ``worker.py`` carries real payloads only for tests, CPU fallback,
+and DCN-side chunk movement — exactly the control/data split of the north star
+(BASELINE.json:5).
+"""
+
+from akka_allreduce_tpu.control.envelope import Envelope, MASTER, master_addr, peer_addr  # noqa: F401
+from akka_allreduce_tpu.control.worker import AllreduceWorker  # noqa: F401
+from akka_allreduce_tpu.control.line_master import LineMaster  # noqa: F401
+from akka_allreduce_tpu.control.grid_master import GridMaster  # noqa: F401
+from akka_allreduce_tpu.control.local import LocalAllreduceSystem, LocalRouter  # noqa: F401

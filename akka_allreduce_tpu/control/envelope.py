@@ -1,0 +1,24 @@
+"""Addressed messages between control-plane handlers."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+MASTER = "master"
+
+
+def peer_addr(worker_id: int) -> str:
+    return f"worker:{worker_id}"
+
+
+def master_addr(line_id: int = 0) -> str:
+    return f"line_master:{line_id}"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class Envelope:
+    """One outgoing message: deliver ``msg`` to ``dest`` (an address string)."""
+
+    dest: str
+    msg: Any

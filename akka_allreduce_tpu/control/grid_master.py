@@ -1,0 +1,156 @@
+"""Cluster-wide control plane — the reference's grid ``Master`` (SURVEY.md §3).
+
+Discovers nodes via membership events, organizes them into lines (1D) or a 2D
+grid of row/column lines (the butterfly topology, SURVEY.md §4.3), owns one
+``LineMaster`` per line, and on any membership change bumps the config id and
+re-runs the ``PrepareAllreduce`` -> ``ConfirmPreparation`` handshake so rounds
+resume against the new peer set (SURVEY.md §4.5: within-round dropout needs NO
+reconfiguration — thresholds absorb it; this path is for actual member loss or
+late joiners).
+
+Worker addressing: each node runs one worker per grid dimension (the
+reference's ``AllreduceDimensionNode``); worker id = ``node_id * dims + dim``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from akka_allreduce_tpu.config import (
+    LineMasterConfig,
+    MasterConfig,
+    ThresholdConfig,
+)
+from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.control.line_master import LineMaster
+from akka_allreduce_tpu.parallel.mesh import grid_factors
+from akka_allreduce_tpu.protocol import CompleteAllreduce, ConfirmPreparation
+
+log = logging.getLogger(__name__)
+
+
+def dim_worker_id(node_id: int, dim: int, dims: int) -> int:
+    return node_id * dims + dim
+
+
+class GridMaster:
+    """Membership + line organization + reconfiguration handshake."""
+
+    def __init__(
+        self,
+        threshold: ThresholdConfig,
+        config: MasterConfig = MasterConfig(),
+        line_master_config: LineMasterConfig = LineMasterConfig(),
+    ) -> None:
+        self.threshold = threshold
+        self.config = config
+        self.line_master_config = line_master_config
+        self.nodes: set[int] = set()
+        self.config_id = 0
+        self.organized = False
+        self.line_masters: dict[int, LineMaster] = {}
+        self._line_of_worker: dict[int, int] = {}
+        self.resume_round = 0
+
+    # -- membership events (reference: Akka Cluster MemberUp/Unreachable) ----
+
+    def member_up(self, node_id: int) -> list[Envelope]:
+        if node_id in self.nodes:
+            return []
+        self.nodes.add(node_id)
+        if not self.organized:
+            if len(self.nodes) < self.config.node_num:
+                return []
+            return self._organize()
+        # late joiner after initial organization: re-line immediately
+        log.info("master: late joiner node %d -> reorganize", node_id)
+        return self._organize()
+
+    def member_unreachable(self, node_id: int) -> list[Envelope]:
+        if node_id not in self.nodes:
+            return []
+        self.nodes.discard(node_id)
+        if not self.organized:
+            return []
+        log.info("master: lost node %d -> reorganize", node_id)
+        if not self.nodes:
+            self.organized = False
+            self.line_masters.clear()
+            self._line_of_worker.clear()
+            return []
+        return self._organize()
+
+    # -- line organization ---------------------------------------------------
+
+    def _organize(self) -> list[Envelope]:
+        """(Re)partition nodes into lines; handshake every line."""
+        # Resume AFTER the highest round any previous line had begun, so a new
+        # configuration never reuses in-flight round numbers.
+        if self.line_masters:
+            self.resume_round = max(
+                lm.next_round for lm in self.line_masters.values()
+            )
+        self.config_id += 1
+        self.organized = True
+        self.line_masters.clear()
+        self._line_of_worker.clear()
+        nodes = sorted(self.nodes)
+        dims = self.config.dimensions
+        lines: list[list[int]] = []  # each entry: worker ids of one line
+        if dims == 1:
+            lines.append([dim_worker_id(n, 0, 1) for n in nodes])
+        elif dims == 2:
+            rows, cols = grid_factors(len(nodes))
+            grid = [nodes[r * cols : (r + 1) * cols] for r in range(rows)]
+            # dim 0: one line per row; dim 1: one line per column
+            for r in range(rows):
+                lines.append([dim_worker_id(n, 0, 2) for n in grid[r]])
+            for c in range(cols):
+                lines.append([dim_worker_id(grid[r][c], 1, 2) for r in range(rows)])
+        else:
+            raise ValueError(f"dimensions must be 1 or 2, got {dims}")
+
+        out: list[Envelope] = []
+        for line_id, worker_ids in enumerate(lines):
+            lm = LineMaster(
+                self.threshold, self.line_master_config, line_id=line_id
+            )
+            self.line_masters[line_id] = lm
+            for w in worker_ids:
+                self._line_of_worker[w] = line_id
+            out.extend(
+                lm.prepare(tuple(worker_ids), self.config_id, self.resume_round)
+            )
+        log.info(
+            "master: organized %d nodes into %d line(s), config %d, resume at %d",
+            len(nodes),
+            len(lines),
+            self.config_id,
+            self.resume_round,
+        )
+        return out
+
+    # -- message routing -----------------------------------------------------
+
+    def handle_for_line(self, line_id: int, msg: Any) -> list[Envelope]:
+        lm = self.line_masters.get(line_id)
+        if lm is None:
+            return []
+        return lm.handle(msg)
+
+    def handle(self, msg: Any) -> list[Envelope]:
+        """Route a worker->master message to the owning line master."""
+        if isinstance(msg, (ConfirmPreparation, CompleteAllreduce)):
+            wid = msg.worker_id if isinstance(msg, ConfirmPreparation) else msg.src_id
+            line_id = self._line_of_worker.get(wid)
+            if line_id is None:
+                return []
+            return self.handle_for_line(line_id, msg)
+        raise TypeError(f"master cannot handle {type(msg).__name__}")
+
+    @property
+    def is_done(self) -> bool:
+        return bool(self.line_masters) and all(
+            lm.is_done for lm in self.line_masters.values()
+        )

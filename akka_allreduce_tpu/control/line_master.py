@@ -1,0 +1,150 @@
+"""Per-line round scheduler — the reference's ``LineMaster`` (SURVEY.md §3).
+
+Keeps a bounded number of rounds in flight; a round completes when
+``ceil(th_allreduce * n_workers)`` workers report ``CompleteAllreduce``; each
+completion advances the window (new rounds start immediately — never wait for
+stragglers). Rounds older than a completed round are abandoned (their
+completions are ignored), matching the worker's discipline.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from akka_allreduce_tpu.config import LineMasterConfig, ThresholdConfig
+from akka_allreduce_tpu.control.envelope import Envelope, peer_addr
+from akka_allreduce_tpu.protocol import (
+    CompleteAllreduce,
+    ConfirmPreparation,
+    PrepareAllreduce,
+    StartAllreduce,
+)
+
+log = logging.getLogger(__name__)
+
+
+class LineMaster:
+    """Drives rounds for one line (worker group) of the grid."""
+
+    def __init__(
+        self,
+        threshold: ThresholdConfig,
+        config: LineMasterConfig = LineMasterConfig(),
+        line_id: int = 0,
+    ) -> None:
+        self.threshold = threshold
+        self.config = config
+        self.line_id = line_id
+        self.worker_ids: tuple[int, ...] = ()
+        self.config_id: int = -1
+        self.next_round = 0  # next round number to start
+        self.completed_up_to = -1
+        self.started_rounds: set[int] = set()
+        self.completions: dict[int, set[int]] = {}  # round -> worker ids
+        self.total_completed = 0
+        self._confirmed: set[int] = set()
+        self._preparing = False
+
+    # -- configuration / handshake ------------------------------------------
+
+    def prepare(
+        self, worker_ids: tuple[int, ...], config_id: int, from_round: int
+    ) -> list[Envelope]:
+        """Begin the PrepareAllreduce handshake with a (new) worker set."""
+        self.worker_ids = tuple(worker_ids)
+        self.config_id = config_id
+        self.next_round = from_round
+        self.started_rounds.clear()
+        self.completions.clear()
+        self.completed_up_to = from_round - 1
+        self._confirmed.clear()
+        self._preparing = True
+        return [
+            Envelope(
+                peer_addr(w),
+                PrepareAllreduce(
+                    config_id, self.worker_ids, w, from_round, self.line_id
+                ),
+            )
+            for w in self.worker_ids
+        ]
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.worker_ids)
+
+    @property
+    def completion_trigger(self) -> int:
+        return self.threshold.allreduce_count(self.n_workers)
+
+    # -- message dispatch ----------------------------------------------------
+
+    def handle(self, msg: Any) -> list[Envelope]:
+        if isinstance(msg, ConfirmPreparation):
+            return self._on_confirm(msg)
+        if isinstance(msg, CompleteAllreduce):
+            return self._on_complete(msg)
+        raise TypeError(f"line master cannot handle {type(msg).__name__}")
+
+    def _on_confirm(self, msg: ConfirmPreparation) -> list[Envelope]:
+        if msg.config_id != self.config_id or not self._preparing:
+            return []
+        self._confirmed.add(msg.worker_id)
+        if self._confirmed != set(self.worker_ids):
+            return []
+        # all workers rebuilt their buffers: open the round window
+        self._preparing = False
+        log.info(
+            "line %d: config %d confirmed by all %d workers; starting at round %d",
+            self.line_id,
+            self.config_id,
+            self.n_workers,
+            self.next_round,
+        )
+        return self._fill_window()
+
+    def _on_complete(self, msg: CompleteAllreduce) -> list[Envelope]:
+        r = msg.round_num
+        if self._preparing or r <= self.completed_up_to or r not in self.started_rounds:
+            return []  # stale or unknown round
+        done = self.completions.setdefault(r, set())
+        if msg.src_id in done:
+            return []
+        done.add(msg.src_id)
+        if len(done) < self.completion_trigger:
+            return []
+        # round complete at threshold; abandon older in-flight rounds
+        self.completed_up_to = max(self.completed_up_to, r)
+        self.total_completed += 1
+        for stale in [x for x in self.started_rounds if x <= r]:
+            self.started_rounds.discard(stale)
+            self.completions.pop(stale, None)
+        return self._fill_window()
+
+    # -- round window --------------------------------------------------------
+
+    def _fill_window(self) -> list[Envelope]:
+        out: list[Envelope] = []
+        while len(self.started_rounds) < self.config.round_window:
+            if (
+                self.config.max_rounds >= 0
+                and self.next_round >= self.config.max_rounds
+            ):
+                break
+            r = self.next_round
+            self.next_round += 1
+            self.started_rounds.add(r)
+            out.extend(
+                Envelope(peer_addr(w), StartAllreduce(r)) for w in self.worker_ids
+            )
+        return out
+
+    @property
+    def is_done(self) -> bool:
+        """All max_rounds rounds completed (only meaningful with max_rounds >= 0)."""
+        return (
+            self.config.max_rounds >= 0
+            and not self._preparing
+            and self.completed_up_to >= self.config.max_rounds - 1
+        )

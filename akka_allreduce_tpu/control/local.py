@@ -1,0 +1,208 @@
+"""In-process router + local N-worker system — the reference's single-JVM dev
+mode (BASELINE.json:7 "4 local JVM workers"; SURVEY.md §5 "Integration").
+
+``LocalRouter`` plays the transport: FIFO delivery between registered handlers,
+with a pluggable drop filter for fault injection (the reference's tests inject
+faults exactly this way — by omitting messages, SURVEY.md §5).
+
+Run as a module for the config-1 throughput demo:
+
+    python -m akka_allreduce_tpu.control.local --nodes 4 --size 1000000 --rounds 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from akka_allreduce_tpu.config import AllreduceConfig
+from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.control.grid_master import GridMaster, dim_worker_id
+from akka_allreduce_tpu.control.node import AllreduceNode
+from akka_allreduce_tpu.protocol import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+)
+
+log = logging.getLogger(__name__)
+
+DropFilter = Callable[[Envelope], bool]
+
+
+class LocalRouter:
+    """FIFO in-process message delivery with fault injection."""
+
+    def __init__(self, drop_filter: DropFilter | None = None) -> None:
+        self._handlers: dict[str, Callable[[Any], list[Envelope]]] = {}
+        self._prefix_handlers: dict[
+            str, Callable[[int, Any], list[Envelope]]
+        ] = {}
+        self._queue: deque[Envelope] = deque()
+        self.drop_filter = drop_filter
+        self.delivered = 0
+        self.dropped = 0
+
+    def register(self, addr: str, handler: Callable[[Any], list[Envelope]]) -> None:
+        self._handlers[addr] = handler
+
+    def register_prefix(
+        self, prefix: str, handler: Callable[[int, Any], list[Envelope]]
+    ) -> None:
+        """Handle every ``prefix:<int>`` address (e.g. all ``worker:N``)."""
+        self._prefix_handlers[prefix] = handler
+
+    def send_all(self, envelopes: list[Envelope]) -> None:
+        for env in envelopes:
+            if self.drop_filter is not None and self.drop_filter(env):
+                self.dropped += 1
+                continue
+            self._queue.append(env)
+
+    def run(self, max_messages: int = 1_000_000) -> int:
+        """Deliver until quiescent; returns messages delivered."""
+        n = 0
+        while self._queue and n < max_messages:
+            env = self._queue.popleft()
+            handler = self._handlers.get(env.dest)
+            if handler is None:
+                prefix, _, suffix = env.dest.rpartition(":")
+                ph = self._prefix_handlers.get(prefix)
+                if ph is not None:
+                    handler = lambda m, _ph=ph, _id=int(suffix): _ph(_id, m)
+            if handler is None:
+                log.warning("no handler for %s; dropping", env.dest)
+                self.dropped += 1
+                continue
+            self.send_all(handler(env.msg))
+            n += 1
+        self.delivered += n
+        return n
+
+
+class LocalAllreduceSystem:
+    """N nodes + grid master + router, fully in-process (dev/test mode)."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        data_sources,
+        data_sinks,
+        config: AllreduceConfig,
+        drop_filter: DropFilter | None = None,
+    ) -> None:
+        assert len(data_sources) == n_nodes and len(data_sinks) == n_nodes
+        self.config = config
+        dims = config.master.dimensions
+        self.master = GridMaster(
+            config.threshold,
+            config.master,
+            config.line_master,
+        )
+        self.router = LocalRouter(drop_filter)
+        self.nodes: dict[int, AllreduceNode] = {}
+        for i in range(n_nodes):
+            self.add_node(i, data_sources[i], data_sinks[i], join=False)
+        self.router.register_prefix("worker", self._route_to_node)
+        self.router.register_prefix("line_master", self.master.handle_for_line)
+
+    def _route_to_node(self, worker_id: int, msg: Any) -> list[Envelope]:
+        dims = self.config.master.dimensions
+        node_id = worker_id // dims
+        node = self.nodes.get(node_id)
+        if node is None:
+            return []  # node left the cluster; transport drops the message
+        return node.handle(worker_id, msg)
+
+    def add_node(self, node_id: int, source, sink, *, join: bool = True) -> None:
+        self.nodes[node_id] = AllreduceNode(
+            node_id,
+            self.config.master.dimensions,
+            source,
+            sink,
+            self.config.metadata,
+            self.config.threshold,
+            self.config.worker,
+        )
+        if join:
+            self.router.send_all(self.master.member_up(node_id))
+
+    def remove_node(self, node_id: int) -> None:
+        self.nodes.pop(node_id, None)
+        self.router.send_all(self.master.member_unreachable(node_id))
+
+    def start(self) -> None:
+        for node_id in sorted(self.nodes):
+            self.router.send_all(self.master.member_up(node_id))
+
+    def run_until_quiescent(self) -> int:
+        return self.router.run()
+
+
+def _main() -> None:
+    parser = argparse.ArgumentParser(description="local N-worker allreduce demo")
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--size", type=int, default=1_000_000)
+    parser.add_argument("--rounds", type=int, default=20)
+    parser.add_argument("--chunk", type=int, default=262_144)
+    parser.add_argument("--dims", type=int, default=1)
+    parser.add_argument("--th", type=float, default=1.0, help="all three thresholds")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    from akka_allreduce_tpu.config import (
+        LineMasterConfig,
+        MasterConfig,
+        MetaDataConfig,
+        ThresholdConfig,
+    )
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(args.th, args.th, args.th),
+        metadata=MetaDataConfig(data_size=args.size, max_chunk_size=args.chunk),
+        line_master=LineMasterConfig(round_window=2, max_rounds=args.rounds),
+        master=MasterConfig(node_num=args.nodes, dimensions=args.dims),
+    )
+
+    rng = np.random.default_rng(0)
+    inputs = [
+        rng.standard_normal(args.size).astype(np.float32)
+        for _ in range(args.nodes)
+    ]
+    flushes: list[list[int]] = [[] for _ in range(args.nodes)]
+
+    def source_for(i):
+        return lambda req: AllReduceInput(inputs[i])
+
+    def sink_for(i):
+        return lambda out: flushes[i].append(out.iteration)
+
+    t0 = time.perf_counter()
+    system = LocalAllreduceSystem(
+        args.nodes,
+        [source_for(i) for i in range(args.nodes)],
+        [sink_for(i) for i in range(args.nodes)],
+        cfg,
+    )
+    system.start()
+    system.run_until_quiescent()
+    dt = time.perf_counter() - t0
+    # a "round" is one collective across ALL nodes; count rounds every node
+    # flushed, not per-node flush events
+    completed = min(len(f) for f in flushes)
+    total_bytes = args.size * 4 * completed
+    print(
+        f"nodes={args.nodes} size={args.size} rounds_completed={completed} "
+        f"(per-node flushes: {[len(f) for f in flushes]}) "
+        f"elapsed={dt:.3f}s allreduce_throughput={total_bytes / dt / 1e6:.1f} MB/s "
+        f"(host engine; the TPU data plane runs this as one XLA collective)"
+    )
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,234 @@
+"""The worker engine: per-round scatter-reduce-allgather state machine.
+
+Host-engine equivalent of the reference's ``AllreduceWorker`` (SURVEY.md §3):
+on ``StartAllreduce`` fetch from the data source, partition into P blocks, chunk
+by ``max_chunk_size``, scatter to peers; on ``ScatterBlock`` accumulate and — at
+the ``th_reduce`` crossing — reduce and broadcast; on ``ReduceBlock`` assemble
+and — at ``th_complete`` — flush to the data sink and report completion
+(SURVEY.md §4.2 call stack).
+
+Round discipline: a bounded out-of-order window absorbs peers running ahead;
+when a *newer* round completes first, older in-flight rounds are abandoned
+(their data is stale for SGD — the same discipline the reference's threshold
+design embodies: never wait for stragglers).
+
+On the TPU path this engine handles only control messages; payload movement
+happens in the XLA collective. The payload-carrying path below is exercised by
+tests, the CPU fallback, and DCN-side movement.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+import numpy as np
+
+from akka_allreduce_tpu.buffers import RoundBuffers, RoundOutOfWindowError
+from akka_allreduce_tpu.config import (
+    MetaDataConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)
+from akka_allreduce_tpu.control.envelope import Envelope, master_addr, peer_addr
+from akka_allreduce_tpu.protocol import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+    CompleteAllreduce,
+    ConfirmPreparation,
+    PrepareAllreduce,
+    ReduceBlock,
+    ScatterBlock,
+    StartAllreduce,
+)
+
+log = logging.getLogger(__name__)
+
+DataSource = Callable[[AllReduceInputRequest], AllReduceInput]
+DataSink = Callable[[AllReduceOutput], None]
+
+
+class AllreduceWorker:
+    """Transport-agnostic worker: feed messages to ``handle``, send what it returns."""
+
+    def __init__(
+        self,
+        data_source: DataSource,
+        data_sink: DataSink,
+        config: WorkerConfig = WorkerConfig(),
+        line_id: int = 0,
+    ) -> None:
+        self.data_source = data_source
+        self.data_sink = data_sink
+        self.config = config
+        self.line_id = line_id
+        # configured state (set by PrepareAllreduce)
+        self.worker_id: int | None = None
+        self.peer_ids: tuple[int, ...] = ()
+        self.config_id: int = -1
+        self.metadata: MetaDataConfig | None = None
+        self.threshold: ThresholdConfig | None = None
+        self.rounds: RoundBuffers | None = None
+        self.completed_rounds = 0
+        self.dropped_messages = 0
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(
+        self, metadata: MetaDataConfig, threshold: ThresholdConfig
+    ) -> None:
+        """Set payload geometry + thresholds (bootstrap, before Prepare)."""
+        self.metadata = metadata
+        self.threshold = threshold
+
+    @property
+    def peer_size(self) -> int:
+        return len(self.peer_ids)
+
+    def _require_ready(self) -> RoundBuffers:
+        if self.rounds is None:
+            raise RuntimeError(
+                "worker not prepared: PrepareAllreduce must precede rounds"
+            )
+        return self.rounds
+
+    # -- message dispatch ----------------------------------------------------
+
+    def handle(self, msg: Any) -> list[Envelope]:
+        if isinstance(msg, PrepareAllreduce):
+            return self._on_prepare(msg)
+        if isinstance(msg, StartAllreduce):
+            return self._on_start(msg)
+        if isinstance(msg, ScatterBlock):
+            return self._on_scatter(msg)
+        if isinstance(msg, ReduceBlock):
+            return self._on_reduce(msg)
+        raise TypeError(f"worker cannot handle {type(msg).__name__}")
+
+    # -- handlers ------------------------------------------------------------
+
+    def _on_prepare(self, msg: PrepareAllreduce) -> list[Envelope]:
+        if self.metadata is None or self.threshold is None:
+            raise RuntimeError("configure(metadata, threshold) before Prepare")
+        self.worker_id = msg.worker_id
+        self.peer_ids = msg.peer_ids
+        self.config_id = msg.config_id
+        self.line_id = msg.line_id
+        self.rounds = RoundBuffers(
+            self.metadata,
+            self.threshold,
+            peer_size=len(msg.peer_ids),
+            window=self.config.round_window,
+        )
+        # resume numbering where the master says (late joiner / re-mesh)
+        self.rounds.completed_up_to = msg.round_num - 1
+        log.info(
+            "worker %s prepared: config=%d peers=%s from round %d",
+            self.worker_id,
+            msg.config_id,
+            msg.peer_ids,
+            msg.round_num,
+        )
+        return [
+            Envelope(
+                master_addr(self.line_id),
+                ConfirmPreparation(msg.config_id, msg.worker_id),
+            )
+        ]
+
+    def _on_start(self, msg: StartAllreduce) -> list[Envelope]:
+        rounds = self._require_ready()
+        r = msg.round_num
+        if not rounds.in_window(r):
+            if r > rounds.completed_up_to + rounds.window:
+                # The master started r, so older rounds are abandoned
+                # cluster-wide: fast-forward instead of wedging forever behind
+                # the window (a lagging worker must rejoin, not retire).
+                rounds.fast_forward(r)
+                log.info(
+                    "worker %s: fast-forwarded to round window ending at %d",
+                    self.worker_id,
+                    r,
+                )
+            else:  # stale round: already completed locally
+                self.dropped_messages += 1
+                return []
+        data = self.data_source(AllReduceInputRequest(r)).data
+        meta = self.metadata
+        assert meta is not None
+        if data.shape != (meta.data_size,):
+            raise ValueError(
+                f"dataSource returned shape {data.shape}, expected ({meta.data_size},)"
+            )
+        out: list[Envelope] = []
+        block = meta.block_size(self.peer_size)
+        n_chunks = meta.chunks_per_block(self.peer_size)
+        # partition my input into one block per peer, chunk each block; the
+        # trailing block may run past data_size -> zero-pad (peers trim on flush)
+        padded = np.zeros(block * self.peer_size, dtype=np.float32)
+        padded[: meta.data_size] = data
+        my_id = self.worker_id
+        assert my_id is not None
+        my_rank = self.peer_ids.index(my_id)
+        for dest_rank, dest_id in enumerate(self.peer_ids):
+            for c in range(n_chunks):
+                lo = dest_rank * block + c * meta.max_chunk_size
+                hi = min(lo + meta.max_chunk_size, (dest_rank + 1) * block)
+                sb = ScatterBlock(padded[lo:hi], my_rank, dest_rank, c, r)
+                if dest_id == my_id:
+                    out.extend(self._on_scatter(sb))  # self-delivery, no wire
+                else:
+                    out.append(Envelope(peer_addr(dest_id), sb))
+        return out
+
+    def _on_scatter(self, msg: ScatterBlock) -> list[Envelope]:
+        rounds = self._require_ready()
+        r = msg.round_num
+        try:
+            buf = rounds.scattered(r)
+        except RoundOutOfWindowError:
+            self.dropped_messages += 1
+            return []
+        crossed = buf.store(msg.value, msg.src_id, msg.chunk_id)
+        if not crossed:
+            return []
+        value, count = buf.reduce(msg.chunk_id)
+        my_rank = self.peer_ids.index(self.worker_id)
+        out: list[Envelope] = []
+        for dest_id in self.peer_ids:
+            rb = ReduceBlock(value, my_rank, 0, msg.chunk_id, r, count)
+            if dest_id == self.worker_id:
+                out.extend(self._on_reduce(rb))
+            else:
+                out.append(Envelope(peer_addr(dest_id), rb))
+        return out
+
+    def _on_reduce(self, msg: ReduceBlock) -> list[Envelope]:
+        rounds = self._require_ready()
+        r = msg.round_num
+        try:
+            buf = rounds.reduced(r)
+        except RoundOutOfWindowError:
+            self.dropped_messages += 1
+            return []
+        buf.store(msg.value, msg.src_id, msg.chunk_id, msg.count)
+        if not buf.reach_completion_threshold():
+            return []
+        data, counts = buf.get_with_counts()
+        rounds.complete(r)  # evicts this round AND abandons older in-flight ones
+        self.completed_rounds += 1
+        self.data_sink(AllReduceOutput(data, counts, r))
+        my_id = self.worker_id
+        assert my_id is not None
+        if (
+            self.config.stats_reporting_round_frequency > 0
+            and self.completed_rounds % self.config.stats_reporting_round_frequency == 0
+        ):
+            log.info(
+                "worker %s: %d rounds complete (dropped=%d)",
+                my_id,
+                self.completed_rounds,
+                self.dropped_messages,
+            )
+        return [Envelope(master_addr(self.line_id), CompleteAllreduce(my_id, r))]

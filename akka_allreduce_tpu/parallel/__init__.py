@@ -1,0 +1,15 @@
+"""Mesh and sharding helpers (the TPU-build's topology layer).
+
+The reference organizes workers into lines and 2D grids over Akka Cluster
+membership (SURVEY.md §3 "Node / dimension actors"); here topology is a
+``jax.sharding.Mesh`` whose axes play the same roles: a 1D ``line`` mesh is one
+line of workers, a 2D ``rows``×``cols`` mesh is the butterfly grid.
+"""
+
+from akka_allreduce_tpu.parallel.mesh import (  # noqa: F401
+    LINE_AXIS,
+    GRID_AXES,
+    grid_factors,
+    grid_mesh,
+    line_mesh,
+)

@@ -1,0 +1,91 @@
+"""Device-mesh construction.
+
+Topology roles (mapping the reference's line/grid organization, SURVEY.md §4.3):
+
+- ``line_mesh(n)``   — one line of n workers; allreduce rides the ``line`` axis.
+- ``grid_mesh(r, c)``— the 2D butterfly grid; a round reduces along ``rows``
+  then ``cols`` (Kylix-style two-stage scatter-reduce).
+
+On real hardware ``jax.make_mesh`` lays devices out so neighboring mesh
+coordinates are ICI neighbors; on the CPU test backend any layout works.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+LINE_AXIS = "line"
+GRID_AXES = ("rows", "cols")
+
+
+def _resolve_devices(
+    num_devices: int | None, devices: Sequence[jax.Device] | None
+) -> list[jax.Device]:
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    return devices
+
+
+def line_mesh(
+    num_devices: int | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axis: str = LINE_AXIS,
+) -> Mesh:
+    """A 1D mesh: one line of workers."""
+    devs = _resolve_devices(num_devices, devices)
+    return jax.make_mesh((len(devs),), (axis,), devices=devs)
+
+
+def grid_factors(n: int) -> tuple[int, int]:
+    """Split n into the most-square (rows, cols) factorization, rows <= cols."""
+    if n <= 0:
+        raise ValueError(f"need a positive device count, got {n}")
+    best = (1, n)
+    for r in range(1, int(math.isqrt(n)) + 1):
+        if n % r == 0:
+            best = (r, n // r)
+    return best
+
+
+def grid_mesh(
+    rows: int | None = None,
+    cols: int | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axes: tuple[str, str] = GRID_AXES,
+) -> Mesh:
+    """A 2D butterfly grid mesh. With no shape given, factors the device count
+    into the most-square grid (16 devices -> 4x4, matching BASELINE.json:8's
+    16-worker butterfly)."""
+    devs = _resolve_devices(
+        rows * cols if rows is not None and cols is not None else None, devices
+    )
+    n = len(devs)
+    if rows is None and cols is None:
+        rows, cols = grid_factors(n)
+        devs = devs[: rows * cols]
+    elif rows is None or cols is None:
+        # honor the given dimension; derive the other from the device count
+        given = rows if rows is not None else cols
+        if n % given == 0:
+            derived = n // given
+        else:
+            raise ValueError(
+                f"{n} devices do not divide into a grid with one side {given}"
+            )
+        rows, cols = (given, derived) if rows is not None else (derived, given)
+    if rows * cols != n:
+        raise ValueError(f"grid {rows}x{cols} != {n} devices")
+    return jax.make_mesh((rows, cols), axes, devices=devs)

@@ -92,6 +92,9 @@ class PrepareAllreduce:
     peer_ids: Sequence[int]
     worker_id: int
     round_num: int
+    # where CompleteAllreduce/ConfirmPreparation go. The reference's workers
+    # reply to the sending actor; explicit handlers need the address spelled out.
+    line_id: int = 0
 
     def __post_init__(self) -> None:
         object.__setattr__(self, "peer_ids", tuple(self.peer_ids))

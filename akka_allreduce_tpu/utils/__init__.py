@@ -1,0 +1,6 @@
+"""Logging, metrics, and timing utilities."""
+
+from akka_allreduce_tpu.utils.metrics import (  # noqa: F401
+    MetricsLogger,
+    RoundMetrics,
+)

@@ -1,0 +1,72 @@
+"""Structured per-round metrics (SURVEY.md §6 "Metrics / logging").
+
+The reference logs periodic throughput lines from workers; here every round
+emits a structured record — round latency, achieved GB/s, contributor count —
+to JSONL. This stream IS the benchmark output for the BASELINE configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import time
+from typing import Any, TextIO
+
+
+@dataclasses.dataclass
+class RoundMetrics:
+    round_num: int
+    latency_s: float
+    data_bytes: int
+    n_devices: int
+    contributors: float  # mean contributor count across chunks
+    schedule: str = "psum"
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def bus_gbps(self) -> float:
+        """Bus bandwidth: 2*(n-1)/n * bytes / t (BASELINE.md measurement rules)."""
+        if self.latency_s <= 0 or self.n_devices <= 0:
+            return 0.0
+        scale = 2.0 * (self.n_devices - 1) / self.n_devices
+        return scale * self.data_bytes / self.latency_s / 1e9
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d.pop("extra")
+        d.update(self.extra)
+        d["bus_gbps"] = self.bus_gbps
+        return json.dumps(d)
+
+
+class MetricsLogger:
+    """Append-only JSONL sink; file path, open stream, or in-memory."""
+
+    def __init__(self, sink: str | TextIO | None = None) -> None:
+        self._own = False
+        if sink is None:
+            self._stream: TextIO = io.StringIO()
+        elif isinstance(sink, str):
+            self._stream = open(sink, "a", buffering=1)
+            self._own = True
+        else:
+            self._stream = sink
+        self.records: list[RoundMetrics] = []
+
+    def log_round(self, m: RoundMetrics) -> None:
+        self.records.append(m)
+        self._stream.write(m.to_json() + "\n")
+
+    def log_event(self, **fields: Any) -> None:
+        fields.setdefault("t", time.time())
+        self._stream.write(json.dumps(fields) + "\n")
+
+    def close(self) -> None:
+        if self._own:
+            self._stream.close()
+
+    def dump(self) -> str:
+        if isinstance(self._stream, io.StringIO):
+            return self._stream.getvalue()
+        return ""

@@ -1,9 +1,12 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax is imported.
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax initializes.
 
 This mirrors the reference's test philosophy (SURVEY.md §5): multi-node behavior is
 tested without any real cluster. Here "multi-node" data-plane tests run on one host
 via ``xla_force_host_platform_device_count=8``; control-plane tests use in-process
 fake peers. Numeric oracle throughout: numpy masked-sum / count.
+
+Note: the axon TPU plugin overrides ``JAX_PLATFORMS`` at import time, so the env
+var alone is not enough — we also update ``jax.config`` before any backend use.
 """
 
 import os
@@ -17,3 +20,7 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

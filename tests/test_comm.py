@@ -1,0 +1,178 @@
+"""Data-plane tests on the 8-device virtual CPU mesh (SURVEY.md §8.3).
+
+Oracle: numpy masked sum / count of the per-device inputs — the same oracle the
+reference's specs use for threshold rounds, minus the actors.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.comm import (
+    measure_allreduce,
+    threshold_allreduce,
+)
+from akka_allreduce_tpu.parallel import grid_factors, grid_mesh, line_mesh
+from akka_allreduce_tpu.utils import MetricsLogger
+
+
+@pytest.fixture(scope="module")
+def line8():
+    return line_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def grid24():
+    return grid_mesh(2, 4)
+
+
+def rand(n, d, seed=0):
+    return np.random.default_rng(seed).standard_normal((n, d)).astype(np.float32)
+
+
+class TestThresholdAllreduce:
+    def test_full_participation_equals_sum(self, line8):
+        xs = rand(8, 1000)
+        res = threshold_allreduce(line8, xs)
+        np.testing.assert_allclose(res.sum, xs.sum(0), rtol=1e-5)
+        assert (np.asarray(res.count) == 8).all()
+        np.testing.assert_allclose(res.average(), xs.mean(0), rtol=1e-5)
+
+    def test_masked_devices_excluded(self, line8):
+        xs = rand(8, 257)  # odd size
+        valid = np.array([1, 1, 0, 1, 0, 1, 1, 1], dtype=np.float32)
+        res = threshold_allreduce(line8, xs, valid)
+        oracle = (xs * valid[:, None]).sum(0)
+        np.testing.assert_allclose(res.sum, oracle, rtol=1e-5)
+        assert (np.asarray(res.count) == 6).all()
+        np.testing.assert_allclose(
+            res.average(), oracle / 6.0, rtol=1e-5
+        )
+
+    def test_per_bucket_masks(self, line8):
+        # data 100, bucket 30 -> 4 buckets (30/30/30/10); device d drops bucket d%4
+        xs = rand(8, 100)
+        valid = np.ones((8, 4), dtype=np.float32)
+        for d in range(8):
+            valid[d, d % 4] = 0.0
+        res = threshold_allreduce(line8, xs, valid, bucket_size=30)
+        counts = np.asarray(res.count)
+        # each bucket dropped by exactly 2 of 8 devices
+        assert (counts == 6).all()
+        oracle = np.zeros(100, np.float32)
+        for d in range(8):
+            mask = np.repeat(valid[d], 30)[:100]
+            oracle += xs[d] * mask
+        np.testing.assert_allclose(res.sum, oracle, rtol=1e-5)
+
+    def test_all_dropped_bucket_reads_zero(self, line8):
+        xs = rand(8, 64)
+        valid = np.ones((8, 2), dtype=np.float32)
+        valid[:, 1] = 0.0  # nobody contributes bucket 1
+        res = threshold_allreduce(line8, xs, valid, bucket_size=32)
+        assert (np.asarray(res.count)[32:] == 0).all()
+        np.testing.assert_allclose(np.asarray(res.average())[32:], 0.0)
+
+    def test_rejects_wrong_shapes(self, line8):
+        with pytest.raises(ValueError):
+            threshold_allreduce(line8, rand(4, 10))  # wrong device count
+
+    def test_caller_array_not_donated(self, line8):
+        # passing an already-sharded device array twice must not hit a
+        # donated/deleted buffer (convenience API never donates)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        xs = jax.device_put(
+            rand(8, 64), NamedSharding(line8, P("line"))
+        )
+        r1 = threshold_allreduce(line8, xs)
+        r2 = threshold_allreduce(line8, xs)  # would raise if xs was donated
+        np.testing.assert_allclose(np.asarray(r1.sum), np.asarray(r2.sum))
+
+    def test_ring_schedule_matches_psum(self, line8):
+        xs = rand(8, 1003)  # not divisible by 8: exercises padding
+        valid = np.array([1, 0, 1, 1, 1, 1, 0, 1], dtype=np.float32)
+        res = threshold_allreduce(line8, xs, valid, schedule="ring")
+        oracle = (xs * valid[:, None]).sum(0)
+        np.testing.assert_allclose(res.sum, oracle, rtol=1e-4, atol=1e-4)
+        assert (np.asarray(res.count) == 6).all()
+
+    def test_butterfly_on_grid_matches_sum(self, grid24):
+        xs = rand(8, 500)
+        valid = np.array([1, 1, 1, 0, 1, 1, 1, 1], dtype=np.float32)
+        res = threshold_allreduce(grid24, xs, valid, schedule="butterfly")
+        oracle = (xs * valid[:, None]).sum(0)
+        # staged psums reassociate fp32 sums; allow absolute slack near zero
+        np.testing.assert_allclose(res.sum, oracle, rtol=1e-5, atol=1e-6)
+        assert (np.asarray(res.count) == 7).all()
+
+    def test_butterfly_requires_grid(self, line8):
+        with pytest.raises(ValueError):
+            threshold_allreduce(line8, rand(8, 16), schedule="butterfly")
+
+    def test_partial_axis_reduce_rejected_at_host_api(self, grid24):
+        # partial-axis reduction leaves the output unreplicated; the host API
+        # refuses it (masked_psum inside shard_map is the supported route)
+        with pytest.raises(ValueError, match="full mesh"):
+            threshold_allreduce(grid24, rand(8, 20), axes="rows")
+
+    def test_masked_psum_partial_axis_inside_shard_map(self, grid24):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from akka_allreduce_tpu.comm import masked_psum
+
+        xs = rand(8, 20)
+
+        def kernel(x):
+            s, c = masked_psum(x.reshape(-1), jnp.float32(1.0), "rows")
+            return s[None], c[None]
+
+        f = jax.shard_map(
+            kernel,
+            mesh=grid24,
+            in_specs=P(("rows", "cols")),
+            out_specs=(P("cols"), P("cols")),
+        )
+        with jax.set_mesh(grid24):
+            sums, counts = f(xs)
+        # grid (2,4): device (r, c) holds row-sum of column c
+        sums = np.asarray(sums)
+        assert sums.shape == (4, 20)
+        for c in range(4):
+            np.testing.assert_allclose(
+                sums[c], xs[c] + xs[4 + c], rtol=1e-5
+            )
+        assert (np.asarray(counts) == 2).all()
+
+
+class TestBandwidthHarness:
+    def test_measure_reports_and_logs(self, line8):
+        logger = MetricsLogger()
+        rep = measure_allreduce(
+            line8, 4096, iters=3, warmup=1, logger=logger
+        )
+        assert rep.n_devices == 8
+        assert rep.bus_gbps_best > 0
+        lines = logger.dump().strip().splitlines()
+        assert len(lines) == 3
+        import json
+
+        rec = json.loads(lines[0])
+        assert rec["n_devices"] == 8 and rec["bus_gbps"] > 0
+
+
+class TestMeshHelpers:
+    def test_grid_factors(self):
+        assert grid_factors(16) == (4, 4)
+        assert grid_factors(8) == (2, 4)
+        assert grid_factors(7) == (1, 7)
+
+    def test_line_mesh_subset(self):
+        m = line_mesh(4)
+        assert m.shape == {"line": 4}
+
+    def test_grid_mesh_auto(self):
+        m = grid_mesh(devices=jax.devices()[:8])
+        assert m.shape == {"rows": 2, "cols": 4}

@@ -74,3 +74,8 @@ class TestAllreduceConfig:
         cfg = AllreduceConfig.from_json('{"threshold": {"th_reduce": 0.5}}')
         assert cfg.threshold.th_reduce == 0.5
         assert cfg.metadata.data_size == 1_048_576
+
+    def test_unknown_section_rejected(self):
+        # a typo must not silently revert thresholds to full completion
+        with pytest.raises(ValueError, match="thresholds"):
+            AllreduceConfig.from_json('{"thresholds": {"th_reduce": 0.5}}')

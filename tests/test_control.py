@@ -1,0 +1,326 @@
+"""Control-plane tests in the reference's style (SURVEY.md §5): one real
+handler wired to fake peers; deliver messages by hand; assert exact emissions.
+Threshold/fault cases are expressed as message omission; the local system tests
+are the single-process integration fixture ("4 local workers")."""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    LineMasterConfig,
+    MasterConfig,
+    MetaDataConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)
+from akka_allreduce_tpu.control import (
+    AllreduceWorker,
+    GridMaster,
+    LineMaster,
+    LocalAllreduceSystem,
+)
+from akka_allreduce_tpu.control.envelope import master_addr, peer_addr
+from akka_allreduce_tpu.protocol import (
+    AllReduceInput,
+    CompleteAllreduce,
+    ConfirmPreparation,
+    PrepareAllreduce,
+    ReduceBlock,
+    ScatterBlock,
+    StartAllreduce,
+)
+
+
+def make_worker(data, sink_log, th=ThresholdConfig(), chunk=8, window=4):
+    w = AllreduceWorker(
+        data_source=lambda req: AllReduceInput(data),
+        data_sink=sink_log.append,
+        config=WorkerConfig(round_window=window),
+    )
+    w.configure(MetaDataConfig(data_size=len(data), max_chunk_size=chunk), th)
+    return w
+
+
+class TestWorkerSpec:
+    """The AllreduceWorkerSpec equivalent — fake peers, hand-fed messages."""
+
+    def test_prepare_confirms(self):
+        w = make_worker(np.zeros(32, np.float32), [])
+        out = w.handle(PrepareAllreduce(7, (0, 1, 2, 3), worker_id=1, round_num=0))
+        assert len(out) == 1
+        assert out[0].dest == master_addr(0)
+        assert out[0].msg == ConfirmPreparation(7, 1)
+        assert w.peer_size == 4
+
+    def test_start_scatters_blocks_to_peers(self):
+        data = np.arange(32, dtype=np.float32)
+        w = make_worker(data, [])
+        w.handle(PrepareAllreduce(1, (0, 1, 2, 3), worker_id=1, round_num=0))
+        out = w.handle(StartAllreduce(0))
+        # block=8, chunk=8 -> 1 chunk per peer; self-delivery is internal, so
+        # 3 ScatterBlocks go out (self contribution may cascade no further yet)
+        scatters = [e for e in out if isinstance(e.msg, ScatterBlock)]
+        assert len(scatters) == 3
+        dests = {e.dest for e in scatters}
+        assert dests == {peer_addr(0), peer_addr(2), peer_addr(3)}
+        for e in scatters:
+            dest_rank = int(e.dest.split(":")[1])
+            np.testing.assert_allclose(
+                e.msg.value, data[dest_rank * 8 : dest_rank * 8 + 8]
+            )
+            assert e.msg.src_id == 1 and e.msg.round_num == 0
+
+    def test_reduce_broadcast_at_threshold(self):
+        # th_reduce=0.5 of 4 peers -> reduce once 2 contributions arrive
+        data = np.ones(32, np.float32)
+        w = make_worker(data, [], th=ThresholdConfig(th_reduce=0.5))
+        w.handle(PrepareAllreduce(1, (0, 1, 2, 3), worker_id=1, round_num=0))
+        out1 = w.handle(ScatterBlock(np.full(8, 2.0, np.float32), 0, 1, 0, 0))
+        assert not [e for e in out1 if isinstance(e.msg, ReduceBlock)]
+        out2 = w.handle(ScatterBlock(np.full(8, 3.0, np.float32), 2, 1, 0, 0))
+        reduces = [e for e in out2 if isinstance(e.msg, ReduceBlock)]
+        # broadcast to the 3 remote peers (self-delivery internal)
+        assert len(reduces) == 3
+        for e in reduces:
+            np.testing.assert_allclose(e.msg.value, np.full(8, 5.0))
+            assert e.msg.count == 2 and e.msg.src_id == 1
+
+    def test_completion_flushes_sink_and_reports(self):
+        data = np.ones(32, np.float32)
+        sink = []
+        # th_complete=0.5: 2 of 4 blocks suffice
+        w = make_worker(data, sink, th=ThresholdConfig(th_complete=0.5))
+        w.handle(PrepareAllreduce(1, (0, 1, 2, 3), worker_id=1, round_num=0))
+        w.handle(ReduceBlock(np.full(8, 4.0, np.float32), 0, 1, 0, 0, count=4))
+        assert not sink
+        out = w.handle(ReduceBlock(np.full(8, 6.0, np.float32), 2, 1, 0, 0, count=3))
+        assert len(sink) == 1
+        flushed = sink[0]
+        np.testing.assert_allclose(flushed.data[0:8], 4.0)
+        np.testing.assert_allclose(flushed.data[16:24], 6.0)
+        assert flushed.count[0] == 4 and flushed.count[16] == 3
+        assert flushed.count[8] == 0  # omitted block
+        completes = [e for e in out if isinstance(e.msg, CompleteAllreduce)]
+        assert len(completes) == 1
+        assert completes[0].msg == CompleteAllreduce(1, 0)
+        assert completes[0].dest == master_addr(0)
+
+    def test_stale_round_messages_dropped(self):
+        data = np.ones(32, np.float32)
+        sink = []
+        w = make_worker(data, sink, th=ThresholdConfig(th_complete=0.25))
+        w.handle(PrepareAllreduce(1, (0, 1, 2, 3), worker_id=1, round_num=0))
+        w.handle(ReduceBlock(np.ones(8, np.float32), 0, 1, 0, 0, count=4))
+        assert len(sink) == 1  # round 0 flushed at th_complete=0.25
+        dropped_before = w.dropped_messages
+        out = w.handle(ScatterBlock(np.ones(8, np.float32), 0, 1, 0, 0))
+        assert out == [] and w.dropped_messages == dropped_before + 1
+
+    def test_unprepared_worker_rejects_rounds(self):
+        w = make_worker(np.ones(8, np.float32), [])
+        with pytest.raises(RuntimeError, match="not prepared"):
+            w.handle(StartAllreduce(0))
+
+    def test_lagging_worker_fast_forwards_on_start(self):
+        # a worker that missed rounds 0..9 must rejoin when the master starts
+        # round 10, not drop StartAllreduce forever
+        data = np.ones(32, np.float32)
+        w = make_worker(data, [], window=4)
+        w.handle(PrepareAllreduce(1, (0, 1, 2, 3), worker_id=1, round_num=0))
+        out = w.handle(StartAllreduce(10))
+        scatters = [e for e in out if isinstance(e.msg, ScatterBlock)]
+        assert len(scatters) == 3  # participating again
+        assert w.rounds.in_window(10)
+        # stale rounds are really gone
+        assert not w.rounds.in_window(5)
+
+
+class TestLineMaster:
+    def make(self, th=1.0, window=2, max_rounds=-1, n=4):
+        lm = LineMaster(
+            ThresholdConfig(th_allreduce=th),
+            LineMasterConfig(round_window=window, max_rounds=max_rounds),
+        )
+        envs = lm.prepare(tuple(range(n)), config_id=1, from_round=0)
+        return lm, envs
+
+    def confirm_all(self, lm, n=4):
+        out = []
+        for w in range(n):
+            out = lm.handle(ConfirmPreparation(1, w))
+        return out
+
+    def test_prepare_then_confirm_opens_window(self):
+        lm, envs = self.make(window=2)
+        assert len(envs) == 4
+        assert all(isinstance(e.msg, PrepareAllreduce) for e in envs)
+        out = self.confirm_all(lm)
+        starts = [e for e in out if isinstance(e.msg, StartAllreduce)]
+        # 2 rounds x 4 workers
+        assert len(starts) == 8
+        assert {e.msg.round_num for e in starts} == {0, 1}
+
+    def test_partial_confirm_does_not_start(self):
+        lm, _ = self.make()
+        assert lm.handle(ConfirmPreparation(1, 0)) == []
+        assert lm.handle(ConfirmPreparation(1, 1)) == []
+
+    def test_threshold_completion_advances_window(self):
+        lm, _ = self.make(th=0.75, window=1)  # trigger at 3 of 4
+        self.confirm_all(lm)
+        assert lm.handle(CompleteAllreduce(0, 0)) == []
+        assert lm.handle(CompleteAllreduce(1, 0)) == []
+        out = lm.handle(CompleteAllreduce(2, 0))  # 3rd completion
+        starts = [e for e in out if isinstance(e.msg, StartAllreduce)]
+        assert {e.msg.round_num for e in starts} == {1}
+        # straggler's late completion for round 0 is ignored
+        assert lm.handle(CompleteAllreduce(3, 0)) == []
+
+    def test_newer_round_abandons_older(self):
+        lm, _ = self.make(th=0.5, window=2)  # trigger at 2
+        self.confirm_all(lm)
+        lm.handle(CompleteAllreduce(0, 1))
+        out = lm.handle(CompleteAllreduce(1, 1))  # round 1 completes first
+        assert lm.completed_up_to == 1
+        # round 0 was abandoned; late completions ignored
+        assert lm.handle(CompleteAllreduce(2, 0)) == []
+        starts = [e for e in out if isinstance(e.msg, StartAllreduce)]
+        assert {e.msg.round_num for e in starts} == {2, 3}
+
+    def test_max_rounds_is_done(self):
+        lm, _ = self.make(th=1.0, window=2, max_rounds=2)
+        self.confirm_all(lm)
+        for r in range(2):
+            for w in range(4):
+                lm.handle(CompleteAllreduce(w, r))
+        assert lm.is_done
+        assert lm.next_round == 2
+
+    def test_duplicate_completion_not_double_counted(self):
+        lm, _ = self.make(th=0.5)
+        self.confirm_all(lm)
+        lm.handle(CompleteAllreduce(0, 0))
+        assert lm.handle(CompleteAllreduce(0, 0)) == []  # same worker again
+        assert lm.completed_up_to == -1
+
+
+class TestGridMaster:
+    def test_organizes_at_node_num(self):
+        gm = GridMaster(ThresholdConfig(), MasterConfig(node_num=3))
+        assert gm.member_up(0) == []
+        assert gm.member_up(1) == []
+        envs = gm.member_up(2)
+        assert gm.organized and gm.config_id == 1
+        prepares = [e for e in envs if isinstance(e.msg, PrepareAllreduce)]
+        assert len(prepares) == 3
+        assert {e.msg.worker_id for e in prepares} == {0, 1, 2}
+
+    def test_unreachable_reorganizes_with_config_bump(self):
+        gm = GridMaster(ThresholdConfig(), MasterConfig(node_num=3))
+        for n in range(3):
+            gm.member_up(n)
+        envs = gm.member_unreachable(1)
+        assert gm.config_id == 2
+        prepares = [e.msg for e in envs if isinstance(e.msg, PrepareAllreduce)]
+        assert {p.worker_id for p in prepares} == {0, 2}
+        assert all(p.peer_ids == (0, 2) for p in prepares)
+
+    def test_late_joiner_reorganizes(self):
+        gm = GridMaster(ThresholdConfig(), MasterConfig(node_num=2))
+        gm.member_up(0), gm.member_up(1)
+        envs = gm.member_up(5)
+        prepares = [e.msg for e in envs if isinstance(e.msg, PrepareAllreduce)]
+        assert {p.worker_id for p in prepares} == {0, 1, 5}
+
+    def test_2d_grid_makes_row_and_col_lines(self):
+        gm = GridMaster(
+            ThresholdConfig(), MasterConfig(node_num=4, dimensions=2)
+        )
+        envs = []
+        for n in range(4):
+            envs = gm.member_up(n)
+        # 2x2 grid -> 2 row lines (dim 0) + 2 col lines (dim 1)
+        assert len(gm.line_masters) == 4
+        prepares = [e.msg for e in envs if isinstance(e.msg, PrepareAllreduce)]
+        assert len(prepares) == 8  # each node appears in one row + one col line
+        # dim-0 worker ids are even (node*2+0), dim-1 odd
+        dim0 = {p.worker_id for p in prepares if p.worker_id % 2 == 0}
+        dim1 = {p.worker_id for p in prepares if p.worker_id % 2 == 1}
+        assert dim0 == {0, 2, 4, 6} and dim1 == {1, 3, 5, 7}
+
+
+def run_local(n_nodes, size, rounds, th=1.0, dims=1, chunk=16, drop_filter=None,
+              seed=0):
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(th, th, th),
+        metadata=MetaDataConfig(data_size=size, max_chunk_size=chunk),
+        line_master=LineMasterConfig(round_window=2, max_rounds=rounds),
+        master=MasterConfig(node_num=n_nodes, dimensions=dims),
+    )
+    rng = np.random.default_rng(seed)
+    inputs = [rng.standard_normal(size).astype(np.float32) for _ in range(n_nodes)]
+    sinks: dict[int, list] = {i: [] for i in range(n_nodes)}
+
+    def src(i):
+        return lambda req: AllReduceInput(inputs[i])
+
+    def snk(i):
+        return sinks[i].append
+
+    system = LocalAllreduceSystem(
+        n_nodes,
+        [src(i) for i in range(n_nodes)],
+        [snk(i) for i in range(n_nodes)],
+        cfg,
+        drop_filter=drop_filter,
+    )
+    system.start()
+    system.run_until_quiescent()
+    return inputs, sinks, system
+
+
+class TestLocalSystemEndToEnd:
+    def test_four_local_workers_exact_sum(self):
+        # BASELINE config 1 shape: full participation -> exact sums every round
+        inputs, sinks, system = run_local(4, size=100, rounds=5)
+        oracle = np.sum(inputs, axis=0)
+        for i in range(4):
+            assert len(sinks[i]) == 5
+            for out in sinks[i]:
+                np.testing.assert_allclose(out.data, oracle, rtol=1e-5)
+                assert (out.count == 4).all()
+        assert system.master.is_done
+
+    def test_dropped_worker_rounds_still_complete(self):
+        # drop EVERY payload message from node 3's worker; thresholds 0.75
+        def drop(env):
+            return (
+                hasattr(env.msg, "src_id")
+                and getattr(env.msg, "src_id", None) == 3
+                and not isinstance(env.msg, CompleteAllreduce)
+            )
+
+        inputs, sinks, system = run_local(
+            4, size=64, rounds=4, th=0.75, drop_filter=drop
+        )
+        oracle = np.sum(inputs[:3], axis=0)  # node 3 never contributes
+        for i in range(3):
+            assert len(sinks[i]) == 4, f"node {i} missed rounds"
+            for out in sinks[i]:
+                # blocks owned by live workers carry the 3-contributor sum
+                live = out.count > 0
+                np.testing.assert_allclose(
+                    out.data[live], oracle[live], rtol=1e-4, atol=1e-5
+                )
+                assert set(np.unique(out.count[live])) <= {3}
+        assert system.master.is_done
+
+    def test_butterfly_2d_equals_total_sum(self):
+        inputs, sinks, system = run_local(4, size=48, rounds=3, dims=2, chunk=8)
+        oracle = np.sum(inputs, axis=0)
+        for i in range(4):
+            assert len(sinks[i]) == 3, f"node {i}: {len(sinks[i])} rounds"
+            for out in sinks[i]:
+                np.testing.assert_allclose(out.data, oracle, rtol=1e-4, atol=1e-5)
+                assert (out.count == 4).all()
