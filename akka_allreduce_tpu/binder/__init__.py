@@ -1,0 +1,22 @@
+"""The ML integration seam — the reference's ``AllreduceBinder`` (SURVEY.md §3).
+
+A binder adapts a learner to the allreduce engine's pull/push API:
+``data_source(AllReduceInputRequest) -> AllReduceInput`` supplies the flat
+float payload for a round; ``data_sink(AllReduceOutput)`` consumes the reduced
+sums + contributor counts. Two modes, as in the reference:
+
+- gradient sync: the payload is the current gradient; the sink applies the
+  partial average to the optimizer (on TPU this usually collapses into an
+  in-step ``psum`` — see ``train.DPTrainer`` — but the binder form works
+  against the host engine too, for DCN/CPU deployments).
+- elastic averaging (the reference's BIDMach mode): the payload is the model
+  weights; the sink moves local weights toward the group average:
+  ``w <- (1 - alpha) * w + alpha * (sum / count)``.
+"""
+
+from akka_allreduce_tpu.binder.api import (  # noqa: F401
+    AllreduceBinder,
+    flatten_pytree,
+)
+from akka_allreduce_tpu.binder.elastic import ElasticAverageBinder  # noqa: F401
+from akka_allreduce_tpu.binder.grad_sync import GradSyncBinder  # noqa: F401

@@ -1,0 +1,43 @@
+"""Binder protocol + pytree<->flat-buffer helpers."""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import jax
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from akka_allreduce_tpu.protocol import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+)
+
+
+class AllreduceBinder(Protocol):
+    """What a worker needs from the ML side (reference ``AllreduceBinder``)."""
+
+    def data_source(self, req: AllReduceInputRequest) -> AllReduceInput: ...
+
+    def data_sink(self, out: AllReduceOutput) -> None: ...
+
+    @property
+    def data_size(self) -> int: ...
+
+
+def flatten_pytree(tree) -> tuple[np.ndarray, Callable]:
+    """Flatten a (params/grads) pytree to a host fp32 vector + unflattener.
+
+    The reference's binder flattens BIDMach matrices to ``Array[Float]`` with a
+    GPU->host copy (SURVEY.md §4.4); this is the same seam. On the pure-TPU
+    grad-sync path this host hop never happens (psum in-step); the flat form is
+    for the host engine / elastic mode / checkpoints.
+    """
+    flat, unravel = ravel_pytree(tree)
+    host = np.asarray(jax.device_get(flat), dtype=np.float32)
+
+    def unflatten(vec: np.ndarray):
+        return unravel(vec.astype(np.float32))
+
+    return host, unflatten

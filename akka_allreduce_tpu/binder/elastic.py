@@ -1,0 +1,52 @@
+"""Elastic-averaging weight binder (the reference's ``ElasticAverageBinder``,
+SURVEY.md §3): per round, contribute current weights; on output move local
+weights toward the group's partial average by ``elastic_rate``:
+
+    w <- (1 - a) * w + a * (sum / count)     where count > 0
+
+Elements nobody contributed (count 0 under thresholds) leave the local weight
+untouched — the straggler-tolerance contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from akka_allreduce_tpu.protocol import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+)
+
+
+class ElasticAverageBinder:
+    def __init__(
+        self,
+        get_weights: Callable[[], np.ndarray],
+        set_weights: Callable[[np.ndarray], None],
+        elastic_rate: float = 0.5,
+    ) -> None:
+        if not 0.0 < elastic_rate <= 1.0:
+            raise ValueError(f"elastic_rate must be in (0, 1], got {elastic_rate}")
+        self.get_weights = get_weights
+        self.set_weights = set_weights
+        self.elastic_rate = elastic_rate
+        self.rounds_applied = 0
+
+    @property
+    def data_size(self) -> int:
+        return int(self.get_weights().shape[0])
+
+    def data_source(self, req: AllReduceInputRequest) -> AllReduceInput:
+        return AllReduceInput(self.get_weights())
+
+    def data_sink(self, out: AllReduceOutput) -> None:
+        w = self.get_weights().astype(np.float32)
+        contributed = out.count > 0
+        avg = out.average()
+        a = self.elastic_rate
+        w = np.where(contributed, (1.0 - a) * w + a * avg, w)
+        self.set_weights(w)
+        self.rounds_applied += 1

@@ -1,0 +1,47 @@
+"""Gradient-sync binder: per round, contribute the latest local gradient; on
+output apply the partial-average gradient through a caller-supplied applier
+(optimizer step). This is the host-engine form of the reference's grad-sync
+configs (BASELINE.json:9-10); the pure-TPU form is the in-step masked psum in
+``train.DPTrainer`` (same semantics, zero host hops)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from akka_allreduce_tpu.protocol import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+)
+
+
+class GradSyncBinder:
+    def __init__(
+        self,
+        get_gradient: Callable[[int], np.ndarray],
+        apply_average: Callable[[np.ndarray, np.ndarray], None],
+        data_size: int | None = None,
+    ) -> None:
+        """``get_gradient(round) -> flat grad``; ``apply_average(avg, counts)``
+        applies the partial-average gradient (elements with count 0 are zero).
+        ``data_size`` sizes the engine's round buffers; when omitted it is
+        probed from ``get_gradient(0)``."""
+        self.get_gradient = get_gradient
+        self.apply_average = apply_average
+        self._data_size = data_size
+        self.rounds_applied = 0
+
+    @property
+    def data_size(self) -> int:
+        if self._data_size is None:
+            self._data_size = int(self.get_gradient(0).shape[0])
+        return self._data_size
+
+    def data_source(self, req: AllReduceInputRequest) -> AllReduceInput:
+        return AllReduceInput(self.get_gradient(req.iteration))
+
+    def data_sink(self, out: AllReduceOutput) -> None:
+        self.apply_average(out.average(), out.count)
+        self.rounds_applied += 1

@@ -1,0 +1,11 @@
+"""Model families for the benchmark workloads (BASELINE.json:9-10).
+
+The reference trains BIDMach learners (MLP on MNIST; ResNet-50 gradient sync,
+SURVEY.md §2 L5). Here the equivalents are flax modules designed TPU-first:
+NHWC layouts, bfloat16-friendly compute with fp32 parameters, and
+normalization that is pure-functional under SPMD.
+"""
+
+from akka_allreduce_tpu.models.mlp import MLP  # noqa: F401
+from akka_allreduce_tpu.models.resnet import ResNet50, ResNet  # noqa: F401
+from akka_allreduce_tpu.models import data  # noqa: F401

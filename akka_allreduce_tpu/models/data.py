@@ -1,0 +1,56 @@
+"""Synthetic dataset generators (no network access in this environment).
+
+Deterministic, learnable streams shaped like the benchmark datasets: each class
+has a fixed random template; samples are template + noise, so a correct DP
+trainer demonstrably reduces loss and the multi-device run can be compared
+step-for-step against a single-device oracle on identical batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SyntheticClassification:
+    """Class-template + Gaussian-noise stream with a fixed seed."""
+
+    def __init__(
+        self,
+        input_shape: tuple[int, ...],
+        classes: int,
+        *,
+        noise: float = 0.5,
+        seed: int = 0,
+    ) -> None:
+        self.input_shape = input_shape
+        self.classes = classes
+        self.noise = noise
+        rng = np.random.default_rng(seed)
+        self.templates = rng.standard_normal(
+            (classes, *input_shape), dtype=np.float32
+        )
+        self._seed = seed
+
+    def batches(self, batch_size: int, steps: int, *, seed_offset: int = 1):
+        """Yield ``steps`` batches of (images, labels), deterministically."""
+        rng = np.random.default_rng(self._seed + seed_offset)
+        for _ in range(steps):
+            labels = rng.integers(0, self.classes, size=batch_size)
+            noise = rng.standard_normal(
+                (batch_size, *self.input_shape), dtype=np.float32
+            )
+            images = self.templates[labels] + self.noise * noise
+            yield images, labels.astype(np.int32)
+
+
+def mnist_like(seed: int = 0) -> SyntheticClassification:
+    """28x28x1, 10 classes — the MLP/MNIST workload shape (BASELINE.json:9)."""
+    return SyntheticClassification((28, 28, 1), 10, seed=seed)
+
+
+def imagenet_like(
+    size: int = 64, classes: int = 1000, seed: int = 0
+) -> SyntheticClassification:
+    """NHWC images for the ResNet-50 workload (reduced spatial size by default
+    so tests and the single-chip bench stay fast; 224 for full-fidelity runs)."""
+    return SyntheticClassification((size, size, 3), classes, seed=seed)

@@ -1,0 +1,27 @@
+"""MLP for the MNIST data-parallel SGD workload (BASELINE.json:9,
+SURVEY.md §4.4). Matmul-shaped for the MXU: wide dense layers, bf16 compute
+with fp32 params when ``compute_dtype`` says so."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """784 -> hidden... -> classes, ReLU, optional bf16 compute."""
+
+    hidden: Sequence[int] = (512, 512)
+    classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        for width in self.hidden:
+            x = nn.Dense(width, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)  # logits in fp32 for a stable softmax

@@ -1,0 +1,102 @@
+"""ResNet-50 for the gradient-sync workload (BASELINE.json:10: 25M-param
+chunked buffer, ring schedule).
+
+TPU-first choices:
+- NHWC layout (XLA's native conv layout on TPU).
+- GroupNorm instead of BatchNorm: normalization is then a pure function of the
+  batch shard, so the train step stays stateless under ``shard_map`` and no
+  cross-device statistics sync competes with the gradient collective. Param
+  count stays ~25.6M, matching the reference workload's buffer size.
+- bf16 compute / fp32 params when ``compute_dtype=jnp.bfloat16`` (MXU-friendly).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    groups: int = 32
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(
+            self.features, (1, 1), use_bias=False, dtype=self.compute_dtype
+        )(x)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features,
+            (3, 3),
+            strides=(self.strides, self.strides),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.compute_dtype,
+        )(y)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features * 4, (1, 1), use_bias=False, dtype=self.compute_dtype
+        )(y)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.features * 4))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features * 4,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                use_bias=False,
+                dtype=self.compute_dtype,
+            )(residual)
+            residual = nn.GroupNorm(
+                num_groups=min(self.groups, self.features * 4)
+            )(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Bottleneck ResNet; stage_sizes (3,4,6,3) is ResNet-50."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    classes: int = 1000
+    width: int = 64
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(
+            self.width,
+            (7, 7),
+            strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=self.compute_dtype,
+        )(x)
+        x = nn.GroupNorm(num_groups=min(32, self.width))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2**stage)
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(
+                    features,
+                    strides=strides,
+                    compute_dtype=self.compute_dtype,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.classes, dtype=jnp.float32)(x)
+        return x
+
+
+def ResNet50(classes: int = 1000, compute_dtype=jnp.float32) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3), classes=classes, compute_dtype=compute_dtype
+    )

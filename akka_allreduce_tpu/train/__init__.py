@@ -1,0 +1,3 @@
+"""Data-parallel training on the ICI data plane (SURVEY.md §8.1 step 4)."""
+
+from akka_allreduce_tpu.train.trainer import DPTrainer, TrainStepMetrics  # noqa: F401

@@ -1,0 +1,224 @@
+"""DP trainer: per-step threshold-masked gradient allreduce inside one jitted
+SPMD step (the pure-TPU form of the reference's grad-sync configs,
+BASELINE.json:9-10 / SURVEY.md §4.4).
+
+Design: batch sharded over the mesh's data axes, params/optimizer state
+replicated; forward + backward run per device; the gradient pytree is
+flattened and goes through ONE fused masked psum (optionally bucketed at
+``max_chunk_size`` granularity — the reference's chunked buffer); the
+optimizer applies the partial-average gradient. Invalid devices (mask 0) still
+compute — XLA collectives are all-or-nothing — but contribute nothing, exactly
+the threshold-contribution semantics of SURVEY.md §8.1 step 3.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.binder.api import flatten_pytree
+from akka_allreduce_tpu.comm.allreduce import expand_counts, masked_psum
+
+
+@dataclasses.dataclass
+class TrainStepMetrics:
+    step: int
+    loss: float
+    contributors: float
+
+
+class DPTrainer:
+    """Data-parallel trainer over every axis of ``mesh``.
+
+    Args:
+      model: a flax module with ``init``/``apply``.
+      mesh: device mesh; the batch is sharded across ALL its axes jointly
+        (a 2D mesh gives the butterfly-grid layout of BASELINE.json:8).
+      example_input: one device's worth of input used for ``init``.
+      optimizer: optax transform (default: SGD).
+      bucket_size: gradient bucket size in elements (None = single fused psum).
+    """
+
+    def __init__(
+        self,
+        model,
+        mesh: Mesh,
+        example_input: np.ndarray,
+        *,
+        optimizer: optax.GradientTransformation | None = None,
+        learning_rate: float = 0.1,
+        bucket_size: int | None = None,
+        loss_fn: Callable | None = None,
+        seed: int = 0,
+    ) -> None:
+        self.model = model
+        self.mesh = mesh
+        self.axis_names = tuple(mesh.axis_names)
+        self.n_devices = int(np.prod([mesh.shape[a] for a in self.axis_names]))
+        self.tx = optimizer or optax.sgd(learning_rate)
+        self.bucket_size = bucket_size
+        self._loss = loss_fn or (
+            lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+        )
+
+        key = jax.random.PRNGKey(seed)
+        self.params = model.init(key, jnp.asarray(example_input))
+        self.opt_state = self.tx.init(self.params)
+        self.param_count = int(
+            sum(np.prod(p.shape) for p in jax.tree.leaves(self.params))
+        )
+        self.step_num = 0
+
+        data_spec = P(
+            self.axis_names if len(self.axis_names) > 1 else self.axis_names[0]
+        )
+        self._data_sharding = NamedSharding(mesh, data_spec)
+        self._replicated = NamedSharding(mesh, P())
+        axis_names = self.axis_names
+        bucket = bucket_size
+        model_apply = model.apply
+        loss_impl = self._loss
+        tx = self.tx
+
+        def step(params, opt_state, x, y, valid):
+            v = valid.reshape(())
+            scalar_cnt = lax.psum(v, axis_names)
+            denom = jnp.maximum(scalar_cnt, 1.0)
+
+            if bucket is None:
+                # Differentiating the v-weighted local loss w.r.t. REPLICATED
+                # params makes JAX's shard_map autodiff insert the cross-device
+                # psum itself (the transpose of the params broadcast), so the
+                # gradient that comes back is already sum_d(v_d * g_d) in ONE
+                # fused collective — the masked allreduce with zero extra code.
+                def global_masked_loss(p):
+                    logits = model_apply(p, x)
+                    return loss_impl(logits, y) * v
+
+                lsum, gsum_tree = jax.value_and_grad(global_masked_loss)(params)
+                gavg = jax.tree.map(lambda g: g / denom, gsum_tree)
+                loss_avg = lax.psum(lsum, axis_names) / denom
+            else:
+                # Explicit bucketed path (the reference's chunked buffer): make
+                # params device-varying first so grads stay LOCAL (no implicit
+                # psum), then run the bucketed masked collective ourselves.
+                params_local = jax.tree.map(
+                    lambda p: lax.pcast(p, axis_names, to="varying"), params
+                )
+
+                def local_loss(p):
+                    logits = model_apply(p, x)
+                    return loss_impl(logits, y)
+
+                loss, grads = jax.value_and_grad(local_loss)(params_local)
+                flat, unravel = ravel_pytree(grads)
+                n_buckets = -(-flat.shape[0] // bucket)
+                gsum, cnt = masked_psum(
+                    flat,
+                    jnp.full((n_buckets,), v),
+                    axis_names,
+                    bucket_size=bucket,
+                )
+                denom_el = jnp.maximum(
+                    expand_counts(cnt, flat.shape[0], bucket), 1.0
+                )
+                gavg = unravel(gsum / denom_el)
+                loss_avg = lax.psum(loss * v, axis_names) / denom
+
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, loss_avg, scalar_cnt
+
+        mapped = jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P(), data_spec, data_spec, data_spec),
+            out_specs=(P(), P(), P(), P()),
+        )
+        self._step = jax.jit(mapped, donate_argnums=(0, 1))
+
+        def eval_correct(params, x, y):
+            logits = model_apply(params, x)
+            hits = jnp.sum(jnp.argmax(logits, -1) == y)
+            return lax.psum(hits, axis_names)
+
+        self._eval = jax.jit(
+            jax.shard_map(
+                eval_correct,
+                mesh=mesh,
+                in_specs=(P(), data_spec, data_spec),
+                out_specs=P(),
+            )
+        )
+
+    # -- stepping ------------------------------------------------------------
+
+    def _place_batch(self, x, y):
+        if x.shape[0] % self.n_devices:
+            raise ValueError(
+                f"global batch {x.shape[0]} not divisible by {self.n_devices}"
+            )
+        x = jax.device_put(np.asarray(x, np.float32), self._data_sharding)
+        y = jax.device_put(np.asarray(y, np.int32), self._data_sharding)
+        return x, y
+
+    def train_step(
+        self, x: np.ndarray, y: np.ndarray, valid: Sequence[float] | None = None
+    ) -> TrainStepMetrics:
+        """One DP step on a GLOBAL batch (first dim divisible by n_devices)."""
+        if valid is None:
+            valid_arr = np.ones((self.n_devices,), np.float32)
+        else:
+            valid_arr = np.asarray(valid, np.float32)
+            if valid_arr.shape != (self.n_devices,):
+                raise ValueError(
+                    f"valid must have shape ({self.n_devices},), got {valid_arr.shape}"
+                )
+        xd, yd = self._place_batch(x, y)
+        vd = jax.device_put(valid_arr, self._data_sharding)
+        self.params, self.opt_state, loss, cnt = self._step(
+            self.params, self.opt_state, xd, yd, vd
+        )
+        self.step_num += 1
+        return TrainStepMetrics(
+            step=self.step_num,
+            loss=float(loss),
+            contributors=float(cnt),
+        )
+
+    def train(
+        self, batches: Iterable, valid_schedule: Callable[[int], Sequence[float]] | None = None
+    ) -> list[TrainStepMetrics]:
+        history = []
+        for x, y in batches:
+            valid = valid_schedule(self.step_num) if valid_schedule else None
+            history.append(self.train_step(x, y, valid))
+        return history
+
+    def accuracy(self, x: np.ndarray, y: np.ndarray) -> float:
+        xd, yd = self._place_batch(x, y)
+        hits = self._eval(self.params, xd, yd)
+        return float(hits) / x.shape[0]
+
+    # -- weights as a flat buffer (binder/checkpoint seam) -------------------
+
+    def get_flat_params(self) -> np.ndarray:
+        flat, _ = flatten_pytree(self.params)
+        return flat
+
+    def set_flat_params(self, vec: np.ndarray) -> None:
+        _, unravel = ravel_pytree(self.params)
+        self.params = jax.device_put(
+            unravel(jnp.asarray(vec, jnp.float32)), self._replicated
+        )

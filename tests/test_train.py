@@ -1,0 +1,130 @@
+"""Trainer + model tests (BASELINE configs 3-4 scaled to the CPU test mesh).
+
+Convergence-to-parity oracle (BASELINE.md row 3): an n-device DP run on a
+global batch must match a single-device run on the same batch step for step,
+because the masked average of per-shard mean gradients equals the full-batch
+mean gradient.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models import MLP, ResNet50, data
+from akka_allreduce_tpu.parallel import grid_mesh, line_mesh
+from akka_allreduce_tpu.train import DPTrainer
+
+
+@pytest.fixture(scope="module")
+def line8():
+    return line_mesh(8)
+
+
+def mlp_trainer(mesh, lr=0.1, bucket=None, seed=0):
+    model = MLP(hidden=(32,), classes=10)
+    return DPTrainer(
+        model,
+        mesh,
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        learning_rate=lr,
+        bucket_size=bucket,
+        seed=seed,
+    )
+
+
+class TestMLPTraining:
+    def test_loss_decreases(self, line8):
+        t = mlp_trainer(line8)
+        ds = data.mnist_like()
+        hist = t.train(ds.batches(64, 30))
+        assert hist[0].contributors == 8.0
+        first5 = np.mean([h.loss for h in hist[:5]])
+        last5 = np.mean([h.loss for h in hist[-5:]])
+        assert last5 < first5 * 0.7, (first5, last5)
+        acc_batch = next(iter(ds.batches(256, 1, seed_offset=99)))
+        assert t.accuracy(*acc_batch) > 0.5
+
+    def test_multi_device_matches_single_device(self, line8):
+        t8 = mlp_trainer(line8, seed=3)
+        t1 = mlp_trainer(line_mesh(1), seed=3)
+        ds = data.mnist_like()
+        batches = list(ds.batches(64, 3))
+        t8.train(iter(batches))
+        t1.train(iter(batches))
+        np.testing.assert_allclose(
+            t8.get_flat_params(), t1.get_flat_params(), rtol=2e-4, atol=2e-5
+        )
+
+    def test_bucketed_matches_unbucketed(self, line8):
+        tb = mlp_trainer(line8, bucket=1000, seed=1)
+        tu = mlp_trainer(line8, seed=1)
+        ds = data.mnist_like()
+        batches = list(ds.batches(32, 3))
+        tb.train(iter(batches))
+        tu.train(iter(batches))
+        np.testing.assert_allclose(
+            tb.get_flat_params(), tu.get_flat_params(), rtol=2e-4, atol=2e-5
+        )
+
+    def test_masked_devices_do_not_contribute(self, line8):
+        # devices 6,7 masked out -> equals a 6-shard run on the same shards
+        t = mlp_trainer(line8, seed=5)
+        ref_params = t.get_flat_params()
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.array([1, 1, 1, 1, 1, 1, 0, 0], np.float32)
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 6.0
+
+        # oracle: single-device trainer on only the first 6 shards
+        t_o = mlp_trainer(line_mesh(1), seed=5)
+        np.testing.assert_allclose(ref_params, t_o.get_flat_params(), atol=1e-6)
+        shard = 64 // 8
+        t_o.train_step(x[: 6 * shard], y[: 6 * shard])
+        np.testing.assert_allclose(
+            t.get_flat_params(), t_o.get_flat_params(), rtol=2e-4, atol=2e-5
+        )
+
+    def test_butterfly_grid_mesh_trains(self):
+        t = mlp_trainer(grid_mesh(2, 4))
+        ds = data.mnist_like()
+        hist = t.train(ds.batches(64, 5))
+        assert len(hist) == 5
+        assert hist[-1].contributors == 8.0
+
+    def test_rejects_bad_batch_and_mask(self, line8):
+        t = mlp_trainer(line8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(60, 1)))  # 60 % 8 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            t.train_step(x, y)
+        x, y = next(iter(ds.batches(64, 1)))
+        with pytest.raises(ValueError, match="valid"):
+            t.train_step(x, y, valid=[1.0, 0.0])
+
+
+class TestResNet:
+    def test_resnet50_param_count_matches_reference_buffer(self):
+        # BASELINE.json:10: 25M-param chunked buffer
+        model = ResNet50(classes=1000)
+        t = DPTrainer(
+            model,
+            line_mesh(1),
+            example_input=np.zeros((1, 32, 32, 3), np.float32),
+            learning_rate=0.1,
+        )
+        assert 24_000_000 < t.param_count < 27_000_000, t.param_count
+
+    def test_resnet_small_trains_on_mesh(self, line8):
+        # scaled-down ResNet (same block structure) so the CPU mesh stays fast
+        model = ResNet50(classes=10)
+        t = DPTrainer(
+            model,
+            line8,
+            example_input=np.zeros((1, 32, 32, 3), np.float32),
+            learning_rate=0.05,
+            bucket_size=262_144,  # the reference's chunked-buffer geometry
+        )
+        ds = data.SyntheticClassification((32, 32, 3), 10, seed=2)
+        hist = t.train(ds.batches(16, 2))
+        assert len(hist) == 2 and np.isfinite(hist[-1].loss)
