@@ -1,3 +1,8 @@
 """Data-parallel training on the ICI data plane (SURVEY.md §8.1 step 4)."""
 
 from akka_allreduce_tpu.train.trainer import DPTrainer, TrainStepMetrics  # noqa: F401
+from akka_allreduce_tpu.train.checkpoint import (  # noqa: F401
+    Snapshot,
+    TrainerCheckpointer,
+)
+from akka_allreduce_tpu.train.elastic import ElasticDPTrainer  # noqa: F401

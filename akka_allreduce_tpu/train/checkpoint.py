@@ -1,0 +1,127 @@
+"""Checkpoint/resume for the DP trainer (SURVEY.md §6 "Checkpoint / resume").
+
+The reference keeps no checkpoint state of its own — allreduce rounds are
+stateless beyond the round window, and model save/load lives in its BIDMach
+dependency. For capability parity of "resume after dropout" (BASELINE.json
+config 5) the TPU build provides the trainer-layer equivalent: Orbax
+checkpoints of ``{params, opt_state, step}``, plus a zero-copy in-memory
+snapshot used by the elastic re-mesh path (SURVEY.md §8.4 — "checkpoint-in-HBM
+→ reinit mesh → resume").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """In-memory (host RAM) snapshot of trainer state for fast re-mesh resume.
+
+    Held as numpy so it survives the death of the device mesh it came from:
+    during elastic reconfiguration the old mesh's devices may be gone by the
+    time we restore.
+    """
+
+    params: Any  # pytree of np.ndarray
+    opt_state: Any  # pytree of np.ndarray / leaves
+    step: int
+
+    @classmethod
+    def capture(cls, trainer) -> "Snapshot":
+        host = lambda t: jax.tree.map(lambda x: np.asarray(x), t)
+        return cls(
+            params=host(trainer.params),
+            opt_state=host(trainer.opt_state),
+            step=trainer.step_num,
+        )
+
+    def restore_into(self, trainer) -> None:
+        """Place this snapshot into ``trainer`` (replicated over its mesh)."""
+        put = lambda t: jax.tree.map(
+            lambda x: jax.device_put(x, trainer._replicated), t
+        )
+        trainer.params = put(self.params)
+        trainer.opt_state = put(self.opt_state)
+        trainer.step_num = self.step
+
+
+class TrainerCheckpointer:
+    """Durable on-disk checkpoints of trainer state via Orbax.
+
+    Usage::
+
+        ckpt = TrainerCheckpointer(dir)
+        ckpt.save(trainer)                  # every k steps
+        step = ckpt.restore(trainer)        # after restart / re-mesh
+    """
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3) -> None:
+        self.directory = Path(directory).absolute()
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, trainer, *, force: bool = False) -> bool:
+        if trainer.step_num in self._mgr.all_steps():
+            return False  # this step is already durable; nothing to do
+        state = {
+            "params": trainer.params,
+            "opt_state": trainer.opt_state,
+            "step": trainer.step_num,
+        }
+        saved = self._mgr.save(
+            trainer.step_num, args=ocp.args.StandardSave(state), force=force
+        )
+        self._mgr.wait_until_finished()
+        return bool(saved)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, trainer, step: int | None = None) -> int:
+        """Restore trainer state in place; returns the restored step number."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        # Use the trainer's live state as the abstract target so leaves come
+        # back with the right dtypes/shardings for its current mesh.
+        target = {
+            "params": trainer.params,
+            "opt_state": trainer.opt_state,
+            "step": trainer.step_num,
+        }
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        # Orbax may hand back single-device arrays; re-replicate over the
+        # trainer's current mesh (this is also what makes restore-into-a-
+        # different-mesh work after an elastic re-mesh).
+        put = lambda t: jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), trainer._replicated)
+            if isinstance(x, (jax.Array, np.ndarray))
+            else x,
+            t,
+        )
+        trainer.params = put(restored["params"])
+        trainer.opt_state = put(restored["opt_state"])
+        trainer.step_num = int(restored["step"])
+        return trainer.step_num
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainerCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

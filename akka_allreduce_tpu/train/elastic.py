@@ -1,0 +1,172 @@
+"""Elastic DP training: the end-to-end dropout / late-joiner recovery of
+BASELINE config 5, composed from the pieces the reference composes
+(SURVEY.md §4.5):
+
+    failure detector -> master recomputes membership -> prepare/confirm
+    handshake -> rounds resume
+
+with the one structural difference SURVEY.md §8.4 dictates: XLA fixes the
+device topology at trace time, so cross-round membership change cannot be a
+peer-list swap — it is **snapshot-in-host-RAM -> rebuild the mesh over the
+live devices -> restore -> resume**. Within-round straggling still never
+triggers this path; it is absorbed by the validity mask (thresholds), exactly
+the reference's two-tier design.
+
+Each *node* owns a static set of devices (a TPU host's chips). Heartbeats feed
+the phi-accrual detector; a silent node's devices leave the mesh at the next
+``poll``; a late joiner's heartbeat brings its devices back in.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from akka_allreduce_tpu.control.failure import (
+    HeartbeatMonitor,
+    MembershipEvent,
+    PhiAccrualFailureDetector,
+)
+from akka_allreduce_tpu.parallel.mesh import line_mesh
+from akka_allreduce_tpu.train.checkpoint import Snapshot
+from akka_allreduce_tpu.train.trainer import DPTrainer, TrainStepMetrics
+
+log = logging.getLogger(__name__)
+
+
+class ElasticDPTrainer:
+    """DP trainer that re-meshes over the devices of live nodes.
+
+    Args:
+      model: flax module.
+      devices_by_node: node id -> that node's devices (disjoint). The mesh at
+        any moment is the concatenation of live nodes' devices, in node order.
+      example_input: one device's worth of input for ``init``.
+      mesh_factory: devices -> Mesh (default: 1D line; pass grid_mesh for the
+        butterfly layout).
+      detector: phi-accrual detector (default: Akka-like threshold 8).
+      min_nodes: below this many live nodes, ``train_step`` refuses to run
+        (the reference's th_allreduce floor applied to membership).
+      **trainer_kwargs: forwarded to DPTrainer (optimizer, bucket_size, ...).
+    """
+
+    def __init__(
+        self,
+        model,
+        devices_by_node: Mapping[int, Sequence[jax.Device]],
+        example_input: np.ndarray,
+        *,
+        mesh_factory: Callable[..., jax.sharding.Mesh] = line_mesh,
+        detector: PhiAccrualFailureDetector | None = None,
+        min_nodes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        **trainer_kwargs,
+    ) -> None:
+        if not devices_by_node:
+            raise ValueError("need at least one node")
+        self.model = model
+        self.devices_by_node = {
+            int(k): list(v) for k, v in devices_by_node.items()
+        }
+        self.example_input = np.asarray(example_input)
+        self.mesh_factory = mesh_factory
+        self.min_nodes = min_nodes
+        self.clock = clock
+        self.trainer_kwargs = trainer_kwargs
+        self.monitor = HeartbeatMonitor(detector)
+        self.generation = 0  # the config_id analog: bumps on every re-mesh
+        self.remesh_events: list[MembershipEvent] = []
+
+        now = self.clock()
+        for node_id in self.devices_by_node:
+            self.monitor.heartbeat(node_id, now)
+        self.member_nodes: tuple[int, ...] = tuple(self.monitor.members_up)
+        self.trainer = self._build_trainer()
+
+    # -- membership ----------------------------------------------------------
+
+    def _live_devices(self) -> list[jax.Device]:
+        devs: list[jax.Device] = []
+        for node_id in self.member_nodes:
+            devs.extend(self.devices_by_node[node_id])
+        return devs
+
+    def _build_trainer(self) -> DPTrainer:
+        mesh = self.mesh_factory(devices=self._live_devices())
+        return DPTrainer(
+            self.model,
+            mesh,
+            example_input=self.example_input,
+            **self.trainer_kwargs,
+        )
+
+    def heartbeat(self, node_id: int, now: float | None = None) -> None:
+        """Record a node's heartbeat. An unknown node id is a late joiner."""
+        if node_id not in self.devices_by_node:
+            raise KeyError(
+                f"node {node_id} has no device assignment; register it in "
+                "devices_by_node before it can join"
+            )
+        ev = self.monitor.heartbeat(node_id, self.clock() if now is None else now)
+        if ev is not None:
+            self.remesh_events.append(ev)
+
+    def leave(self, node_id: int, now: float | None = None) -> None:
+        ev = self.monitor.leave(node_id, self.clock() if now is None else now)
+        if ev is not None:
+            self.remesh_events.append(ev)
+
+    def poll(self, now: float | None = None) -> bool:
+        """Run failure detection and re-mesh if membership changed.
+
+        Returns True if a re-mesh happened. This is the
+        ``PrepareAllreduce -> ConfirmPreparation`` moment of the reference:
+        expensive here (re-jit) where the reference's is cheap, which is why
+        it only fires on *sustained* failure, never on within-round lag.
+        """
+        now = self.clock() if now is None else now
+        self.remesh_events.extend(self.monitor.poll(now))
+        live = tuple(self.monitor.members_up)
+        if live == self.member_nodes:
+            return False
+        if not live:
+            raise RuntimeError("all nodes unreachable; cannot re-mesh")
+        log.info(
+            "re-mesh: members %s -> %s (generation %d -> %d)",
+            self.member_nodes,
+            live,
+            self.generation,
+            self.generation + 1,
+        )
+        snap = Snapshot.capture(self.trainer)
+        self.member_nodes = live
+        self.generation += 1
+        self.trainer = self._build_trainer()
+        snap.restore_into(self.trainer)
+        return True
+
+    # -- training ------------------------------------------------------------
+
+    @property
+    def n_devices(self) -> int:
+        return self.trainer.n_devices
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.member_nodes)
+
+    def train_step(
+        self, x: np.ndarray, y: np.ndarray, valid: Sequence[float] | None = None
+    ) -> TrainStepMetrics:
+        if self.n_nodes < self.min_nodes:
+            raise RuntimeError(
+                f"only {self.n_nodes} live nodes < min_nodes={self.min_nodes}"
+            )
+        return self.trainer.train_step(x, y, valid)
+
+    def get_flat_params(self) -> np.ndarray:
+        return self.trainer.get_flat_params()

@@ -12,7 +12,10 @@ var alone is not enough — we also update ``jax.config`` before any backend use
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the driver may export JAX_PLATFORMS=axon (the TPU
+# plugin), and in-process CLI entrypoints re-assert this env var into
+# jax.config — it must say cpu for the whole suite.
+os.environ["JAX_PLATFORMS"] = "cpu"
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,106 @@
+"""Checkpoint/resume tests (SURVEY.md §6 "Checkpoint / resume"; the durable
+half of BASELINE config 5's recovery story)."""
+
+import numpy as np
+import optax
+import pytest
+
+from akka_allreduce_tpu.models import MLP, data
+from akka_allreduce_tpu.parallel import line_mesh
+from akka_allreduce_tpu.train import DPTrainer, Snapshot, TrainerCheckpointer
+
+
+def make_trainer(mesh, seed=0):
+    return DPTrainer(
+        MLP(hidden=(16,), classes=10),
+        mesh,
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        optimizer=optax.adam(1e-3),  # nontrivial opt state (mu/nu/count)
+        seed=seed,
+    )
+
+
+class TestSnapshot:
+    def test_capture_restore_roundtrip(self):
+        mesh = line_mesh(8)
+        t = make_trainer(mesh)
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 3))
+        snap = Snapshot.capture(t)
+        ref = t.get_flat_params().copy()
+
+        t.train(ds.batches(32, 2, seed_offset=7))  # diverge
+        assert not np.allclose(t.get_flat_params(), ref)
+
+        snap.restore_into(t)
+        assert t.step_num == 3
+        np.testing.assert_array_equal(t.get_flat_params(), ref)
+
+    def test_snapshot_survives_mesh_change(self):
+        # the elastic re-mesh path: capture on 8 devices, restore into a
+        # 4-device trainer, and training continues identically to a trainer
+        # that had those weights natively
+        t8 = make_trainer(line_mesh(8), seed=1)
+        ds = data.mnist_like()
+        t8.train(ds.batches(32, 2))
+        snap = Snapshot.capture(t8)
+
+        t4 = make_trainer(line_mesh(4), seed=99)
+        snap.restore_into(t4)
+        assert t4.step_num == 2
+        np.testing.assert_array_equal(t4.get_flat_params(), t8.get_flat_params())
+        m = t4.train_step(*next(iter(ds.batches(16, 1, seed_offset=3))))
+        assert m.contributors == 4.0 and np.isfinite(m.loss)
+
+
+class TestTrainerCheckpointer:
+    def test_save_restore_roundtrip(self, tmp_path):
+        mesh = line_mesh(8)
+        t = make_trainer(mesh, seed=2)
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 3))
+        with TrainerCheckpointer(tmp_path / "ckpt") as ckpt:
+            assert ckpt.save(t)
+            assert ckpt.latest_step() == 3
+            ref = t.get_flat_params().copy()
+
+            t.train(ds.batches(32, 2, seed_offset=5))
+            step = ckpt.restore(t)
+        assert step == 3 and t.step_num == 3
+        np.testing.assert_array_equal(t.get_flat_params(), ref)
+
+    def test_restore_into_fresh_process_equivalent(self, tmp_path):
+        # a brand-new trainer (fresh params) restores the full state
+        ds = data.mnist_like()
+        t = make_trainer(line_mesh(8), seed=3)
+        t.train(ds.batches(32, 2))
+        with TrainerCheckpointer(tmp_path / "c2") as ckpt:
+            ckpt.save(t)
+            fresh = make_trainer(line_mesh(8), seed=77)
+            ckpt.restore(fresh)
+        np.testing.assert_array_equal(
+            fresh.get_flat_params(), t.get_flat_params()
+        )
+        # post-restore training matches the original exactly (opt state too)
+        batch = next(iter(ds.batches(32, 1, seed_offset=9)))
+        t.train_step(*batch)
+        fresh.train_step(*batch)
+        np.testing.assert_allclose(
+            fresh.get_flat_params(), t.get_flat_params(), rtol=1e-6, atol=1e-7
+        )
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        t = make_trainer(line_mesh(1))
+        with TrainerCheckpointer(tmp_path / "empty") as ckpt:
+            with pytest.raises(FileNotFoundError):
+                ckpt.restore(t)
+
+    def test_max_to_keep_prunes(self, tmp_path):
+        t = make_trainer(line_mesh(1), seed=4)
+        ds = data.mnist_like()
+        with TrainerCheckpointer(tmp_path / "c3", max_to_keep=2) as ckpt:
+            for _ in range(4):
+                t.train(ds.batches(8, 1))
+                ckpt.save(t)
+            steps = ckpt._mgr.all_steps()
+        assert list(steps) == [3, 4]

@@ -1,0 +1,192 @@
+"""Elastic recovery tests — BASELINE config 5 ("Threshold-completion allreduce
+with worker dropout / late-joiner recovery") end to end on the virtual CPU
+mesh, plus unit tests for the phi-accrual failure detector (SURVEY.md §4.5).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from akka_allreduce_tpu.control.failure import (
+    HeartbeatMonitor,
+    MemberState,
+    PhiAccrualFailureDetector,
+)
+from akka_allreduce_tpu.models import MLP, data
+from akka_allreduce_tpu.train import ElasticDPTrainer
+
+
+class TestPhiAccrual:
+    def test_regular_heartbeats_stay_available(self):
+        d = PhiAccrualFailureDetector()
+        for i in range(20):
+            d.heartbeat(1, i * 1.0)
+        assert d.is_available(1, 20.5)
+        assert d.phi(1, 20.1) < 1.0
+
+    def test_sustained_silence_trips(self):
+        d = PhiAccrualFailureDetector()
+        for i in range(20):
+            d.heartbeat(1, i * 1.0)
+        assert not d.is_available(1, 40.0)
+        # suspicion grows monotonically with silence (pre-saturation regime)
+        assert d.phi(1, 22.0) > d.phi(1, 21.5) > d.phi(1, 21.0)
+
+    def test_jittery_node_gets_slack(self):
+        # irregular-but-alive heartbeats widen the window: at t_silent=4 the
+        # jittery node must look healthier than a metronomic one
+        jittery, steady = PhiAccrualFailureDetector(), PhiAccrualFailureDetector()
+        t = 0.0
+        for i in range(30):
+            t += 0.5 if i % 2 else 2.5
+            jittery.heartbeat(1, t)
+        for i in range(30):
+            steady.heartbeat(1, i * 1.5)
+        assert jittery.phi(1, t + 4.0) < steady.phi(1, 45.0 - 1.5 + 4.0)
+
+    def test_never_heard_from_is_not_suspected(self):
+        d = PhiAccrualFailureDetector()
+        assert d.phi(99, 1e9) == 0.0
+
+    def test_monitor_edge_events(self):
+        m = HeartbeatMonitor()
+        ev = m.heartbeat(1, 0.0)
+        assert ev is not None and ev.state is MemberState.UP
+        assert m.heartbeat(1, 1.0) is None  # no repeat UP
+        for i in range(2, 12):
+            m.heartbeat(1, float(i))
+        events = m.poll(60.0)
+        assert [e.state for e in events] == [MemberState.UNREACHABLE]
+        assert m.poll(61.0) == []  # edge-triggered, not level
+        rejoin = m.heartbeat(1, 62.0)
+        assert rejoin is not None and rejoin.state is MemberState.UP
+
+
+def elastic(n_nodes=4, devs_per_node=2, **kw):
+    devices = jax.devices()
+    assert len(devices) >= n_nodes * devs_per_node
+    assignment = {
+        n: devices[n * devs_per_node : (n + 1) * devs_per_node]
+        for n in range(n_nodes)
+    }
+    fake_now = {"t": 0.0}
+    t = ElasticDPTrainer(
+        MLP(hidden=(16,), classes=10),
+        assignment,
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        clock=lambda: fake_now["t"],
+        **kw,
+    )
+    return t, fake_now
+
+
+class TestElasticDPTrainer:
+    def test_dropout_remesh_resume(self):
+        t, now = elastic()
+        assert t.n_devices == 8 and t.n_nodes == 4
+        ds = data.mnist_like()
+        for x, y in ds.batches(32, 3):
+            for n in range(4):
+                t.heartbeat(n)
+            now["t"] += 1.0
+            t.train_step(x, y)
+        params_before = t.get_flat_params().copy()
+
+        # node 3 goes silent; others keep beating
+        for _ in range(10):
+            for n in range(3):
+                t.heartbeat(n)
+            now["t"] += 1.0
+        assert t.poll()  # re-meshed
+        assert t.n_nodes == 3 and t.n_devices == 6 and t.generation == 1
+        # weights and step counter survived the re-mesh
+        np.testing.assert_array_equal(t.get_flat_params(), params_before)
+        assert t.trainer.step_num == 3
+
+        m = t.train_step(*next(iter(ds.batches(24, 1, seed_offset=5))))
+        assert m.contributors == 6.0 and np.isfinite(m.loss)
+
+    def test_late_joiner_rejoins_mesh(self):
+        t, now = elastic(n_nodes=3)
+        ds = data.mnist_like()
+        # node 2 silent -> shrink to 2 nodes
+        for _ in range(10):
+            t.heartbeat(0), t.heartbeat(1)
+            now["t"] += 1.0
+        assert t.poll() and t.n_nodes == 2
+        t.train_step(*next(iter(ds.batches(16, 1))))
+
+        # node 2 comes back (late joiner) -> grow back to 3 nodes
+        t.heartbeat(2)
+        assert t.poll() and t.n_nodes == 3 and t.generation == 2
+        m = t.train_step(*next(iter(ds.batches(24, 1, seed_offset=1))))
+        assert m.contributors == 6.0
+
+    def test_no_change_no_remesh(self):
+        t, now = elastic(n_nodes=2)
+        for _ in range(5):
+            t.heartbeat(0), t.heartbeat(1)
+            now["t"] += 1.0
+        gen = t.generation
+        assert not t.poll()
+        assert t.generation == gen
+
+    def test_min_nodes_floor(self):
+        t, now = elastic(n_nodes=2, min_nodes=2)
+        ds = data.mnist_like()
+        for _ in range(10):
+            t.heartbeat(0)
+            now["t"] += 1.0
+        t.poll()
+        with pytest.raises(RuntimeError, match="min_nodes"):
+            t.train_step(*next(iter(ds.batches(8, 1))))
+
+    def test_all_nodes_lost_raises(self):
+        t, now = elastic(n_nodes=2)
+        for _ in range(3):
+            t.heartbeat(0), t.heartbeat(1)
+            now["t"] += 1.0
+        now["t"] += 1000.0
+        with pytest.raises(RuntimeError, match="all nodes"):
+            t.poll()
+
+    def test_unknown_node_heartbeat_rejected(self):
+        t, _ = elastic(n_nodes=2)
+        with pytest.raises(KeyError, match="device assignment"):
+            t.heartbeat(7)
+
+    def test_remesh_training_continues_correctly(self):
+        # post-remesh training on 2 nodes must equal a fresh 4-device trainer
+        # seeded with the same snapshot — the re-mesh is semantically invisible
+        t, now = elastic(n_nodes=4, devs_per_node=1, seed=11)
+        ds = data.mnist_like()
+        for x, y in ds.batches(16, 2):
+            for n in range(4):
+                t.heartbeat(n)
+            now["t"] += 1.0
+            t.train_step(x, y)
+        for _ in range(10):
+            t.heartbeat(0), t.heartbeat(1)
+            now["t"] += 1.0
+        assert t.poll() and t.n_devices == 2
+
+        from akka_allreduce_tpu.parallel import line_mesh
+        from akka_allreduce_tpu.train import DPTrainer
+
+        oracle = DPTrainer(
+            MLP(hidden=(16,), classes=10),
+            line_mesh(2),
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            seed=11,
+        )
+        from akka_allreduce_tpu.train import Snapshot
+
+        # host-RAM copy: oracle must not alias t's buffers (steps donate them)
+        Snapshot.capture(t.trainer).restore_into(oracle)
+        batch = next(iter(ds.batches(16, 1, seed_offset=42)))
+        t.train_step(*batch)
+        oracle.train_step(*batch)
+        np.testing.assert_allclose(
+            t.get_flat_params(), oracle.get_flat_params(), rtol=1e-6, atol=1e-7
+        )
