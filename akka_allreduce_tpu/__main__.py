@@ -1,0 +1,269 @@
+"""CLI bootstrap — the reference's per-role ``main`` classes + run scripts
+(SURVEY.md §2 L4, §3 "Bootstrap mains + scripts") as one argparse entrypoint:
+
+    python -m akka_allreduce_tpu local-demo   --nodes 4 --size 1000000
+    python -m akka_allreduce_tpu bench        --floats 67108864 --schedule psum
+    python -m akka_allreduce_tpu train-mlp    --steps 100 --batch 64
+    python -m akka_allreduce_tpu train-resnet --steps 5 --bucket 262144
+    python -m akka_allreduce_tpu elastic-demo --steps 30 --drop-at 10 --rejoin-at 20
+
+``local-demo`` is the reference's single-process N-worker fixture (BASELINE
+config 1) on the host engine; the rest run the XLA data plane on whatever
+devices are visible (TPU chips, or a virtual CPU mesh via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _add_mesh_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
+    p.add_argument(
+        "--mesh",
+        choices=("line", "grid"),
+        default="line",
+        help="1D line or 2D butterfly grid (SURVEY.md §4.3)",
+    )
+
+
+def _make_mesh(args):
+    import jax
+
+    from akka_allreduce_tpu.parallel import grid_mesh, line_mesh
+
+    if args.mesh == "grid":
+        devs = None if args.devices is None else jax.devices()[: args.devices]
+        return grid_mesh(devices=devs)
+    return line_mesh(args.devices)
+
+
+def _cmd_local_demo(argv: list[str]) -> int:
+    from akka_allreduce_tpu.control.local import _main
+
+    sys.argv = ["local-demo", *argv]
+    _main()
+    return 0
+
+
+def _cmd_bench(argv: list[str]) -> int:
+    p = argparse.ArgumentParser("bench", description="threshold-allreduce bandwidth")
+    p.add_argument("--floats", type=int, default=64 * 1024 * 1024)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--schedule", choices=("psum", "butterfly", "ring"), default="psum")
+    p.add_argument("--bucket", type=int, default=None)
+    _add_mesh_flags(p)
+    args = p.parse_args(argv)
+
+    import json
+
+    from akka_allreduce_tpu.comm.bandwidth import measure_allreduce
+
+    mesh = _make_mesh(args)
+    r = measure_allreduce(
+        mesh,
+        args.floats,
+        iters=args.iters,
+        schedule=args.schedule,
+        bucket_size=args.bucket,
+    )
+    print(json.dumps(r.to_dict()))
+    return 0
+
+
+def _train_flags(p: argparse.ArgumentParser) -> None:
+    _add_mesh_flags(p)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch", type=int, default=64, help="global batch size")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--bucket", type=int, default=None, help="grad bucket (elements)")
+    p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+
+
+def _run_training(trainer, ds, args, *, label: str) -> int:
+    import numpy as np
+
+    from akka_allreduce_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(args.metrics_out)
+    ckpt = None
+    if args.checkpoint_dir:
+        from akka_allreduce_tpu.train import TrainerCheckpointer
+
+        ckpt = TrainerCheckpointer(args.checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            step = ckpt.restore(trainer)
+            print(f"resumed from step {step}")
+    t0 = time.perf_counter()
+    losses = []
+    for x, y in ds.batches(args.batch, args.steps):
+        st = time.perf_counter()
+        m = trainer.train_step(x, y)
+        dt = time.perf_counter() - st
+        losses.append(m.loss)
+        logger.log_event(
+            kind="train_step", workload=label, step=m.step, loss=m.loss,
+            contributors=m.contributors, step_time_s=round(dt, 6),
+        )
+        if ckpt and args.checkpoint_every and m.step % args.checkpoint_every == 0:
+            ckpt.save(trainer)
+    total = time.perf_counter() - t0
+    if ckpt:
+        ckpt.save(trainer, force=True)
+        ckpt.close()
+    logger.close()
+    print(
+        f"{label}: {len(losses)} steps on {trainer.n_devices} devices in "
+        f"{total:.2f}s ({total / max(len(losses), 1) * 1e3:.1f} ms/step); "
+        f"loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
+    )
+    return 0
+
+
+def _cmd_train_mlp(argv: list[str]) -> int:
+    p = argparse.ArgumentParser("train-mlp", description="MLP/MNIST DP-SGD (config 3)")
+    _train_flags(p)
+    p.add_argument("--hidden", type=int, nargs="+", default=[128])
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from akka_allreduce_tpu.models import MLP, data
+    from akka_allreduce_tpu.train import DPTrainer
+
+    trainer = DPTrainer(
+        MLP(hidden=tuple(args.hidden), classes=10),
+        _make_mesh(args),
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        learning_rate=args.lr,
+        bucket_size=args.bucket,
+    )
+    return _run_training(trainer, data.mnist_like(), args, label="mlp_mnist")
+
+
+def _cmd_train_resnet(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "train-resnet", description="ResNet-50 DP grad sync (config 4)"
+    )
+    _train_flags(p)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--classes", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from akka_allreduce_tpu.models import ResNet50, data
+    from akka_allreduce_tpu.train import DPTrainer
+
+    trainer = DPTrainer(
+        ResNet50(classes=args.classes),
+        _make_mesh(args),
+        example_input=np.zeros(
+            (1, args.image_size, args.image_size, 3), np.float32
+        ),
+        learning_rate=args.lr,
+        bucket_size=args.bucket or 262_144,  # the reference's chunk geometry
+    )
+    print(f"ResNet params: {trainer.param_count / 1e6:.1f}M")
+    ds = data.SyntheticClassification(
+        (args.image_size, args.image_size, 3), args.classes, seed=0
+    )
+    return _run_training(trainer, ds, args, label="resnet50")
+
+
+def _cmd_elastic_demo(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "elastic-demo",
+        description="config-5 dropout + late-joiner recovery, end to end",
+    )
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-per-device", type=int, default=8)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--drop-at", type=int, default=10, help="step the last node dies")
+    p.add_argument("--rejoin-at", type=int, default=20, help="step it comes back")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.models import MLP, data
+    from akka_allreduce_tpu.train import ElasticDPTrainer
+
+    devices = jax.devices()
+    per = max(1, len(devices) // args.nodes)
+    assignment = {
+        n: devices[n * per : (n + 1) * per] for n in range(args.nodes)
+    }
+    now = {"t": 0.0}
+    trainer = ElasticDPTrainer(
+        MLP(hidden=(32,), classes=10),
+        assignment,
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        clock=lambda: now["t"],
+    )
+    ds = data.mnist_like()
+    dead = args.nodes - 1
+    for step in range(args.steps):
+        live = set(trainer.member_nodes)
+        if step == args.rejoin_at:
+            trainer.heartbeat(dead)  # late joiner
+        for n in range(args.nodes):
+            if n == dead and args.drop_at <= step < args.rejoin_at:
+                continue
+            if n in trainer.devices_by_node:
+                trainer.heartbeat(n)
+        now["t"] += 1.0
+        if trainer.poll():
+            print(
+                f"step {step}: re-meshed to {trainer.n_nodes} nodes / "
+                f"{trainer.n_devices} devices (generation {trainer.generation})"
+            )
+        x, y = next(iter(ds.batches(args.batch_per_device * trainer.n_devices, 1,
+                                    seed_offset=step)))
+        m = trainer.train_step(x, y)
+        if step % 5 == 0 or set(trainer.member_nodes) != live:
+            print(
+                f"step {m.step}: loss={m.loss:.4f} "
+                f"contributors={m.contributors:.0f}"
+            )
+    print(f"done: {args.steps} steps, final generation {trainer.generation}")
+    return 0
+
+
+COMMANDS = {
+    "local-demo": _cmd_local_demo,
+    "bench": _cmd_bench,
+    "train-mlp": _cmd_train_mlp,
+    "train-resnet": _cmd_train_resnet,
+    "elastic-demo": _cmd_elastic_demo,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    # the axon TPU plugin overrides JAX_PLATFORMS at import time; re-assert
+    # the user's explicit platform choice (same dance as tests/conftest.py)
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("commands:", ", ".join(COMMANDS))
+        return 0
+    cmd = argv[0]
+    if cmd not in COMMANDS:
+        print(f"unknown command {cmd!r}; expected one of {sorted(COMMANDS)}")
+        return 2
+    return COMMANDS[cmd](argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""CLI bootstrap tests (SURVEY.md §2 L4 — the reference's role mains + run
+scripts, exercised in-process on the virtual CPU mesh)."""
+
+import json
+
+from akka_allreduce_tpu.__main__ import main
+
+
+class TestCLI:
+    def test_help_and_unknown(self, capsys):
+        assert main([]) == 0
+        assert "commands:" in capsys.readouterr().out
+        assert main(["no-such-cmd"]) == 2
+
+    def test_bench(self, capsys):
+        assert main(["bench", "--floats", "4096", "--iters", "2"]) == 0
+        report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert report["n_devices"] == 8
+        assert report["bus_gbps_best"] > 0
+
+    def test_local_demo(self, capsys):
+        assert (
+            main(
+                ["local-demo", "--nodes", "4", "--size", "10000", "--rounds", "3"]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "rounds_completed=3" in out
+
+    def test_train_mlp_with_metrics_and_resume(self, tmp_path, capsys):
+        metrics = tmp_path / "m.jsonl"
+        ckpt = tmp_path / "ckpt"
+        args = [
+            "train-mlp", "--steps", "2", "--batch", "16",
+            "--hidden", "8",
+            "--metrics-out", str(metrics),
+            "--checkpoint-dir", str(ckpt), "--checkpoint-every", "1",
+        ]
+        assert main(args) == 0
+        lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+        assert [l["step"] for l in lines] == [1, 2]
+        assert all(l["contributors"] == 8.0 for l in lines)
+
+        assert main(args) == 0  # second run resumes from the checkpoint
+        assert "resumed from step 2" in capsys.readouterr().out
+
+    def test_elastic_demo(self, capsys):
+        # the drop window must outlast the phi detector's suspicion ramp
+        # (~3-4 silent intervals at threshold 8), hence drop at 2, rejoin at 8
+        assert (
+            main(
+                [
+                    "elastic-demo", "--steps", "10", "--drop-at", "2",
+                    "--rejoin-at", "8", "--batch-per-device", "4",
+                ]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "re-meshed to 3 nodes" in out
+        assert "re-meshed to 4 nodes" in out
+        assert "final generation 2" in out
