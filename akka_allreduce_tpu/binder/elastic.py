@@ -14,6 +14,7 @@ from typing import Callable
 
 import numpy as np
 
+from akka_allreduce_tpu import native
 from akka_allreduce_tpu.protocol import (
     AllReduceInput,
     AllReduceInputRequest,
@@ -43,10 +44,9 @@ class ElasticAverageBinder:
         return AllReduceInput(self.get_weights())
 
     def data_sink(self, out: AllReduceOutput) -> None:
-        w = self.get_weights().astype(np.float32)
-        contributed = out.count > 0
-        avg = out.average()
-        a = self.elastic_rate
-        w = np.where(contributed, (1.0 - a) * w + a * avg, w)
+        w = self.get_weights().astype(np.float32)  # fresh writable copy
+        # fused (1-a)*w + a*sum/count where count>0, via the native engine
+        # when built (akka_allreduce_tpu/native), numpy otherwise
+        native.elastic_update(w, out.data, out.count, self.elastic_rate)
         self.set_weights(w)
         self.rounds_applied += 1

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from akka_allreduce_tpu import native
 from akka_allreduce_tpu.config import MetaDataConfig, ThresholdConfig
 
 
@@ -95,7 +96,7 @@ class ScatteredDataBuffer:
             raise ValueError(
                 f"chunk {chunk_id} expects shape ({stop - start},), got {value.shape}"
             )
-        self._sums[start:stop] += value
+        native.accumulate(self._sums[start:stop], value)
         self._counts[chunk_id] += 1
         self._contributed[chunk_id, src_id] = True
         return (
@@ -214,8 +215,8 @@ class ReducedDataBuffer:
         """
         n = self.metadata.data_size
         lengths = np.tile(self._chunk_lengths, self.peer_size)
-        counts = np.repeat(self._chunk_counts.reshape(-1), lengths)
-        return self._data[:n].copy(), counts[:n].astype(np.int32)
+        counts = native.expand_counts(self._chunk_counts.reshape(-1), lengths, n)
+        return self._data[:n].copy(), counts
 
 
 class RoundBuffers:

@@ -1,0 +1,198 @@
+"""ctypes binding for the C++ host-side reduction engine.
+
+The TPU data plane is XLA (comm/allreduce.py); this native library carries the
+*host* data path — engine unit mode, CPU fallback, DCN chunk staging — the
+role the reference's JVM float loops play (SURVEY.md §3 "Reduction executor").
+Built from ``native/threshold_reduce.cpp`` via ``make -C native`` or, failing
+that, compiled on first import when a C++ toolchain is present. Every entry
+point has a numpy fallback, so the framework is fully functional without the
+.so; ``available()`` reports which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_threshold_reduce.so")
+_SRC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "threshold_reduce.cpp",
+)
+
+_lib = None
+_lock = threading.Lock()
+_build_attempted = False
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _try_build() -> bool:
+    if not os.path.exists(_SRC_PATH):
+        return False
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-fPIC", "-shared", "-fopenmp", "-std=c++17",
+        _SRC_PATH, "-o", _SO_PATH,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.info("native build unavailable (%s); using numpy fallback", e)
+        return False
+
+
+def _load():
+    global _lib, _build_attempted
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build_attempted:
+            _build_attempted = True
+            _try_build()
+        if not os.path.exists(_SO_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("could not load %s: %s", _SO_PATH, e)
+            return None
+        lib.ar_accumulate.argtypes = [_f32p, _f32p, ctypes.c_int64]
+        lib.ar_masked_reduce.argtypes = [
+            _f32p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p,
+        ]
+        lib.ar_masked_reduce.restype = ctypes.c_float
+        lib.ar_average.argtypes = [_f32p, _i32p, _f32p, ctypes.c_int64]
+        lib.ar_elastic_update.argtypes = [
+            _f32p, _f32p, _i32p, ctypes.c_float, ctypes.c_int64,
+        ]
+        lib.ar_expand_counts.argtypes = [
+            _i32p, _i64p, ctypes.c_int64, _i32p, ctypes.c_int64,
+        ]
+        lib.ar_abi_version.restype = ctypes.c_int
+        if lib.ar_abi_version() != 1:
+            log.warning("native ABI mismatch; using numpy fallback")
+            return None
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(_f32p)
+
+
+def _ip(a: np.ndarray):
+    return a.ctypes.data_as(_i32p)
+
+
+def _writable_f32(a: np.ndarray, name: str) -> np.ndarray:
+    if a.dtype != np.float32 or not a.flags.c_contiguous or not a.flags.writeable:
+        raise ValueError(f"{name} must be writable C-contiguous float32")
+    return a
+
+
+def accumulate(dst: np.ndarray, src: np.ndarray) -> None:
+    """dst += src, in place (float32)."""
+    # numpy's in-place add is already optimal single-threaded; the native
+    # kernel only wins when OpenMP has cores to spread across (the fused
+    # kernels below win regardless, by skipping temporaries). Gate BEFORE
+    # _load(): small-buffer deployments must never pay the lazy first build.
+    if dst.size < 16384 or (os.cpu_count() or 1) < 2 or (lib := _load()) is None:
+        dst += src.astype(np.float32, copy=False)
+        return
+    _writable_f32(dst, "dst")
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    if src.shape != dst.shape:
+        raise ValueError(f"shape mismatch: {dst.shape} vs {src.shape}")
+    lib.ar_accumulate(_fp(dst), _fp(src), dst.size)
+
+
+def masked_reduce(srcs: np.ndarray, valid: np.ndarray) -> tuple[np.ndarray, float]:
+    """Fused ``(sum_j valid[j]*srcs[j], sum(valid))`` over ``srcs: (k, n)``."""
+    srcs = np.ascontiguousarray(srcs, dtype=np.float32)
+    valid = np.ascontiguousarray(valid, dtype=np.float32)
+    if srcs.ndim != 2 or valid.shape != (srcs.shape[0],):
+        raise ValueError(f"need srcs (k, n) and valid (k,); got {srcs.shape}, {valid.shape}")
+    lib = _load()
+    if lib is None:
+        out = (srcs * valid[:, None]).sum(axis=0, dtype=np.float32)
+        return out, float(valid.sum())
+    out = np.empty(srcs.shape[1], dtype=np.float32)
+    count = lib.ar_masked_reduce(
+        _fp(srcs), _fp(valid), srcs.shape[0], srcs.shape[1], _fp(out)
+    )
+    return out, float(count)
+
+
+def average(total: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """``total / counts`` where count > 0 else 0 (the consumer divide)."""
+    total = np.ascontiguousarray(total, dtype=np.float32)
+    counts = np.ascontiguousarray(counts, dtype=np.int32)
+    if counts.shape != total.shape:
+        raise ValueError(f"shape mismatch: {total.shape} vs {counts.shape}")
+    lib = _load()
+    if lib is None:
+        return np.where(
+            counts > 0, total / np.maximum(counts, 1), np.float32(0.0)
+        ).astype(np.float32)
+    out = np.empty_like(total)
+    lib.ar_average(_fp(total), _ip(counts), _fp(out), total.size)
+    return out
+
+
+def elastic_update(
+    w: np.ndarray, total: np.ndarray, counts: np.ndarray, alpha: float
+) -> None:
+    """In place: ``w <- (1-a)*w + a*total/counts`` where count > 0."""
+    _writable_f32(w, "w")
+    total = np.ascontiguousarray(total, dtype=np.float32)
+    counts = np.ascontiguousarray(counts, dtype=np.int32)
+    if total.shape != w.shape or counts.shape != w.shape:
+        raise ValueError("w, total, counts must all share one shape")
+    lib = _load()
+    if lib is None:
+        contributed = counts > 0
+        avg = total / np.maximum(counts, 1)
+        np.copyto(w, np.where(contributed, (1 - alpha) * w + alpha * avg, w))
+        return
+    lib.ar_elastic_update(_fp(w), _fp(total), _ip(counts), alpha, w.size)
+
+
+def expand_counts(
+    chunk_counts: np.ndarray, lengths: np.ndarray, n_out: int
+) -> np.ndarray:
+    """Per-chunk counts -> per-element counts (ReducedDataBuffer.get_with_counts)."""
+    chunk_counts = np.ascontiguousarray(chunk_counts, dtype=np.int32).reshape(-1)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64).reshape(-1)
+    if chunk_counts.shape != lengths.shape:
+        raise ValueError("chunk_counts and lengths must align")
+    lib = _load()
+    if lib is None:
+        out = np.zeros(n_out, dtype=np.int32)
+        rep = np.repeat(chunk_counts, lengths)[:n_out]
+        out[: rep.size] = rep  # zero-pad short inputs, same as the kernel
+        return out
+    out = np.zeros(n_out, dtype=np.int32)
+    lib.ar_expand_counts(
+        _ip(chunk_counts),
+        lengths.ctypes.data_as(_i64p),
+        chunk_counts.size,
+        _ip(out),
+        n_out,
+    )
+    return out

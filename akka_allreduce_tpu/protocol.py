@@ -146,6 +146,12 @@ class AllReduceOutput:
         object.__setattr__(self, "count", np.asarray(self.count, dtype=np.int32))
 
     def average(self) -> np.ndarray:
-        """Sum / count with zero-contribution elements left at 0."""
-        safe = np.maximum(self.count, 1).astype(np.float32)
-        return self.data / safe
+        """Sum / count with zero-contribution elements left at 0.
+
+        One implementation point for the consumer divide: the native engine's
+        fused kernel when built, numpy otherwise (both return exact 0 where
+        count == 0 — unfilled chunks hold zero sums anyway).
+        """
+        from akka_allreduce_tpu import native
+
+        return native.average(self.data, self.count)

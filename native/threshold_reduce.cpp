@@ -1,0 +1,85 @@
+// Host-side data-plane kernels for the threshold allreduce engine.
+//
+// The reference's reduction executor is a JVM float loop in
+// ScatteredDataBuffer.reduce (SURVEY.md §3 "Reduction executor"); on TPU the
+// ICI path replaces it with XLA's compiled AllReduce, but the *host* data
+// path — engine unit mode, the CPU fallback transport, and DCN-side chunk
+// staging — still sums float chunks on the CPU. These are those loops,
+// vectorized and OpenMP-parallel, exposed through a C ABI for ctypes
+// (no pybind11 in this toolchain).
+//
+// Contract notes:
+// - all arrays are dense float32/int32, C-contiguous (the Python side
+//   guarantees this);
+// - kernels parallelize across elements, so results are deterministic
+//   (each output element is produced by exactly one thread).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// dst[i] += src[i].  ScatteredDataBuffer.store's accumulate.
+void ar_accumulate(float* __restrict__ dst, const float* __restrict__ src, int64_t n) {
+#pragma omp parallel for schedule(static) if (n > 65536)
+  for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+// Fused masked reduce across k sources of length n:
+//   out_sum[i] = sum_j valid[j] * srcs[j*n + i]
+// returns sum(valid) (the contributor count).  The engine-mode equivalent of
+// masked_psum (comm/allreduce.py): one pass, no (k, n) temporary.
+float ar_masked_reduce(const float* __restrict__ srcs, const float* __restrict__ valid, int64_t k,
+                       int64_t n, float* out_sum) {
+#pragma omp parallel for schedule(static) if (n > 16384)
+  for (int64_t i = 0; i < n; ++i) {
+    float acc = 0.0f;
+    for (int64_t j = 0; j < k; ++j) acc += valid[j] * srcs[j * n + i];
+    out_sum[i] = acc;
+  }
+  float count = 0.0f;
+  for (int64_t j = 0; j < k; ++j) count += valid[j];
+  return count;
+}
+
+// out[i] = counts[i] > 0 ? sum[i] / counts[i] : 0 — the consumer-side divide
+// that turns (sum, count) into the partial average (SURVEY.md §3
+// "Collective semantics").  In-place allowed (out == sum).
+void ar_average(const float* __restrict__ sum, const int32_t* __restrict__ counts, float* __restrict__ out,
+                int64_t n) {
+#pragma omp parallel for schedule(static) if (n > 65536)
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = counts[i] > 0 ? sum[i] / static_cast<float>(counts[i]) : 0.0f;
+  }
+}
+
+// Elastic-averaging apply (binder/elastic.py):
+//   w[i] <- counts[i] > 0 ? (1 - a) * w[i] + a * sum[i] / counts[i] : w[i]
+void ar_elastic_update(float* __restrict__ w, const float* __restrict__ sum, const int32_t* __restrict__ counts,
+                       float alpha, int64_t n) {
+  const float keep = 1.0f - alpha;
+#pragma omp parallel for schedule(static) if (n > 65536)
+  for (int64_t i = 0; i < n; ++i) {
+    if (counts[i] > 0) {
+      w[i] = keep * w[i] + alpha * (sum[i] / static_cast<float>(counts[i]));
+    }
+  }
+}
+
+// Expand per-chunk counts to per-element counts:
+//   out[ chunk boundaries by lengths[c] ] = chunk_counts[c]
+// ReducedDataBuffer.get_with_counts's repeat.
+void ar_expand_counts(const int32_t* chunk_counts, const int64_t* lengths,
+                      int64_t n_chunks, int32_t* out, int64_t n_out) {
+  int64_t pos = 0;
+  for (int64_t c = 0; c < n_chunks && pos < n_out; ++c) {
+    int64_t len = lengths[c];
+    if (len > n_out - pos) len = n_out - pos;
+    for (int64_t i = 0; i < len; ++i) out[pos + i] = chunk_counts[c];
+    pos += len;
+  }
+}
+
+int ar_abi_version() { return 1; }
+
+}  // extern "C"
