@@ -28,68 +28,174 @@ _SRC_PATH = os.path.join(
     "threshold_reduce.cpp",
 )
 
+_ABI_VERSION = 2
+
 _lib = None
 _lock = threading.Lock()
-_build_attempted = False
+_build_thread: threading.Thread | None = None
+_load_failed = False
 
 _f32p = ctypes.POINTER(ctypes.c_float)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64p = ctypes.POINTER(ctypes.c_int64)
 
 
+# Canonical compile flags — native/Makefile shims to build() below, so this is
+# the single source of truth.
+_CXXFLAGS = ["-O3", "-fPIC", "-shared", "-fopenmp", "-Wall", "-std=c++17"]
+
+# accumulate() routes buffers smaller than this to numpy (in-place add is
+# already optimal single-threaded; OpenMP only wins with work to spread).
+_ACCUM_NATIVE_MIN = 16384
+
+
 def _try_build() -> bool:
     if not os.path.exists(_SRC_PATH):
         return False
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        "-O3", "-fPIC", "-shared", "-fopenmp", "-std=c++17",
-        _SRC_PATH, "-o", _SO_PATH,
-    ]
+    # Compile to a per-process-per-thread temp path, then rename into place:
+    # N worker processes (or a background build racing an explicit build())
+    # may compile concurrently, and os.replace is atomic on POSIX — nobody
+    # ever CDLLs a half-written file.
+    tmp = f"{_SO_PATH}.tmp.{os.getpid()}.{threading.get_ident()}"
+    cmd = [os.environ.get("CXX", "g++"), *_CXXFLAGS, _SRC_PATH, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
         return True
-    except (subprocess.SubprocessError, FileNotFoundError) as e:
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
         log.info("native build unavailable (%s); using numpy fallback", e)
         return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
-def _load():
-    global _lib, _build_attempted
+def _ensure_build(wait: bool) -> None:
+    """Kick off (or join) the one-time background build of the .so."""
+    global _build_thread
     with _lock:
-        if _lib is not None:
-            return _lib
-        if not os.path.exists(_SO_PATH) and not _build_attempted:
-            _build_attempted = True
-            _try_build()
+        if os.path.exists(_SO_PATH):
+            return
+        if _build_thread is None:
+            _build_thread = threading.Thread(
+                target=_try_build, name="native-build", daemon=True
+            )
+            _build_thread.start()
+        thread = _build_thread
+    if wait:
+        thread.join(timeout=150)
+
+
+def _bind(lib) -> None:
+    """Declare argtypes; raises AttributeError on a stale .so missing symbols."""
+    lib.ar_abi_version.restype = ctypes.c_int
+    lib.ar_accumulate.argtypes = [_f32p, _f32p, ctypes.c_int64]
+    lib.ar_average.argtypes = [_f32p, _i32p, _f32p, ctypes.c_int64]
+    lib.ar_elastic_update.argtypes = [
+        _f32p, _f32p, _i32p, ctypes.c_float, ctypes.c_int64,
+    ]
+    lib.ar_expand_counts.argtypes = [
+        _i32p, _i64p, ctypes.c_int64, _i32p, ctypes.c_int64,
+    ]
+
+
+def _load(*, build_wait: bool = False, _retried: bool = False):
+    """Return the bound library or None (numpy fallback).
+
+    Hot-path callers use the default ``build_wait=False``: a missing .so
+    starts ONE background compile and the caller falls back to numpy until it
+    lands — a round-completion path must never stall ~2min on a g++ run.
+    ``available()`` passes ``build_wait=True`` (explicit capability query).
+
+    A stale artifact (old ABI / missing symbols / corrupt ELF) is removed and
+    rebuilt from the current source once; only a failure with no way forward
+    (no toolchain, removal refused) latches ``_load_failed`` so hot paths
+    short-circuit without re-stat/re-dlopen per message.
+    """
+    global _lib, _load_failed, _build_thread
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    if not os.path.exists(_SO_PATH):
+        _ensure_build(wait=build_wait)
         if not os.path.exists(_SO_PATH):
+            with _lock:
+                # build thread finished and still no artifact: terminal
+                if (
+                    _build_thread is not None
+                    and not _build_thread.is_alive()
+                    and not os.path.exists(_SO_PATH)
+                ):
+                    _load_failed = True
             return None
+    retry = False
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        lib = None
         try:
             lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            log.warning("could not load %s: %s", _SO_PATH, e)
-            return None
-        lib.ar_accumulate.argtypes = [_f32p, _f32p, ctypes.c_int64]
-        lib.ar_masked_reduce.argtypes = [
-            _f32p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p,
-        ]
-        lib.ar_masked_reduce.restype = ctypes.c_float
-        lib.ar_average.argtypes = [_f32p, _i32p, _f32p, ctypes.c_int64]
-        lib.ar_elastic_update.argtypes = [
-            _f32p, _f32p, _i32p, ctypes.c_float, ctypes.c_int64,
-        ]
-        lib.ar_expand_counts.argtypes = [
-            _i32p, _i64p, ctypes.c_int64, _i32p, ctypes.c_int64,
-        ]
-        lib.ar_abi_version.restype = ctypes.c_int
-        if lib.ar_abi_version() != 1:
-            log.warning("native ABI mismatch; using numpy fallback")
-            return None
-        _lib = lib
-        return lib
+            _bind(lib)
+            if lib.ar_abi_version() != _ABI_VERSION:
+                raise AttributeError(
+                    f"ABI {lib.ar_abi_version()} != {_ABI_VERSION}"
+                )
+        except (OSError, AttributeError) as e:
+            log.warning("stale/unloadable %s (%s)", _SO_PATH, e)
+            if lib is not None:
+                # dlclose the failed handle: glibc dlopen dedupes by path, so
+                # a still-open stale image would shadow the rebuilt file
+                try:
+                    import _ctypes
+
+                    _ctypes.dlclose(lib._handle)
+                except Exception:  # pragma: no cover - best effort
+                    pass
+            removed = True
+            try:
+                os.remove(_SO_PATH)
+            except FileNotFoundError:
+                pass  # a concurrent loader already removed it — proceed
+            except OSError:
+                removed = False
+            if removed:
+                _build_thread = None  # allow a fresh build of current source
+                retry = not _retried
+            if not retry:
+                _load_failed = True
+                return None
+        else:
+            _lib = lib
+            return lib
+    # stale artifact removed: one rebuild + reload attempt (async unless the
+    # caller asked to wait)
+    return _load(build_wait=build_wait, _retried=True)
 
 
 def available() -> bool:
-    return _load() is not None
+    return _load(build_wait=True) is not None
+
+
+def build() -> bool:
+    """Force a synchronous rebuild from source (``make -C native`` shims here).
+
+    Returns True iff the library built and loaded.
+    """
+    global _lib, _load_failed, _build_thread
+    with _lock:
+        _lib = None
+        _load_failed = False
+        _build_thread = None
+        if os.path.exists(_SO_PATH):
+            try:
+                os.remove(_SO_PATH)
+            except OSError:
+                return False
+    return _try_build() and _load() is not None
 
 
 def _fp(a: np.ndarray):
@@ -112,7 +218,11 @@ def accumulate(dst: np.ndarray, src: np.ndarray) -> None:
     # kernel only wins when OpenMP has cores to spread across (the fused
     # kernels below win regardless, by skipping temporaries). Gate BEFORE
     # _load(): small-buffer deployments must never pay the lazy first build.
-    if dst.size < 16384 or (os.cpu_count() or 1) < 2 or (lib := _load()) is None:
+    if (
+        dst.size < _ACCUM_NATIVE_MIN
+        or (os.cpu_count() or 1) < 2
+        or (lib := _load()) is None
+    ):
         dst += src.astype(np.float32, copy=False)
         return
     _writable_f32(dst, "dst")
@@ -120,23 +230,6 @@ def accumulate(dst: np.ndarray, src: np.ndarray) -> None:
     if src.shape != dst.shape:
         raise ValueError(f"shape mismatch: {dst.shape} vs {src.shape}")
     lib.ar_accumulate(_fp(dst), _fp(src), dst.size)
-
-
-def masked_reduce(srcs: np.ndarray, valid: np.ndarray) -> tuple[np.ndarray, float]:
-    """Fused ``(sum_j valid[j]*srcs[j], sum(valid))`` over ``srcs: (k, n)``."""
-    srcs = np.ascontiguousarray(srcs, dtype=np.float32)
-    valid = np.ascontiguousarray(valid, dtype=np.float32)
-    if srcs.ndim != 2 or valid.shape != (srcs.shape[0],):
-        raise ValueError(f"need srcs (k, n) and valid (k,); got {srcs.shape}, {valid.shape}")
-    lib = _load()
-    if lib is None:
-        out = (srcs * valid[:, None]).sum(axis=0, dtype=np.float32)
-        return out, float(valid.sum())
-    out = np.empty(srcs.shape[1], dtype=np.float32)
-    count = lib.ar_masked_reduce(
-        _fp(srcs), _fp(valid), srcs.shape[0], srcs.shape[1], _fp(out)
-    )
-    return out, float(count)
 
 
 def average(total: np.ndarray, counts: np.ndarray) -> np.ndarray:

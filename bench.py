@@ -18,9 +18,12 @@ Environment hardening (the chip is reached through a tunnel):
 - benchmark data is generated ON DEVICE (host->device transfers over the
   tunnel run at ~10-25 MB/s and would dominate or wedge the run);
 - sync is a 4-byte ``device_get`` (``block_until_ready`` returns without
-  waiting on this backend); measured tunnel RTT is subtracted;
-- the collective is iterated inside one jitted ``fori_loop`` so per-call RTT
-  amortizes over ``inner`` iterations;
+  waiting on this backend);
+- the collective is iterated inside one jitted ``fori_loop`` with a *traced*
+  trip count, and per-iteration time is the slope between a short and a long
+  run: ``(t(inner_hi) - t(inner_lo)) / (inner_hi - inner_lo)``. The constant
+  tunnel RTT + dispatch overhead cancels in the difference, which a one-shot
+  RTT subtraction cannot do reliably when RTT jitter exceeds compute time;
 - a watchdog alarm still emits a well-formed JSON line if the device wedges.
 
 vs_baseline: the reference's data plane is JVM float chunks over Netty TCP
@@ -56,8 +59,9 @@ def _emit(metric: str, value: float) -> None:
 
 def main() -> None:
     num_floats = int(os.environ.get("BENCH_FLOATS", 64 * 1024 * 1024))
-    inner = int(os.environ.get("BENCH_INNER", 20))
-    outer = int(os.environ.get("BENCH_OUTER", 3))
+    inner_lo = int(os.environ.get("BENCH_INNER_LO", 5))
+    inner_hi = int(os.environ.get("BENCH_INNER_HI", 105))
+    outer = int(os.environ.get("BENCH_OUTER", 4))
     watchdog_s = int(os.environ.get("BENCH_TIMEOUT", 480))
     mfloat = num_floats // (1024 * 1024)
 
@@ -81,7 +85,8 @@ def main() -> None:
     devices = jax.devices()
     n = len(devices)
     print(
-        f"devices={n} ({devices[0].platform}), floats={num_floats}, inner={inner}",
+        f"devices={n} ({devices[0].platform}), floats={num_floats}, "
+        f"inner={inner_lo}/{inner_hi}",
         file=sys.stderr,
     )
 
@@ -106,7 +111,7 @@ def main() -> None:
                 jax.device_put(jnp.ones((n,)), NamedSharding(mesh, spec)),
             )
 
-        def kernel(x, valid):
+        def kernel(x, valid, trips):
             v = valid.reshape(())
 
             def body(_, carry):
@@ -114,10 +119,17 @@ def main() -> None:
                 avg = s / jnp.maximum(c, 1.0)
                 return lax.pcast(avg, "line", to="varying")
 
-            return lax.fori_loop(0, inner, body, x.reshape(x.shape[-1]))[None]
+            return lax.fori_loop(
+                0, trips.reshape(()), body, x.reshape(x.shape[-1])
+            )[None]
 
         fn = jax.jit(
-            jax.shard_map(kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(spec, spec, P()),
+                out_specs=spec,
+            )
         )
         metric = f"allreduce_bus_bw_{mfloat}Mfloat"
         scale = 2.0 * (n - 1) / n * num_floats * 4
@@ -134,7 +146,7 @@ def main() -> None:
                 jnp.ones((K,)),
             )
 
-        def kernel(X, V):
+        def kernel(X, V, trips):
             c = jnp.maximum(V.sum(), 1.0)
 
             def body(_, X):
@@ -143,7 +155,7 @@ def main() -> None:
                 # re-writes the whole buffer (no loop-invariant hoisting)
                 return X - avg[None] / K
 
-            return lax.fori_loop(0, inner, body, X)
+            return lax.fori_loop(0, trips, body, X)
 
         fn = jax.jit(kernel)
         metric = f"local_threshold_reduce_bw_{mfloat}Mfloat"
@@ -151,28 +163,36 @@ def main() -> None:
 
     args = init()
     sync(args[0])
-    t0 = time.perf_counter()
-    sync(args[0])
-    rtt = time.perf_counter() - t0
-    print(f"tunnel rtt={rtt * 1000:.1f}ms", file=sys.stderr)
 
-    out = fn(*args)
-    sync(out)  # compile + first run
-
-    best = float("inf")
-    for _ in range(outer):
+    def run(trips: int) -> float:
         t0 = time.perf_counter()
-        out = fn(*args)
+        out = fn(*args, jnp.int32(trips))
         sync(out)
-        dt = (time.perf_counter() - t0 - rtt) / inner
-        if dt > 0:  # rtt jitter can overshoot; discard nonsense samples
-            best = min(best, dt)
+        return time.perf_counter() - t0
+
+    run(inner_lo)  # compile + warm both trip counts
+    run(inner_hi)
+
+    # Tunnel jitter hits a *difference* of two timings from both sides, so
+    # min() over slope samples would keep the single most optimistic outlier
+    # and inflate bandwidth. Instead pair the best (least-delayed) observation
+    # of each trip count: delays only ever add, so min(t_hi) - min(t_lo) is
+    # the least-contaminated slope.
+    lows, highs = [], []
+    for _ in range(outer):
+        lows.append(run(inner_lo))
+        highs.append(run(inner_hi))
+        print(
+            f"t_lo={lows[-1] * 1e3:.1f}ms t_hi={highs[-1] * 1e3:.1f}ms",
+            file=sys.stderr,
+        )
+    dt = (min(highs) - min(lows)) / (inner_hi - inner_lo)
 
     signal.alarm(0)
-    if best == float("inf"):
+    if dt <= 0:
         _emit(f"allreduce_bench_UNMEASURABLE_{mfloat}Mfloat", 0.0)
         return
-    _emit(metric, scale / best / 1e9)
+    _emit(metric, scale / dt / 1e9)
 
 
 if __name__ == "__main__":

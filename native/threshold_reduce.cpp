@@ -25,23 +25,6 @@ void ar_accumulate(float* __restrict__ dst, const float* __restrict__ src, int64
   for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
 }
 
-// Fused masked reduce across k sources of length n:
-//   out_sum[i] = sum_j valid[j] * srcs[j*n + i]
-// returns sum(valid) (the contributor count).  The engine-mode equivalent of
-// masked_psum (comm/allreduce.py): one pass, no (k, n) temporary.
-float ar_masked_reduce(const float* __restrict__ srcs, const float* __restrict__ valid, int64_t k,
-                       int64_t n, float* out_sum) {
-#pragma omp parallel for schedule(static) if (n > 16384)
-  for (int64_t i = 0; i < n; ++i) {
-    float acc = 0.0f;
-    for (int64_t j = 0; j < k; ++j) acc += valid[j] * srcs[j * n + i];
-    out_sum[i] = acc;
-  }
-  float count = 0.0f;
-  for (int64_t j = 0; j < k; ++j) count += valid[j];
-  return count;
-}
-
 // out[i] = counts[i] > 0 ? sum[i] / counts[i] : 0 — the consumer-side divide
 // that turns (sum, count) into the partial average (SURVEY.md §3
 // "Collective semantics").  In-place allowed (out == sum).
@@ -80,6 +63,6 @@ void ar_expand_counts(const int32_t* chunk_counts, const int64_t* lengths,
   }
 }
 
-int ar_abi_version() { return 1; }
+int ar_abi_version() { return 2; }
 
 }  // extern "C"

@@ -17,12 +17,10 @@ def engine(request, monkeypatch):
             pytest.skip("native library not built and no toolchain")
         # force the native branch even on 1-core machines / small sizes
         monkeypatch.setattr(native.os, "cpu_count", lambda: 8)
+        monkeypatch.setattr(native, "_ACCUM_NATIVE_MIN", 0)
     else:
         monkeypatch.setattr(native, "_lib", None)
-        monkeypatch.setattr(native, "_build_attempted", True)
-        monkeypatch.setattr(
-            native, "_load", lambda: None
-        )
+        monkeypatch.setattr(native, "_load_failed", True)
     return request.param
 
 
@@ -37,13 +35,6 @@ class TestKernels:
             ref = dst + src
             native.accumulate(dst, src)
             np.testing.assert_allclose(dst, ref, rtol=1e-6)
-
-    def test_masked_reduce(self, engine):
-        X = RNG.standard_normal((5, 1000)).astype(np.float32)
-        v = np.array([1, 0, 1, 1, 0], np.float32)
-        s, c = native.masked_reduce(X, v)
-        np.testing.assert_allclose(s, (X * v[:, None]).sum(0), rtol=1e-5)
-        assert c == 3.0
 
     def test_average_zero_counts_read_zero(self, engine):
         total = RNG.standard_normal(100).astype(np.float32)
@@ -73,8 +64,6 @@ class TestKernels:
 
     def test_shape_validation(self, engine):
         with pytest.raises(ValueError):
-            native.masked_reduce(np.zeros((2, 3), np.float32), np.zeros(3, np.float32))
-        with pytest.raises(ValueError):
             native.average(np.zeros(4, np.float32), np.zeros(5, np.int32))
         with pytest.raises(ValueError):
             native.elastic_update(
@@ -90,4 +79,38 @@ class TestBuildMachinery:
 
     def test_abi_guard(self):
         if native._lib is not None:
-            assert native._lib.ar_abi_version() == 1
+            assert native._lib.ar_abi_version() == native._ABI_VERSION
+
+    def test_stale_so_rebuilds_from_source(self, tmp_path, monkeypatch):
+        # a .so missing symbols (stale revision) must be removed and rebuilt
+        # from the current source — not crash, not latch the fallback forever
+        import subprocess
+
+        src = tmp_path / "empty.cpp"
+        src.write_text('extern "C" int unrelated() { return 0; }\n')
+        so = tmp_path / "stale.so"
+        try:
+            subprocess.run(
+                ["g++", "-shared", "-fPIC", str(src), "-o", str(so)],
+                check=True, capture_output=True, timeout=60,
+            )
+        except Exception:
+            pytest.skip("no toolchain")
+        monkeypatch.setattr(native, "_SO_PATH", str(so))
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        monkeypatch.setattr(native, "_build_thread", None)
+        lib = native._load(build_wait=True)
+        assert lib is not None and lib.ar_abi_version() == native._ABI_VERSION
+        assert not native._load_failed
+
+    def test_no_toolchain_latches_fallback(self, tmp_path, monkeypatch):
+        # with no .so and no way to build one, the failure is cached so hot
+        # paths don't re-stat / re-lock per message
+        monkeypatch.setattr(native, "_SO_PATH", str(tmp_path / "none.so"))
+        monkeypatch.setattr(native, "_SRC_PATH", str(tmp_path / "none.cpp"))
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        monkeypatch.setattr(native, "_build_thread", None)
+        assert native._load(build_wait=True) is None
+        assert native._load_failed
