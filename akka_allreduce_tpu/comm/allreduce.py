@@ -24,6 +24,9 @@ Schedules:
 - ``"ring"``      — explicit ppermute ring (reduce-scatter + all-gather),
   the reference's "ring schedule" for large chunked buffers (BASELINE.json:9);
   also the substrate for later overlap/pipelining work.
+- ``"pallas_ring"`` — the same ring schedule as a Pallas remote-DMA kernel
+  (ops/ring.py): double-buffered ICI transfers with semaphore back-pressure,
+  streamed through VMEM in max_chunk_size-ish buckets.
 """
 
 from __future__ import annotations
@@ -220,12 +223,12 @@ def build_threshold_allreduce(
             "partial-axis reduction call masked_psum inside your own shard_map."
         )
     n_devices = int(np.prod([mesh.shape[a] for a in axis_names]))
-    if schedule not in ("psum", "butterfly", "ring"):
+    if schedule not in ("psum", "butterfly", "ring", "pallas_ring"):
         raise ValueError(f"unknown schedule {schedule!r}")
     if schedule == "butterfly" and len(axis_names) < 2:
         raise ValueError("butterfly schedule needs a 2D grid mesh")
-    if schedule == "ring" and len(axis_names) != 1:
-        raise ValueError("ring schedule reduces over exactly one axis")
+    if schedule in ("ring", "pallas_ring") and len(axis_names) != 1:
+        raise ValueError("ring schedules reduce over exactly one axis")
 
     spec_in = P(axis_names if len(axis_names) > 1 else axis_names[0])
 
@@ -240,7 +243,7 @@ def build_threshold_allreduce(
             v = jnp.full((_num_buckets(data_size, bucket_size),), v)
         if bucket_size is None and v.ndim != 0:
             raise ValueError("per-bucket valid mask requires bucket_size")
-        if schedule == "ring":
+        if schedule in ("ring", "pallas_ring"):
             if v.ndim == 0:
                 vx = x * v
             else:
@@ -248,7 +251,16 @@ def build_threshold_allreduce(
                 pad = n_buckets * bucket_size - data_size
                 xp = jnp.pad(x, (0, pad)).reshape(n_buckets, bucket_size)
                 vx = (xp * v[:, None]).reshape(-1)[:data_size]
-            total = ring_allreduce_sum(vx, axis_names[0], n_devices)
+            if schedule == "pallas_ring":
+                from akka_allreduce_tpu.ops.ring import (
+                    pallas_ring_allreduce_sum,
+                )
+
+                total = pallas_ring_allreduce_sum(
+                    vx, axis_names[0], n_devices
+                )
+            else:
+                total = ring_allreduce_sum(vx, axis_names[0], n_devices)
             count = lax.psum(jnp.asarray(v, x.dtype), axis_names)
         elif schedule == "butterfly":
             total, count = _staged_masked_psum(x, v, axis_names, bucket_size)
@@ -261,9 +273,9 @@ def build_threshold_allreduce(
         mesh=mesh,
         in_specs=(spec_in, spec_in),
         out_specs=(P(), P()),
-        # The ring's ppermute all-gather produces a replicated result, but the
-        # static varying-axes check cannot prove it; the numeric tests do.
-        check_vma=(schedule != "ring"),
+        # The rings' all-gather produces a replicated result, but the static
+        # varying-axes check cannot prove it; the numeric tests do.
+        check_vma=(schedule not in ("ring", "pallas_ring")),
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 

@@ -1,0 +1,30 @@
+"""Pallas TPU kernels for the framework's hot data-plane ops.
+
+Two kernel families:
+
+- :mod:`.local_reduce` — fused single-chip threshold reduce: masked average
+  and the elastic-average step over K stacked payloads in ONE pass over HBM
+  (XLA needs two: one to form the average, one to apply it).
+- :mod:`.ring` — an explicit inter-chip ring allreduce built on Pallas remote
+  DMA with double-buffered slots and semaphore back-pressure; the compiled
+  equivalent of the reference's chunked ring schedule (SURVEY.md §3
+  "ring/chunked schedule", BASELINE.json:9) and the substrate for later
+  comm/compute overlap.
+
+All kernels run in TPU interpret mode on the CPU test backend (including the
+interpreter's race detector), so "multi-chip" kernel behavior is tested
+without hardware, mirroring the reference's probe-based test philosophy
+(SURVEY.md §5).
+"""
+
+from akka_allreduce_tpu.ops.local_reduce import (
+    elastic_average_step,
+    masked_average,
+)
+from akka_allreduce_tpu.ops.ring import pallas_ring_allreduce_sum
+
+__all__ = [
+    "elastic_average_step",
+    "masked_average",
+    "pallas_ring_allreduce_sum",
+]

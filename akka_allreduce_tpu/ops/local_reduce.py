@@ -1,0 +1,162 @@
+"""Fused single-chip threshold-reduce kernels.
+
+The engine-unit-mode hot op: K worker payloads stacked as ``(K, data)`` are
+masked-summed, counted, divided, and (optionally) folded back into every
+replica — the reference's ``ScatteredDataBuffer.reduce`` + consumer divide +
+``ElasticAverageBinder`` apply (SURVEY.md §3), executed on-chip.
+
+Why Pallas: XLA lowers ``avg = (X*V).sum(0)/c; X' = (1-a)X + a*avg`` to two
+passes over ``X`` in HBM (the column average is a full reduction, so the
+update cannot start until it finishes — globally). Per column *tile* the
+dependency is local, so one kernel pass reads a ``(K, tr, 128)`` tile,
+reduces it, and applies the update before moving on: 1 read + 1 write of X
+instead of 2 reads + 1 write. On HBM-bound sizes that is the difference
+between ~1/3 and ~1/2 of peak bandwidth on the bench's headline op.
+
+The same kernels run under the Pallas TPU interpreter on the CPU test
+backend; numeric oracle is numpy masked-sum/count (tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+_DEF_ROWS = 512  # 512*128 fp32 = 256 KB per K-slice tile
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to_tiles(x: jax.Array, rows: int) -> tuple[jax.Array, int]:
+    """(K, data) -> (K, n_tiles*rows, LANE), zero-padded."""
+    k, data = x.shape
+    tile_elems = rows * LANE
+    n_tiles = max(1, -(-data // tile_elems))
+    padded = n_tiles * tile_elems
+    x = jnp.pad(x, ((0, 0), (0, padded - data)))
+    return x.reshape(k, n_tiles * rows, LANE), n_tiles
+
+
+def _avg_kernel(x_ref, v_ref, avg_ref, cnt_ref):
+    # x: (K, rows, LANE) tile; v: (K, 1) in SMEM-ish vmem; avg: (rows, LANE)
+    v = v_ref[:]  # (K, 1)
+    masked = x_ref[:] * v[:, :, None]
+    total = jnp.sum(masked, axis=0)
+    count = jnp.sum(v)
+    cnt_ref[0, 0] = count
+    avg_ref[:] = total / jnp.maximum(count, 1.0)
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
+def _masked_average_impl(x, valid, *, rows: int, interpret: bool):
+    k, data = x.shape
+    xt, n_tiles = _pad_to_tiles(x, rows)
+    v2 = valid.reshape(k, 1).astype(x.dtype)
+    avg, cnt = pl.pallas_call(
+        _avg_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (k, rows, LANE), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((k, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_tiles * rows, LANE), x.dtype),
+            jax.ShapeDtypeStruct((1, 1), x.dtype),
+        ],
+        interpret=interpret,
+    )(xt, v2)
+    return avg.reshape(-1)[:data], cnt[0, 0]
+
+
+def masked_average(
+    x: jax.Array,
+    valid: jax.Array,
+    *,
+    rows: int = _DEF_ROWS,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """One-pass threshold reduce of K stacked payloads.
+
+    Args:
+      x: ``(K, data)`` float payloads (one row per virtual worker).
+      valid: ``(K,)`` 0/1 contribution mask.
+    Returns:
+      ``(avg, count)``: ``avg[i] = sum_k v_k x_k[i] / max(count, 1)``.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    return _masked_average_impl(
+        x, valid, rows=rows, interpret=bool(interpret)
+    )
+
+
+def _elastic_kernel(x_ref, v_ref, alpha_ref, out_ref):
+    v = v_ref[:]  # (K, 1)
+    alpha = alpha_ref[0]
+    masked = x_ref[:] * v[:, :, None]
+    count = jnp.sum(v)
+    avg = jnp.sum(masked, axis=0) / jnp.maximum(count, 1.0)
+    # count == 0: nobody contributed this round; replicas keep their state
+    # (binder/elastic.py semantics — counts>0 gates the update)
+    keep = jnp.where(count > 0.0, 1.0 - alpha, 1.0).astype(x_ref.dtype)
+    pull = jnp.where(count > 0.0, alpha, 0.0).astype(x_ref.dtype)
+    out_ref[:] = keep * x_ref[:] + pull * avg[None]
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
+def _elastic_step_impl(x, valid, alpha, *, rows: int, interpret: bool):
+    k, data = x.shape
+    xt, n_tiles = _pad_to_tiles(x, rows)
+    v2 = valid.reshape(k, 1).astype(x.dtype)
+    a = jnp.asarray(alpha, x.dtype).reshape(1)
+    out = pl.pallas_call(
+        _elastic_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (k, rows, LANE), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((k, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (k, rows, LANE), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(xt.shape, x.dtype),
+        interpret=interpret,
+    )(xt, v2, a)
+    return out.reshape(k, -1)[:, :data]
+
+
+def elastic_average_step(
+    x: jax.Array,
+    valid: jax.Array,
+    alpha: float | jax.Array,
+    *,
+    rows: int = _DEF_ROWS,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused elastic-averaging round over K local replicas, one HBM pass.
+
+    ``x' = (1-alpha) * x + alpha * avg`` where ``avg`` is the threshold-masked
+    contributor average; if no replica contributed (``sum(valid) == 0``) the
+    state is returned unchanged. Shapes as :func:`masked_average`.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    return _elastic_step_impl(
+        x, valid, alpha, rows=rows, interpret=bool(interpret)
+    )

@@ -1,0 +1,167 @@
+"""Explicit ring allreduce as a Pallas remote-DMA kernel.
+
+The reference's large-buffer path is a *chunked ring schedule*
+(BASELINE.json:9 — ResNet-50's 25M-param buffer): each worker passes chunks
+around a ring, accumulating as they go. Here that schedule is a compiled TPU
+kernel: reduce-scatter then all-gather over the ICI ring, double-buffered
+remote DMA per step, with explicit semaphore back-pressure so a fast neighbor
+can never overwrite a slot that has not been consumed yet (the Pallas
+interpreter's race detector verifies this in tests/test_ops.py — it catches
+the naive two-slot version without back-pressure).
+
+Payloads are processed in VMEM-resident *buckets* — the framework's
+``max_chunk_size`` granularity (SURVEY.md §3 "chunked buffers") doubles as
+the VMEM staging size, so arbitrarily large buffers stream through a fixed
+on-chip footprint.
+
+Call inside ``shard_map``. For the host-facing entry use
+``comm.allreduce.build_threshold_allreduce(schedule="pallas_ring")``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+_DEF_SEG_ROWS = 512  # per-step transfer: 512*128 fp32 = 256 KB
+_LOGICAL = pltpu.DeviceIdType.LOGICAL
+
+
+def _ring_kernel(n: int, axis_name: str, x_ref, out_ref, recv_buf, send_sem,
+                 recv_sem, cap_sem):
+    """One bucket: (n*seg_rows, LANE) in VMEM -> allreduced same shape.
+
+    Unified reduce-scatter + all-gather loop, 2(n-1) steps. Step s:
+      RS (s < n-1):   send seg (my-s) % n, accumulate into seg (my-s-1) % n
+      AG (s >= n-1):  send seg (my+1-s') % n, copy into seg (my-s') % n
+                      with s' = s - (n-1)
+    Back-pressure: two recv slots; before reusing a slot (s >= 2) wait until
+    the right neighbor consumed what we wrote there two steps ago; after
+    consuming a slot, signal the left neighbor. Signals are emitted only for
+    steps that have a matching wait (s <= S-3), so every semaphore drains to
+    zero by kernel end.
+    """
+    seg_rows = x_ref.shape[0] // n
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my - 1 + n, n)
+
+    # Neighbor barrier: nobody starts DMAing until both neighbors are in the
+    # kernel (their buffers exist and their semaphores are live).
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=_LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=_LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    out_ref[:] = x_ref[:]
+    total_steps = 2 * (n - 1)
+
+    def step(s, _):
+        sp = s - (n - 1)  # all-gather step index (valid when s >= n-1)
+        rs = s < n - 1
+        send_idx = lax.rem(jnp.where(rs, my - s, my + 1 - sp) + 2 * n, n)
+        recv_idx = lax.rem(jnp.where(rs, my - s - 1, my - sp) + 2 * n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(cap_sem, 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[pl.ds(send_idx * seg_rows, seg_rows)],
+            dst_ref=recv_buf.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=_LOGICAL,
+        )
+        rdma.start()
+        # wait() blocks on BOTH our send completing and the symmetric
+        # incoming copy from the left neighbor landing in recv_buf[slot]
+        rdma.wait()
+
+        dst = pl.ds(recv_idx * seg_rows, seg_rows)
+
+        @pl.when(rs)
+        def _():
+            out_ref[dst] = out_ref[dst] + recv_buf[slot]
+
+        @pl.when(jnp.logical_not(rs))
+        def _():
+            out_ref[dst] = recv_buf[slot]
+
+        # slot consumed: left neighbor may overwrite it (their step s+2)
+        @pl.when(s <= total_steps - 3)
+        def _():
+            pltpu.semaphore_signal(cap_sem, inc=1, device_id=left,
+                                   device_id_type=_LOGICAL)
+        return 0
+
+    lax.fori_loop(0, total_steps, step, 0)
+
+
+def pallas_ring_allreduce_sum(
+    x: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    *,
+    seg_rows: int = _DEF_SEG_ROWS,
+    interpret: bool | None = None,
+    detect_races: bool = False,
+) -> jax.Array:
+    """Ring-allreduce ``sum(x)`` over ``axis_name`` inside ``shard_map``.
+
+    ``x`` is this device's flat ``(data,)`` payload. Data is padded to whole
+    buckets of ``axis_size * seg_rows * LANE`` elements; buckets stream
+    sequentially through one VMEM-resident kernel launch each.
+
+    ``interpret`` defaults to True off-TPU (the Pallas TPU interpreter), so
+    the same kernel is testable on the CPU mesh; ``detect_races=True`` turns
+    on the interpreter's race detector (tests only — it is slow).
+    """
+    n = axis_size
+    if n == 1:
+        return x
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    data = x.shape[0]
+    bucket = n * seg_rows * LANE
+    n_buckets = max(1, -(-data // bucket))
+    x = jnp.pad(x, (0, n_buckets * bucket - data))
+    xb = x.reshape(n_buckets, n * seg_rows, LANE)
+
+    if interpret:
+        interp = pltpu.InterpretParams(detect_races=detect_races)
+    else:
+        interp = False
+
+    call = pl.pallas_call(
+        functools.partial(_ring_kernel, n, axis_name),
+        out_shape=jax.ShapeDtypeStruct((n * seg_rows, LANE), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, seg_rows, LANE), x.dtype),  # recv slots
+            pltpu.SemaphoreType.DMA((2,)),  # send
+            pltpu.SemaphoreType.DMA((2,)),  # recv
+            pltpu.SemaphoreType.REGULAR,  # capacity (back-pressure)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=7
+        ),
+        interpret=interp,
+    )
+
+    def one_bucket(carry, xi):
+        return carry, call(xi)
+
+    _, out = lax.scan(one_bucket, 0, xb)
+    return out.reshape(-1)[:data]

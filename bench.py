@@ -8,11 +8,13 @@ BASELINE.json:8) across every visible device.
 - n devices >= 2: bus bandwidth 2*(n-1)/n * bytes / t of the ICI collective.
 - n == 1 (the single-chip CI reality): a 1-device psum folds to a no-op, so we
   measure the round's actual reduction work instead — K=8 virtual workers'
-  payloads threshold-reduced (masked sum + count + divide) on-chip, with the
-  buffer updated every iteration so XLA cannot hoist work out of the timing
-  loop. This is the direct analog of the reference's local-worker configs
-  (BASELINE.json:7: "4 local JVM workers" reducing inside one JVM); value is
-  input bytes reduced per second.
+  payloads threshold-reduced and elastic-averaged on-chip via the fused
+  Pallas kernel (ops/local_reduce.py: one HBM pass instead of XLA's two),
+  with the buffer updated every iteration so nothing hoists out of the
+  timing loop. This is the direct analog of the reference's local-worker
+  configs (BASELINE.json:7: "4 local JVM workers" reducing inside one JVM);
+  value is input bytes reduced per second. Set BENCH_XLA=1 to time the
+  unfused XLA lowering of the same op for comparison.
 
 Environment hardening (the chip is reached through a tunnel):
 - benchmark data is generated ON DEVICE (host->device transfers over the
@@ -146,16 +148,28 @@ def main() -> None:
                 jnp.ones((K,)),
             )
 
-        def kernel(X, V, trips):
-            c = jnp.maximum(V.sum(), 1.0)
+        use_xla = os.environ.get("BENCH_XLA", "0") == "1"
+        alpha = jnp.float32(0.125)
 
-            def body(_, X):
-                avg = (X * V[:, None]).sum(0) / c  # the threshold reduce
-                # fold the average back in so each iteration re-reads and
-                # re-writes the whole buffer (no loop-invariant hoisting)
-                return X - avg[None] / K
+        if use_xla:
 
-            return lax.fori_loop(0, trips, body, X)
+            def kernel(X, V, trips):
+                c = jnp.maximum(V.sum(), 1.0)
+
+                def body(_, X):
+                    avg = (X * V[:, None]).sum(0) / c
+                    return (1.0 - alpha) * X + alpha * avg[None]
+
+                return lax.fori_loop(0, trips, body, X)
+
+        else:
+            from akka_allreduce_tpu.ops import elastic_average_step
+
+            def kernel(X, V, trips):
+                def body(_, X):
+                    return elastic_average_step(X, V, alpha)
+
+                return lax.fori_loop(0, trips, body, X)
 
         fn = jax.jit(kernel)
         metric = f"local_threshold_reduce_bw_{mfloat}Mfloat"
