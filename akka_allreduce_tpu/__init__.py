@@ -26,9 +26,10 @@ Layout (mirrors SURVEY.md §2's layer map):
 - ``comm``     — ICI data plane: mesh, bucketing, masked allreduce, schedules
 - ``control``  — LineMaster / GridMaster / membership / worker engine
 - ``binder``   — dataSource/dataSink integration seam (grad-sync, elastic-average)
-- ``models``   — MLP (MNIST) and ResNet-50 model families
-- ``train``    — data-parallel trainer, checkpointing, metrics
-- ``ops``      — Pallas/XLA kernels for the hot ops
+- ``models``   — MLP (MNIST), ResNet-50, and Transformer LM model families
+- ``train``    — data-parallel + long-context (DP x SP) trainers, checkpointing
+- ``ops``      — Pallas/XLA kernels for the hot ops; ring attention / Ulysses
+  sequence parallelism for long-context (beyond the reference, SURVEY.md §6)
 - ``parallel`` — mesh + sharding helpers
 - ``utils``    — logging, metrics JSONL, timing
 """

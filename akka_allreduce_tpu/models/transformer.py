@@ -1,0 +1,144 @@
+"""Decoder-only Transformer LM with pluggable sequence/context parallelism.
+
+A model family the reference does not have (its workloads stop at MLP and
+ResNet-50 — SURVEY.md §2 L5); it exists here because long-context is
+first-class in the TPU rebuild. Designed TPU-first:
+
+- all heavy math is batched matmul (MXU-shaped), optional bfloat16 compute
+  with fp32 params/logits;
+- rotary position embeddings, so a sequence-sharded device needs only its
+  integer global offset — no position-table gather crossing shards;
+- attention dispatches on ``seq_axis``: ``None`` -> dense single-device;
+  otherwise ring attention or Ulysses all-to-all over that mesh axis
+  (ops/ring_attention.py), making the SAME module runnable under ``shard_map``
+  with the sequence dimension sharded across the ICI ring. The shard count is
+  read from the mesh itself (``lax.axis_size``), so the module cannot drift
+  out of sync with the mesh it runs under.
+
+When ``seq_axis`` is set the module must be applied inside ``shard_map`` with
+that axis in scope; ``__call__`` then takes this device's (B, T_local) token
+shard.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from akka_allreduce_tpu.ops.ring_attention import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def rope(x: jax.Array, offset: jax.Array | int, *, base: float = 10000.0):
+    """Rotary embedding over the last (even) dim; positions = offset + arange(T).
+
+    ``x``: (B, T, H, D). Pure elementwise after a cos/sin table build, so XLA
+    fuses it into the surrounding projections.
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head dim, got {d}")
+    pos = offset + jnp.arange(x.shape[1])
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]  # (T, D/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate((x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    """Causal multi-head self-attention with RoPE and SP dispatch."""
+
+    n_heads: int
+    seq_axis: str | None = None
+    seq_impl: str = "ring"  # "ring" | "ulysses"
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        if d_model % self.n_heads:
+            raise ValueError(f"{d_model=} not divisible by {self.n_heads=}")
+        head = d_model // self.n_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.n_heads, head),
+            dtype=self.compute_dtype,
+            name=name,
+        )
+        q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
+
+        if self.seq_axis is None:
+            offset = 0
+        else:
+            offset = lax.axis_index(self.seq_axis) * x.shape[1]
+        q, k = rope(q, offset), rope(k, offset)
+
+        if self.seq_axis is None:
+            out = attention_reference(q, k, v, causal=True)
+        elif self.seq_impl == "ring":
+            out = ring_attention(q, k, v, self.seq_axis, causal=True)
+        elif self.seq_impl == "ulysses":
+            out = ulysses_attention(q, k, v, self.seq_axis, causal=True)
+        else:
+            raise ValueError(f"unknown seq_impl {self.seq_impl!r}")
+        return nn.DenseGeneral(
+            d_model, axis=(-2, -1), dtype=self.compute_dtype, name="out"
+        )(out)
+
+
+class Block(nn.Module):
+    n_heads: int
+    mlp_ratio: int = 4
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        x = x + Attention(
+            self.n_heads,
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
+            compute_dtype=self.compute_dtype,
+        )(h)
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        h = nn.Dense(self.mlp_ratio * d_model, dtype=self.compute_dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(d_model, dtype=self.compute_dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """Tokens (B, T_local) int32 -> logits (B, T_local, vocab) fp32."""
+
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    mlp_ratio: int = 4
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab, self.d_model, dtype=self.compute_dtype)(tokens)
+        for _ in range(self.n_layers):
+            x = Block(
+                self.n_heads,
+                mlp_ratio=self.mlp_ratio,
+                seq_axis=self.seq_axis,
+                seq_impl=self.seq_impl,
+                compute_dtype=self.compute_dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        logits = nn.Dense(self.vocab, dtype=self.compute_dtype)(x)
+        return logits.astype(jnp.float32)

@@ -1,6 +1,6 @@
-"""Pallas TPU kernels for the framework's hot data-plane ops.
+"""Pallas/XLA kernels for the framework's hot data-plane ops.
 
-Two kernel families:
+Three families:
 
 - :mod:`.local_reduce` — fused single-chip threshold reduce: masked average
   and the elastic-average step over K stacked payloads in ONE pass over HBM
@@ -10,6 +10,10 @@ Two kernel families:
   equivalent of the reference's chunked ring schedule (SURVEY.md §3
   "ring/chunked schedule", BASELINE.json:9) and the substrate for later
   comm/compute overlap.
+- :mod:`.ring_attention` — long-context sequence parallelism: blockwise ring
+  attention (K/V rotating over ICI via ppermute, flash-style online softmax)
+  and Ulysses all-to-all head/sequence re-sharding. No analog in the
+  reference (SURVEY.md §6 — long-context is ABSENT there).
 
 All kernels run in TPU interpret mode on the CPU test backend (including the
 interpreter's race detector), so "multi-chip" kernel behavior is tested
@@ -22,9 +26,17 @@ from akka_allreduce_tpu.ops.local_reduce import (
     masked_average,
 )
 from akka_allreduce_tpu.ops.ring import pallas_ring_allreduce_sum
+from akka_allreduce_tpu.ops.ring_attention import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
+    "attention_reference",
     "elastic_average_step",
     "masked_average",
     "pallas_ring_allreduce_sum",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -7,8 +7,10 @@ line of workers, a 2D ``rows``×``cols`` mesh is the butterfly grid.
 """
 
 from akka_allreduce_tpu.parallel.mesh import (  # noqa: F401
+    DATA_SEQ_AXES,
     LINE_AXIS,
     GRID_AXES,
+    data_seq_mesh,
     grid_factors,
     grid_mesh,
     line_mesh,

@@ -20,6 +20,7 @@ from jax.sharding import Mesh
 
 LINE_AXIS = "line"
 GRID_AXES = ("rows", "cols")
+DATA_SEQ_AXES = ("data", "seq")
 
 
 def _resolve_devices(
@@ -89,3 +90,19 @@ def grid_mesh(
     if rows * cols != n:
         raise ValueError(f"grid {rows}x{cols} != {n} devices")
     return jax.make_mesh((rows, cols), axes, devices=devs)
+
+
+def data_seq_mesh(
+    dp: int | None = None,
+    sp: int | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axes: tuple[str, str] = DATA_SEQ_AXES,
+) -> Mesh:
+    """A 2D (data, seq) mesh for long-context training: DP replicas along
+    ``data``, each replica's sequence sharded along ``seq`` (ring attention /
+    Ulysses ride the ``seq`` axis — ops/ring_attention.py). With no shape
+    given, prefers the most-square factorization with ``sp`` the larger side
+    (sequence parallelism is the scarcer resource). Same shape logic as
+    :func:`grid_mesh`, only the axis roles differ."""
+    return grid_mesh(dp, sp, devices=devices, axes=axes)

@@ -1,0 +1,200 @@
+"""Long-context trainer: DP x SP over a (data, seq) mesh.
+
+Composes the framework's two pillars in one jitted SPMD step:
+
+- **sequence parallelism** along the ``seq`` axis — each device holds a
+  (B_local, T_local) token shard; attention runs as ring attention (K/V
+  rotating over ICI neighbors) or Ulysses all-to-all (ops/ring_attention.py);
+- **threshold-masked gradient allreduce** along BOTH axes — the same
+  contributor-mask semantics as the reference's threshold allreduce
+  (SURVEY.md §8.1 step 3), with the mask applied per DP *replica row*: a
+  dropped/straggling replica's v=0 zeroes its whole row's contribution while
+  the collective still completes, exactly the reference's partial-completion
+  round recast over a 2D mesh.
+
+The reference itself has neither sequence parallelism nor transformers
+(SURVEY.md §6); this is the TPU rebuild's long-context layer.
+
+Gradient collective: differentiating the v-weighted *local token-loss sum*
+w.r.t. REPLICATED params makes shard_map autodiff insert the cross-device psum
+over both mesh axes itself (the transpose of the params broadcast), so
+``sum_d(v_row(d) * g_d)`` arrives in one fused collective; dividing by
+``psum(v * local_token_count)`` yields the exact masked per-token-average
+gradient. Same trick as train/trainer.py's unbucketed path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class LongContextStepMetrics:
+    step: int
+    loss: float  # masked per-token average cross-entropy
+    contributors: float  # contributing DP replica rows
+
+
+class LongContextTrainer:
+    """DP+SP trainer for a :class:`~akka_allreduce_tpu.models.TransformerLM`.
+
+    Args:
+      model_cls: the TransformerLM class (or compatible); instantiated here so
+        ``seq_axis`` always matches the mesh.
+      mesh: a 2-axis (data, seq) mesh from ``parallel.data_seq_mesh``.
+      seq_len: GLOBAL sequence length (divisible by the seq axis size).
+      seq_impl: "ring" or "ulysses".
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        model_cls=None,
+        vocab: int = 64,
+        d_model: int = 64,
+        n_heads: int = 4,
+        n_layers: int = 2,
+        seq_len: int = 128,
+        seq_impl: str = "ring",
+        optimizer: optax.GradientTransformation | None = None,
+        learning_rate: float = 0.1,
+        seed: int = 0,
+    ) -> None:
+        from akka_allreduce_tpu.models.transformer import TransformerLM
+
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                f"need a (data, seq) mesh, got axes {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.data_axis, self.seq_axis = mesh.axis_names
+        self.dp = int(mesh.shape[self.data_axis])
+        self.sp = int(mesh.shape[self.seq_axis])
+        if seq_len % self.sp:
+            raise ValueError(f"{seq_len=} not divisible by seq shards {self.sp}")
+        self.seq_len = seq_len
+        self.vocab = vocab
+        cls = model_cls or TransformerLM
+        self.model = cls(
+            vocab=vocab,
+            d_model=d_model,
+            n_heads=n_heads,
+            n_layers=n_layers,
+            seq_axis=self.seq_axis,
+            seq_impl=seq_impl,
+        )
+        self.tx = optimizer or optax.adam(learning_rate)
+
+        # init runs the module in single-device (dense) form: same params, the
+        # seq dispatch only changes the attention schedule, not the weights
+        init_model = cls(
+            vocab=vocab,
+            d_model=d_model,
+            n_heads=n_heads,
+            n_layers=n_layers,
+        )
+        tokens0 = jnp.zeros((1, seq_len // self.sp), jnp.int32)
+        self.params = init_model.init(jax.random.PRNGKey(seed), tokens0)
+        self.opt_state = self.tx.init(self.params)
+        self.param_count = int(
+            sum(np.prod(p.shape) for p in jax.tree.leaves(self.params))
+        )
+        self.step_num = 0
+
+        data_spec = P(self.data_axis, self.seq_axis)
+        self._data_sharding = NamedSharding(mesh, data_spec)
+        self._valid_sharding = NamedSharding(mesh, P(self.data_axis))
+        axis_names = tuple(mesh.axis_names)
+        data_axis = self.data_axis
+        seq_axis = self.seq_axis
+        model_apply = self.model.apply
+        tx = self.tx
+
+        def step(params, opt_state, x, y, valid):
+            # the mask arrives sharded on `data` only; mark it varying on
+            # `seq` too so the both-axes psums below are well-typed (the
+            # contributor count keeps the data-only form so its psum over
+            # `data` is provably replicated)
+            v0 = valid.reshape(())
+            v = lax.pcast(v0, seq_axis, to="varying")
+            tokens_local = jnp.float32(x.shape[0] * x.shape[1])
+            denom = jnp.maximum(
+                lax.psum(v * tokens_local, axis_names), 1.0
+            )
+
+            def masked_loss_sum(p):
+                logits = model_apply(p, x)  # (B_local, T_local, vocab)
+                ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+                return ce.sum() * v / denom
+
+            lval, gavg = jax.value_and_grad(masked_loss_sum)(params)
+            loss_avg = lax.psum(lval, axis_names)  # already /denom
+            contributors = lax.psum(v0, data_axis)
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, loss_avg, contributors
+
+        mapped = jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P(), data_spec, data_spec, P(self.data_axis)),
+            out_specs=(P(), P(), P(), P()),
+        )
+        self._step = jax.jit(mapped, donate_argnums=(0, 1))
+
+    # -- stepping ------------------------------------------------------------
+
+    def _place(self, x, y):
+        if x.shape[0] % self.dp:
+            raise ValueError(
+                f"global batch {x.shape[0]} not divisible by dp={self.dp}"
+            )
+        if x.shape[1] != self.seq_len:
+            raise ValueError(
+                f"sequence length {x.shape[1]} != configured {self.seq_len}"
+            )
+        x = jax.device_put(np.asarray(x, np.int32), self._data_sharding)
+        y = jax.device_put(np.asarray(y, np.int32), self._data_sharding)
+        return x, y
+
+    def train_step(
+        self,
+        tokens: np.ndarray,
+        labels: np.ndarray,
+        valid: Sequence[float] | None = None,
+    ) -> LongContextStepMetrics:
+        """One step on a GLOBAL (batch, seq_len) token array.
+
+        ``valid``: per-DP-replica-row contributor mask of shape (dp,);
+        None = all rows contribute.
+        """
+        if valid is None:
+            valid_arr = np.ones((self.dp,), np.float32)
+        else:
+            valid_arr = np.asarray(valid, np.float32)
+            if valid_arr.shape != (self.dp,):
+                raise ValueError(
+                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
+                )
+        xd, yd = self._place(tokens, labels)
+        vd = jax.device_put(valid_arr, self._valid_sharding)
+        self.params, self.opt_state, loss, cnt = self._step(
+            self.params, self.opt_state, xd, yd, vd
+        )
+        self.step_num += 1
+        return LongContextStepMetrics(
+            step=self.step_num, loss=float(loss), contributors=float(cnt)
+        )
+
+    def train(self, batches: Iterable) -> list[LongContextStepMetrics]:
+        return [self.train_step(x, y) for x, y in batches]
