@@ -5,6 +5,7 @@
     python -m akka_allreduce_tpu bench        --floats 67108864 --schedule psum
     python -m akka_allreduce_tpu train-mlp    --steps 100 --batch 64
     python -m akka_allreduce_tpu train-resnet --steps 5 --bucket 262144
+    python -m akka_allreduce_tpu train-lm     --steps 30 --seq-len 256 --impl ring
     python -m akka_allreduce_tpu elastic-demo --steps 30 --drop-at 10 --rejoin-at 20
 
 ``local-demo`` is the reference's single-process N-worker fixture (BASELINE
@@ -176,6 +177,51 @@ def _cmd_train_resnet(argv: list[str]) -> int:
     return _run_training(trainer, ds, args, label="resnet50")
 
 
+def _cmd_train_lm(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "train-lm",
+        description="long-context Transformer LM, DP x SP with ring attention "
+        "or Ulysses (no analog in the reference — SURVEY.md §6)",
+    )
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seq-len", type=int, default=256, help="GLOBAL sequence length")
+    p.add_argument("--dp", type=int, default=None, help="data-parallel rows")
+    p.add_argument("--sp", type=int, default=None, help="sequence shards")
+    p.add_argument("--impl", choices=("ring", "ulysses"), default="ring")
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
+    args = p.parse_args(argv)
+    args.checkpoint_dir = None
+    args.checkpoint_every = 0
+
+    from akka_allreduce_tpu.models import data
+    from akka_allreduce_tpu.parallel import data_seq_mesh
+    from akka_allreduce_tpu.train import LongContextTrainer
+
+    mesh = data_seq_mesh(args.dp, args.sp)
+    trainer = LongContextTrainer(
+        mesh,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.heads,
+        n_layers=args.layers,
+        seq_len=args.seq_len,
+        seq_impl=args.impl,
+        learning_rate=args.lr,
+    )
+    print(
+        f"LM params: {trainer.param_count / 1e6:.2f}M, mesh "
+        f"dp={trainer.dp} x sp={trainer.sp}, seq_len={args.seq_len} ({args.impl})"
+    )
+    ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
+    return _run_training(trainer, ds, args, label=f"lm_{args.impl}")
+
+
 def _cmd_elastic_demo(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         "elastic-demo",
@@ -240,6 +286,7 @@ COMMANDS = {
     "bench": _cmd_bench,
     "train-mlp": _cmd_train_mlp,
     "train-resnet": _cmd_train_resnet,
+    "train-lm": _cmd_train_lm,
     "elastic-demo": _cmd_elastic_demo,
 }
 

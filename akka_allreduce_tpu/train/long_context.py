@@ -80,6 +80,7 @@ class LongContextTrainer:
         self.data_axis, self.seq_axis = mesh.axis_names
         self.dp = int(mesh.shape[self.data_axis])
         self.sp = int(mesh.shape[self.seq_axis])
+        self.n_devices = self.dp * self.sp
         if seq_len % self.sp:
             raise ValueError(f"{seq_len=} not divisible by seq shards {self.sp}")
         self.seq_len = seq_len

@@ -45,6 +45,20 @@ class TestCLI:
         assert main(args) == 0  # second run resumes from the checkpoint
         assert "resumed from step 2" in capsys.readouterr().out
 
+    def test_train_lm(self, tmp_path, capsys):
+        metrics = tmp_path / "lm.jsonl"
+        args = [
+            "train-lm", "--steps", "2", "--batch", "4", "--seq-len", "32",
+            "--d-model", "16", "--heads", "2", "--layers", "1",
+            "--vocab", "16", "--metrics-out", str(metrics),
+        ]
+        assert main(args) == 0
+        out = capsys.readouterr().out
+        assert "dp=2 x sp=4" in out  # 8-device mesh factors to 2x4
+        lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+        assert [l["step"] for l in lines] == [1, 2]
+        assert all(l["contributors"] == 2.0 for l in lines)
+
     def test_elastic_demo(self, capsys):
         # the drop window must outlast the phi detector's suspicion ramp
         # (~3-4 silent intervals at threshold 8), hence drop at 2, rejoin at 8
