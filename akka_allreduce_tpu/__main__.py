@@ -2,6 +2,8 @@
 (SURVEY.md §2 L4, §3 "Bootstrap mains + scripts") as one argparse entrypoint:
 
     python -m akka_allreduce_tpu local-demo   --nodes 4 --size 1000000
+    python -m akka_allreduce_tpu cluster-master --port 7070 --nodes 2 --rounds 20
+    python -m akka_allreduce_tpu cluster-node --seed 127.0.0.1:7070
     python -m akka_allreduce_tpu bench        --floats 67108864 --schedule psum
     python -m akka_allreduce_tpu train-mlp    --steps 100 --batch 64
     python -m akka_allreduce_tpu train-resnet --steps 5 --bucket 262144
@@ -17,6 +19,7 @@ devices are visible (TPU chips, or a virtual CPU mesh via
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
 import time
 
@@ -222,6 +225,130 @@ def _cmd_train_lm(argv: list[str]) -> int:
     return _run_training(trainer, ds, args, label=f"lm_{args.impl}")
 
 
+def _cmd_cluster_master(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "cluster-master",
+        description="seed/master role: membership + round scheduling over TCP "
+        "(the reference's master main, SURVEY.md §4.1)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--nodes", type=int, default=2, help="nodes before organizing")
+    p.add_argument("--dims", type=int, default=1, choices=(1, 2))
+    p.add_argument("--size", type=int, default=1_000_000)
+    p.add_argument("--chunk", type=int, default=262_144)
+    p.add_argument("--rounds", type=int, default=20, help="-1 = run forever")
+    p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
+    p.add_argument("--heartbeat", type=float, default=1.0, help="interval (s)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    import asyncio
+
+    from akka_allreduce_tpu.config import (
+        AllreduceConfig,
+        LineMasterConfig,
+        MasterConfig,
+        MetaDataConfig,
+        ThresholdConfig,
+    )
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(args.th, args.th, args.th),
+        metadata=MetaDataConfig(data_size=args.size, max_chunk_size=args.chunk),
+        line_master=LineMasterConfig(round_window=2, max_rounds=args.rounds),
+        master=MasterConfig(
+            node_num=args.nodes,
+            dimensions=args.dims,
+            heartbeat_interval_s=args.heartbeat,
+        ),
+    )
+
+    async def run() -> None:
+        master = MasterProcess(cfg, args.host, args.port)
+        ep = await master.start()
+        print(f"master listening on {ep}", flush=True)
+        try:
+            await master.run_until_done()
+            print(
+                f"master done: {master.rounds_completed} line-rounds completed",
+                flush=True,
+            )
+            await asyncio.sleep(2 * args.heartbeat)  # let Shutdown flush
+        finally:
+            await master.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+def _cmd_cluster_node(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "cluster-node",
+        description="worker-node role: joins the seed, serves one worker per "
+        "grid dimension (the reference's worker main, SURVEY.md §4.1)",
+    )
+    p.add_argument("--seed", required=True, help="master host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--node-id", type=int, default=-1, help="-1 = master assigns")
+    p.add_argument("--data-seed", type=int, default=None, help="payload RNG seed")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    import asyncio
+
+    import numpy as np
+
+    from akka_allreduce_tpu.control.bootstrap import NodeProcess
+    from akka_allreduce_tpu.control.cluster import Endpoint
+    from akka_allreduce_tpu.protocol import AllReduceInput
+
+    state = {"payload": None, "flushes": 0, "t0": None}
+
+    def source(req):
+        if state["payload"] is None:
+            raise RuntimeError("source called before Welcome sized the payload")
+        return AllReduceInput(state["payload"])
+
+    def sink(out):
+        state["flushes"] += 1
+
+    async def run() -> int:
+        node = NodeProcess(
+            Endpoint.parse(args.seed),
+            source,
+            sink,
+            args.host,
+            args.port,
+            preferred_node_id=args.node_id,
+        )
+        await node.start()
+        nid = await node.wait_welcomed()
+        size = node.config.metadata.data_size
+        seed = args.data_seed if args.data_seed is not None else nid
+        state["payload"] = (
+            np.random.default_rng(seed).standard_normal(size).astype(np.float32)
+        )
+        state["t0"] = time.perf_counter()
+        print(f"node {nid} joined {args.seed}", flush=True)
+        try:
+            reason = await node.run_until_shutdown()
+        finally:
+            await node.stop()
+        dt = time.perf_counter() - state["t0"]
+        mbs = state["flushes"] * size * 4 / max(dt, 1e-9) / 1e6
+        print(
+            f"node {nid} shut down ({reason}): {state['flushes']} rounds, "
+            f"{mbs:.1f} MB/s reduced",
+            flush=True,
+        )
+        return 0
+
+    return asyncio.run(run())
+
+
 def _cmd_elastic_demo(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         "elastic-demo",
@@ -283,6 +410,8 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
 
 COMMANDS = {
     "local-demo": _cmd_local_demo,
+    "cluster-master": _cmd_cluster_master,
+    "cluster-node": _cmd_cluster_node,
     "bench": _cmd_bench,
     "train-mlp": _cmd_train_mlp,
     "train-resnet": _cmd_train_resnet,

@@ -1,0 +1,341 @@
+"""Multi-process cluster bootstrap: master and node roles over TCP.
+
+The reference's deployment (SURVEY.md §2 L4, §4.1): one ``main`` per role; the
+master JVM binds a seed address, worker JVMs join via Akka Cluster, the grid
+master organizes lines and rounds begin. Here:
+
+- ``MasterProcess`` — binds the seed endpoint; owns the ``GridMaster`` (and
+  thus every ``LineMaster``), the address book, and the phi-accrual
+  ``HeartbeatMonitor``. Nodes join with ``JoinCluster``, are ``Welcome``d with
+  an assigned node id + the cluster config, then heartbeat. Silence trips the
+  detector -> ``member_unreachable`` -> re-organize (SURVEY.md §4.5); a
+  late joiner re-runs the Prepare/Confirm handshake.
+- ``NodeProcess`` — dials the seed, then hosts one ``AllreduceNode`` (one
+  worker per grid dimension) whose scatter/reduce chunks travel as wire frames
+  directly between nodes — the master never relays payloads, matching the
+  reference where workers message peers point-to-point.
+
+Addressing: ``master`` and every ``line_master:<id>`` live on the master
+process; ``worker:<id>`` lives on node ``id // dims``; ``client:<port>`` is a
+pre-welcome return address (the joiner does not yet know its node id);
+``node:<id>`` receives master broadcasts (address book, shutdown).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Callable
+
+from akka_allreduce_tpu.config import AllreduceConfig
+from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.control.failure import (
+    HeartbeatMonitor,
+    MemberState,
+    PhiAccrualFailureDetector,
+)
+from akka_allreduce_tpu.control.grid_master import GridMaster
+from akka_allreduce_tpu.control.node import AllreduceNode
+from akka_allreduce_tpu.control.remote import RemoteTransport, run_periodic
+from akka_allreduce_tpu.control.worker import DataSink, DataSource
+
+log = logging.getLogger(__name__)
+
+
+class MasterProcess:
+    """Seed-node role: membership, line organization, round scheduling."""
+
+    def __init__(
+        self,
+        config: AllreduceConfig,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        phi_threshold: float = 8.0,
+    ) -> None:
+        self.config = config
+        self.clock = clock
+        self.grid = GridMaster(
+            config.threshold, config.master, config.line_master
+        )
+        self.monitor = HeartbeatMonitor(
+            PhiAccrualFailureDetector(
+                threshold=phi_threshold,
+                first_heartbeat_estimate=config.master.heartbeat_interval_s,
+            )
+        )
+        self.book: dict[int, cl.Endpoint] = {}
+        self.unreachable: set[int] = set()
+        self.transport = RemoteTransport(host, port)
+        self.transport.register("master", self._on_cluster_msg)
+        self.transport.register_prefix("line_master", self.grid.handle_for_line)
+        self.transport.set_prefix_route("worker", self._worker_endpoint)
+        self.transport.set_prefix_route("node", self.book.get)
+        self._poll_task: asyncio.Task | None = None
+        self._done = asyncio.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> cl.Endpoint:
+        ep = await self.transport.start()
+        interval = self.config.master.heartbeat_interval_s
+        self._poll_task = asyncio.create_task(
+            run_periodic(interval, self._poll_detector)
+        )
+        log.info("master listening on %s", ep)
+        return ep
+
+    async def stop(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except asyncio.CancelledError:
+                pass
+            self._poll_task = None
+        await self.transport.stop()
+
+    async def run_until_done(self, timeout: float | None = None) -> None:
+        """Wait for every line to finish ``max_rounds``, then broadcast
+        ``Shutdown`` (requires ``line_master.max_rounds >= 0``)."""
+        await asyncio.wait_for(self._done.wait(), timeout)
+        await self.transport.send_all(self._broadcast(cl.Shutdown("done")))
+
+    # -- routing helpers -------------------------------------------------------
+
+    def _worker_endpoint(self, worker_id: int) -> cl.Endpoint | None:
+        nid = worker_id // self.config.master.dimensions
+        return None if nid in self.unreachable else self.book.get(nid)
+
+    def _broadcast(self, msg: Any) -> list[Envelope]:
+        return [
+            Envelope(f"node:{nid}", msg)
+            for nid in sorted(self.book)
+            if nid not in self.unreachable
+        ]
+
+    # -- cluster protocol ------------------------------------------------------
+
+    def _on_cluster_msg(self, msg: Any) -> list[Envelope]:
+        now = self.clock()
+        if isinstance(msg, cl.JoinCluster):
+            return self._on_join(msg, now)
+        if isinstance(msg, cl.Heartbeat):
+            return self._on_heartbeat(msg.node_id, now)
+        if isinstance(msg, cl.LeaveCluster):
+            self.monitor.leave(msg.node_id, now)
+            out = self.grid.member_unreachable(msg.node_id)
+            self.book.pop(msg.node_id, None)
+            self.unreachable.discard(msg.node_id)
+            return out + self._broadcast(self._address_book())
+        raise TypeError(f"master cannot handle {type(msg).__name__}")
+
+    def _on_join(self, msg: cl.JoinCluster, now: float) -> list[Envelope]:
+        nid = msg.preferred_node_id
+        if nid < 0 or (
+            nid in self.book and self.book[nid] != cl.Endpoint(msg.host, msg.port)
+        ):
+            nid = max(self.book, default=-1) + 1
+        self.book[nid] = cl.Endpoint(msg.host, msg.port)
+        self.unreachable.discard(nid)
+        # pre-welcome return address: the joiner doesn't know its id yet
+        self.transport.set_route(
+            f"client:{msg.port}", cl.Endpoint(msg.host, msg.port)
+        )
+        self.monitor.heartbeat(nid, now)
+        log.info("master: node %d joined from %s:%d", nid, msg.host, msg.port)
+        out = [
+            Envelope(
+                f"client:{msg.port}",
+                cl.Welcome(nid, self.config.to_json()),
+            )
+        ]
+        out.extend(self._broadcast(self._address_book()))
+        out.extend(self.grid.member_up(nid))
+        return out
+
+    def _on_heartbeat(self, node_id: int, now: float) -> list[Envelope]:
+        if node_id not in self.book:
+            return []  # stale heartbeat from a node we already expelled
+        event = self.monitor.heartbeat(node_id, now)
+        if event is not None and node_id not in self.grid.nodes:
+            # silence marked it unreachable but the process lives: rejoin it
+            log.info("master: node %d heartbeat resumed -> rejoin", node_id)
+            self.unreachable.discard(node_id)
+            return self._broadcast(self._address_book()) + self.grid.member_up(
+                node_id
+            )
+        return []
+
+    def _address_book(self) -> cl.AddressBook:
+        return cl.AddressBook(
+            tuple(
+                (nid, ep.host, ep.port)
+                for nid, ep in sorted(self.book.items())
+                if nid not in self.unreachable
+            )
+        )
+
+    async def _poll_detector(self) -> None:
+        now = self.clock()
+        out: list[Envelope] = []
+        expelled = False
+        for event in self.monitor.poll(now):
+            if event.state is MemberState.UNREACHABLE:
+                log.info(
+                    "master: node %d unreachable (phi=%.1f)",
+                    event.node_id,
+                    event.phi,
+                )
+                out.extend(self.grid.member_unreachable(event.node_id))
+                # stop dialing and advertising the silent endpoint, but keep
+                # its book entry + detector state: if the process is alive and
+                # heartbeats resume, _on_heartbeat re-lines it without a new
+                # JoinCluster; a genuine restart re-joins explicitly.
+                self.unreachable.add(event.node_id)
+                expelled = True
+        if expelled:
+            out.extend(self._broadcast(self._address_book()))
+        if out:
+            await self.transport.send_all(out)
+        if self.grid.is_done:
+            self._done.set()
+
+    @property
+    def rounds_completed(self) -> int:
+        """Line-rounds completed across ALL configurations, not just the
+        current one (re-organization replaces the line masters)."""
+        return self.grid.total_completed
+
+
+class NodeProcess:
+    """Worker-node role: joins the seed, hosts one worker per dimension."""
+
+    def __init__(
+        self,
+        seed: cl.Endpoint,
+        data_source: DataSource,
+        data_sink: DataSink,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        preferred_node_id: int = -1,
+    ) -> None:
+        self.seed = seed
+        self.data_source = data_source
+        self.data_sink = data_sink
+        self.preferred_node_id = preferred_node_id
+        self.node_id: int | None = None
+        self.node: AllreduceNode | None = None
+        self.config: AllreduceConfig | None = None
+        self.book = cl.AddressBook(())
+        self.transport = RemoteTransport(host, port)
+        self.transport.set_route("master", seed)
+        self.transport.set_prefix_route("line_master", lambda _lid: seed)
+        self.transport.set_prefix_route("worker", self._peer_endpoint)
+        self._heartbeat_task: asyncio.Task | None = None
+        self._welcomed = asyncio.Event()
+        self._shutdown = asyncio.Event()
+        self.shutdown_reason: str | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> None:
+        ep = await self.transport.start()
+        self.transport.register_prefix(
+            "client", lambda _port, msg: self._on_cluster_msg(msg)
+        )
+        await self.transport.send(
+            Envelope(
+                "master",
+                cl.JoinCluster(ep.host, ep.port, self.preferred_node_id),
+            )
+        )
+
+    async def wait_welcomed(self, timeout: float = 10.0) -> int:
+        await asyncio.wait_for(self._welcomed.wait(), timeout)
+        assert self.node_id is not None
+        return self.node_id
+
+    async def run_until_shutdown(self, timeout: float | None = None) -> str:
+        await asyncio.wait_for(self._shutdown.wait(), timeout)
+        return self.shutdown_reason or "done"
+
+    async def leave(self) -> None:
+        """Graceful departure (the reference's Cluster leave)."""
+        if self.node_id is not None:
+            await self.transport.send(
+                Envelope("master", cl.LeaveCluster(self.node_id))
+            )
+
+    async def stop(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except asyncio.CancelledError:
+                pass
+            self._heartbeat_task = None
+        await self.transport.stop()
+
+    # -- routing helpers -------------------------------------------------------
+
+    def _peer_endpoint(self, worker_id: int) -> cl.Endpoint | None:
+        if self.config is None:
+            return None
+        return self.book.endpoint_of(
+            worker_id // self.config.master.dimensions
+        )
+
+    # -- cluster protocol ------------------------------------------------------
+
+    def _on_cluster_msg(self, msg: Any) -> list[Envelope]:
+        if isinstance(msg, cl.Welcome):
+            return self._on_welcome(msg)
+        if isinstance(msg, cl.AddressBook):
+            self.book = msg
+            return []
+        if isinstance(msg, cl.Shutdown):
+            self.shutdown_reason = msg.reason
+            self._shutdown.set()
+            return []
+        raise TypeError(f"node cannot handle {type(msg).__name__}")
+
+    def _on_welcome(self, msg: cl.Welcome) -> list[Envelope]:
+        self.config = AllreduceConfig.from_json(msg.config_json)
+        self.node_id = msg.node_id
+        dims = self.config.master.dimensions
+        self.node = AllreduceNode(
+            msg.node_id,
+            dims,
+            self.data_source,
+            self.data_sink,
+            self.config.metadata,
+            self.config.threshold,
+            self.config.worker,
+        )
+        for dim in range(dims):
+            wid = msg.node_id * dims + dim
+            self.transport.register(
+                f"worker:{wid}",
+                lambda m, _wid=wid: self.node.handle(_wid, m),
+            )
+        self.transport.register_prefix(
+            "node", lambda _nid, m: self._on_cluster_msg(m)
+        )
+        interval = self.config.master.heartbeat_interval_s
+        self._heartbeat_task = asyncio.create_task(
+            run_periodic(interval, self._send_heartbeat)
+        )
+        self._welcomed.set()
+        log.info("node %d welcomed (dims=%d)", msg.node_id, dims)
+        return []
+
+    async def _send_heartbeat(self) -> None:
+        assert self.node_id is not None
+        await self.transport.send(
+            Envelope("master", cl.Heartbeat(self.node_id))
+        )

@@ -1,0 +1,103 @@
+"""Cluster membership protocol — the reference's Akka Cluster seam.
+
+The reference gets membership from Akka Cluster: nodes join via seed-node
+addresses, gossip carries MemberUp/Unreachable, and the grid master reacts to
+those events (SURVEY.md §3 "Membership", §4.1 bootstrap, §4.5 recovery). This
+module is the same seam as explicit messages: a node dials the master (the
+single seed), is welcomed with its node id + the cluster config, then
+heartbeats; the master's phi-accrual detector (control/failure.py) turns
+heartbeat silence into ``member_unreachable`` and the grid re-organizes.
+
+These are control-plane-only messages (no float payloads) carried by the same
+wire codec and TCP transport as the round protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    """A reachable transport address (host, port) — the actor-system address."""
+
+    host: str
+    port: int
+
+    def __str__(self) -> str:  # "host:port", the CLI's --seed format
+        return f"{self.host}:{self.port}"
+
+    @classmethod
+    def parse(cls, text: str) -> "Endpoint":
+        host, _, port = text.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"expected host:port, got {text!r}")
+        return cls(host, int(port))
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinCluster:
+    """Node -> master (seed): request membership.
+
+    ``host``/``port`` is the joiner's own server endpoint — peers will dial it
+    to deliver ScatterBlock/ReduceBlock. ``preferred_node_id`` lets a restarted
+    node ask for its old identity back (-1 = master assigns).
+    """
+
+    host: str
+    port: int
+    preferred_node_id: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class Welcome:
+    """Master -> node: membership granted.
+
+    Carries the assigned node id and the full cluster config as JSON
+    (``AllreduceConfig.to_json``) so every node runs identical geometry and
+    thresholds — the reference distributes the same knobs via
+    ``application.conf`` on each JVM.
+    """
+
+    node_id: int
+    config_json: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Heartbeat:
+    """Node -> master: liveness signal feeding the phi-accrual detector."""
+
+    node_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaveCluster:
+    """Node -> master: graceful departure (Akka Cluster leave)."""
+
+    node_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class AddressBook:
+    """Master -> all nodes: node id -> endpoint map after every membership
+    change, so workers can dial their current peers."""
+
+    entries: tuple[tuple[int, str, int], ...]  # (node_id, host, port)
+
+    def __post_init__(self) -> None:
+        object.__setattr__(
+            self, "entries", tuple(tuple(e) for e in self.entries)
+        )
+
+    def endpoint_of(self, node_id: int) -> Endpoint | None:
+        for nid, host, port in self.entries:
+            if nid == node_id:
+                return Endpoint(host, port)
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Shutdown:
+    """Master -> all nodes: the run is over (max_rounds reached); exit."""
+
+    reason: str = "done"

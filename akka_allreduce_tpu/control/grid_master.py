@@ -52,6 +52,7 @@ class GridMaster:
         self.line_masters: dict[int, LineMaster] = {}
         self._line_of_worker: dict[int, int] = {}
         self.resume_round = 0
+        self._completed_before_reorg = 0  # line-rounds of replaced configs
 
     # -- membership events (reference: Akka Cluster MemberUp/Unreachable) ----
 
@@ -90,6 +91,9 @@ class GridMaster:
         if self.line_masters:
             self.resume_round = max(
                 lm.next_round for lm in self.line_masters.values()
+            )
+            self._completed_before_reorg += sum(
+                lm.total_completed for lm in self.line_masters.values()
             )
         self.config_id += 1
         self.organized = True
@@ -148,6 +152,13 @@ class GridMaster:
                 return []
             return self.handle_for_line(line_id, msg)
         raise TypeError(f"master cannot handle {type(msg).__name__}")
+
+    @property
+    def total_completed(self) -> int:
+        """Line-rounds completed across every configuration this master ran."""
+        return self._completed_before_reorg + sum(
+            lm.total_completed for lm in self.line_masters.values()
+        )
 
     @property
     def is_done(self) -> bool:

@@ -1,0 +1,236 @@
+"""Remote transport: asyncio TCP delivery of control-plane envelopes.
+
+The reference's L0 is Akka remoting — ``ActorSelection ! msg`` serialized by
+Netty onto TCP (SURVEY.md §2 L0). This is the same layer, idiomatic Python:
+each process runs one ``RemoteTransport`` = one inbound TCP server + a pool of
+outbound connections + a single-consumer delivery loop, so every local handler
+processes one message at a time (the actor guarantee the reference's buffers
+rely on — SURVEY.md §6 "Race detection": actor model, buffers actor-private).
+
+Routing mirrors ``LocalRouter`` (control/local.py) but resolves non-local
+addresses to endpoints: exact routes ("master" -> seed) and prefix resolvers
+("worker:<id>" -> the owning node's endpoint via the address book). Delivery
+is at-most-once: a dead or unknown destination drops the message — exactly the
+reference's remoting semantics, and what the threshold design expects
+(SURVEY.md §4.2: rounds complete at threshold, never wait for lost messages).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Callable
+
+from akka_allreduce_tpu.control import wire
+from akka_allreduce_tpu.control.cluster import Endpoint
+from akka_allreduce_tpu.control.envelope import Envelope
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Any], list[Envelope]]
+PrefixHandler = Callable[[int, Any], list[Envelope]]
+_U32 = wire._U32
+
+
+class RemoteTransport:
+    """One process's transport: local handlers + remote routes."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        connect_timeout_s: float = 5.0,
+    ) -> None:
+        self._host = host
+        self._port = port
+        self.connect_timeout_s = connect_timeout_s
+        self._server: asyncio.Server | None = None
+        self._handlers: dict[str, Handler] = {}
+        self._prefix_handlers: dict[str, PrefixHandler] = {}
+        self._routes: dict[str, Endpoint] = {}
+        self._prefix_routes: dict[str, Callable[[int], Endpoint | None]] = {}
+        self._conns: dict[Endpoint, asyncio.StreamWriter] = {}
+        self._conn_locks: dict[Endpoint, asyncio.Lock] = {}
+        self._inbox: asyncio.Queue[tuple[str, Any]] = asyncio.Queue()
+        self._pump: asyncio.Task | None = None
+        self._reader_tasks: set[asyncio.Task] = set()
+        self.delivered = 0
+        self.dropped = 0
+        self.on_send_error: Callable[[Endpoint, Envelope], None] | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> Endpoint:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        self._pump = asyncio.create_task(self._pump_inbox())
+        return self.endpoint
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return Endpoint(self._host, self._port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # cancel connection handlers BEFORE wait_closed: on Python >= 3.12 it
+        # waits for them, and they loop on readexactly until cancelled
+        for task in list(self._reader_tasks):
+            task.cancel()
+        if self._reader_tasks:
+            await asyncio.gather(*self._reader_tasks, return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except asyncio.CancelledError:
+                pass
+            self._pump = None
+        for w in self._conns.values():
+            w.close()
+        self._conns.clear()
+        self._conn_locks.clear()
+
+    # -- registration / routing -------------------------------------------------
+
+    def register(self, addr: str, handler: Handler) -> None:
+        self._handlers[addr] = handler
+
+    def register_prefix(self, prefix: str, handler: PrefixHandler) -> None:
+        self._prefix_handlers[prefix] = handler
+
+    def set_route(self, addr: str, endpoint: Endpoint) -> None:
+        self._routes[addr] = endpoint
+
+    def set_prefix_route(
+        self, prefix: str, resolver: Callable[[int], Endpoint | None]
+    ) -> None:
+        self._prefix_routes[prefix] = resolver
+
+    def _local_handler(self, dest: str) -> Callable[[Any], list[Envelope]] | None:
+        handler = self._handlers.get(dest)
+        if handler is not None:
+            return handler
+        prefix, _, suffix = dest.rpartition(":")
+        ph = self._prefix_handlers.get(prefix)
+        if ph is not None and suffix.lstrip("-").isdigit():
+            return lambda m, _ph=ph, _id=int(suffix): _ph(_id, m)
+        return None
+
+    def _resolve(self, dest: str) -> Endpoint | None:
+        ep = self._routes.get(dest)
+        if ep is not None:
+            return ep
+        prefix, _, suffix = dest.rpartition(":")
+        resolver = self._prefix_routes.get(prefix)
+        if resolver is not None and suffix.lstrip("-").isdigit():
+            return resolver(int(suffix))
+        return None
+
+    # -- sending -----------------------------------------------------------------
+
+    async def send(self, env: Envelope) -> None:
+        handler = self._local_handler(env.dest)
+        if handler is not None:  # local delivery: no wire, same FIFO inbox
+            await self._inbox.put((env.dest, env.msg))
+            return
+        ep = self._resolve(env.dest)
+        if ep is None:
+            log.warning("no route for %s; dropping", env.dest)
+            self.dropped += 1
+            return
+        frame = wire.encode_frame(env.dest, env.msg)
+        try:
+            await self._write(ep, frame)
+        except (OSError, asyncio.TimeoutError) as exc:
+            self.dropped += 1
+            log.warning("send to %s (%s) failed: %s", env.dest, ep, exc)
+            writer = self._conns.pop(ep, None)
+            if writer is not None:
+                writer.close()
+            self._conn_locks.pop(ep, None)
+            if self.on_send_error is not None:
+                self.on_send_error(ep, env)
+
+    async def send_all(self, envelopes: list[Envelope]) -> None:
+        for env in envelopes:
+            await self.send(env)
+
+    async def _write(self, ep: Endpoint, frame: bytes) -> None:
+        # Bounded connect/drain: sends run inline in the pump consumer, so an
+        # unresponsive peer (SYN blackhole) must not stall the whole control
+        # plane for the kernel's TCP timeout — it becomes a dropped message.
+        lock = self._conn_locks.setdefault(ep, asyncio.Lock())
+        async with lock:  # serialize connect + write per peer
+            writer = self._conns.get(ep)
+            if writer is None or writer.is_closing():
+                _, writer = await asyncio.wait_for(
+                    asyncio.open_connection(ep.host, ep.port),
+                    self.connect_timeout_s,
+                )
+                self._conns[ep] = writer
+            writer.write(frame)
+            await asyncio.wait_for(writer.drain(), self.connect_timeout_s)
+
+    # -- receiving ----------------------------------------------------------------
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        assert task is not None
+        self._reader_tasks.add(task)
+        try:
+            while True:
+                header = await reader.readexactly(4)
+                (length,) = _U32.unpack(header)
+                body = await reader.readexactly(length)
+                dest, msg = wire.decode_frame_body(body)
+                await self._inbox.put((dest, msg))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass  # peer closed; at-most-once semantics, nothing to recover
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._reader_tasks.discard(task)
+            writer.close()
+
+    async def _pump_inbox(self) -> None:
+        """Single consumer: every handler runs one message at a time."""
+        while True:
+            dest, msg = await self._inbox.get()
+            handler = self._local_handler(dest)
+            if handler is None:
+                log.warning("no handler for %s; dropping", dest)
+                self.dropped += 1
+                continue
+            try:
+                out = handler(msg)
+            except Exception:
+                log.exception("handler for %s failed on %s", dest, type(msg).__name__)
+                continue
+            self.delivered += 1
+            await self.send_all(out)
+
+    async def drain(self, timeout: float = 5.0) -> None:
+        """Wait until the local inbox is empty (test convenience)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not self._inbox.empty():
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("transport did not drain")
+            await asyncio.sleep(0.01)
+
+
+async def run_periodic(
+    interval_s: float, fn: Callable[[], Awaitable[None]]
+) -> None:
+    """Fixed-interval async ticker (heartbeats, detector polls)."""
+    while True:
+        await asyncio.sleep(interval_s)
+        await fn()

@@ -1,0 +1,202 @@
+"""Binary wire codec for the control-plane protocol.
+
+The reference configures Akka serializers for its ``Array[Float]``-carrying
+actor messages (SURVEY.md §2 L0 "serializer config for Array[Float] messages").
+This is the same layer, purpose-built: each message encodes to
+``[u8 tag][fixed struct fields][raw little-endian float32 payload]`` and a
+framed envelope is ``[u32 frame_len][u16 dest_len][dest utf8][encoded msg]``.
+No pickle — the format is versioned by tag, language-neutral, and float
+payloads are zero-copy views on decode (``np.frombuffer``).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.protocol import (
+    CompleteAllreduce,
+    ConfirmPreparation,
+    PrepareAllreduce,
+    ReduceBlock,
+    ScatterBlock,
+    StartAllreduce,
+)
+
+# one tag per message type; payload-carrying tags end the body with raw f32
+_TAGS: dict[type, int] = {
+    StartAllreduce: 1,
+    ScatterBlock: 2,
+    ReduceBlock: 3,
+    CompleteAllreduce: 4,
+    PrepareAllreduce: 5,
+    ConfirmPreparation: 6,
+    cl.JoinCluster: 7,
+    cl.Welcome: 8,
+    cl.Heartbeat: 9,
+    cl.LeaveCluster: 10,
+    cl.AddressBook: 11,
+    cl.Shutdown: 12,
+}
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+
+def _pack_str(text: str) -> bytes:
+    raw = text.encode("utf-8")
+    return _U16.pack(len(raw)) + raw
+
+
+def _unpack_str(buf: memoryview, off: int) -> tuple[str, int]:
+    (n,) = _U16.unpack_from(buf, off)
+    off += 2
+    return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+
+def _pack_floats(value: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(value, dtype=np.float32)
+    return _U32.pack(arr.size) + arr.tobytes()
+
+
+def _unpack_floats(buf: memoryview, off: int) -> tuple[np.ndarray, int]:
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    arr = np.frombuffer(buf, dtype="<f4", count=n, offset=off)
+    return arr, off + 4 * n
+
+
+def encode(msg: Any) -> bytes:
+    """Message -> ``[tag][body]`` bytes."""
+    tag = _TAGS.get(type(msg))
+    if tag is None:
+        raise TypeError(f"no wire tag for {type(msg).__name__}")
+    head = bytes([tag])
+    if tag == 1:
+        return head + struct.pack("<q", msg.round_num)
+    if tag == 2:
+        return (
+            head
+            + struct.pack(
+                "<iiiq", msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num
+            )
+            + _pack_floats(msg.value)
+        )
+    if tag == 3:
+        return (
+            head
+            + struct.pack(
+                "<iiiqi",
+                msg.src_id,
+                msg.dest_id,
+                msg.chunk_id,
+                msg.round_num,
+                msg.count,
+            )
+            + _pack_floats(msg.value)
+        )
+    if tag == 4:
+        return head + struct.pack("<iq", msg.src_id, msg.round_num)
+    if tag == 5:
+        peers = msg.peer_ids
+        return head + struct.pack(
+            f"<qiqiH{len(peers)}i",
+            msg.config_id,
+            msg.worker_id,
+            msg.round_num,
+            msg.line_id,
+            len(peers),
+            *peers,
+        )
+    if tag == 6:
+        return head + struct.pack("<qi", msg.config_id, msg.worker_id)
+    if tag == 7:
+        return (
+            head
+            + _pack_str(msg.host)
+            + struct.pack("<Hi", msg.port, msg.preferred_node_id)
+        )
+    if tag == 8:
+        return head + struct.pack("<i", msg.node_id) + _pack_str(msg.config_json)
+    if tag == 9:
+        return head + struct.pack("<i", msg.node_id)
+    if tag == 10:
+        return head + struct.pack("<i", msg.node_id)
+    if tag == 11:
+        parts = [head, _U16.pack(len(msg.entries))]
+        for nid, host, port in msg.entries:
+            parts.append(struct.pack("<i", nid) + _pack_str(host) + _U16.pack(port))
+        return b"".join(parts)
+    if tag == 12:
+        return head + _pack_str(msg.reason)
+    raise AssertionError(f"unhandled tag {tag}")
+
+
+def decode(data: bytes | memoryview) -> Any:
+    """``[tag][body]`` bytes -> message (float payloads are zero-copy views)."""
+    buf = memoryview(data)
+    tag = buf[0]
+    off = 1
+    if tag == 1:
+        return StartAllreduce(*struct.unpack_from("<q", buf, off))
+    if tag == 2:
+        src, dest, chunk, rnd = struct.unpack_from("<iiiq", buf, off)
+        value, _ = _unpack_floats(buf, off + 20)
+        return ScatterBlock(value, src, dest, chunk, rnd)
+    if tag == 3:
+        src, dest, chunk, rnd, count = struct.unpack_from("<iiiqi", buf, off)
+        value, _ = _unpack_floats(buf, off + 24)
+        return ReduceBlock(value, src, dest, chunk, rnd, count)
+    if tag == 4:
+        return CompleteAllreduce(*struct.unpack_from("<iq", buf, off))
+    if tag == 5:
+        config_id, worker_id, round_num, line_id, n = struct.unpack_from(
+            "<qiqiH", buf, off
+        )
+        peers = struct.unpack_from(f"<{n}i", buf, off + 26)
+        return PrepareAllreduce(config_id, peers, worker_id, round_num, line_id)
+    if tag == 6:
+        return ConfirmPreparation(*struct.unpack_from("<qi", buf, off))
+    if tag == 7:
+        host, off = _unpack_str(buf, off)
+        port, preferred = struct.unpack_from("<Hi", buf, off)
+        return cl.JoinCluster(host, port, preferred)
+    if tag == 8:
+        (node_id,) = struct.unpack_from("<i", buf, off)
+        config_json, _ = _unpack_str(buf, off + 4)
+        return cl.Welcome(node_id, config_json)
+    if tag == 9:
+        return cl.Heartbeat(*struct.unpack_from("<i", buf, off))
+    if tag == 10:
+        return cl.LeaveCluster(*struct.unpack_from("<i", buf, off))
+    if tag == 11:
+        (n,) = _U16.unpack_from(buf, off)
+        off += 2
+        entries = []
+        for _ in range(n):
+            (nid,) = struct.unpack_from("<i", buf, off)
+            host, off = _unpack_str(buf, off + 4)
+            (port,) = _U16.unpack_from(buf, off)
+            off += 2
+            entries.append((nid, host, port))
+        return cl.AddressBook(tuple(entries))
+    if tag == 12:
+        reason, _ = _unpack_str(buf, off)
+        return cl.Shutdown(reason)
+    raise ValueError(f"unknown wire tag {tag}")
+
+
+def encode_frame(dest: str, msg: Any) -> bytes:
+    """Framed envelope: ``[u32 len][u16 dest_len][dest][tag][body]``."""
+    body = _pack_str(dest) + encode(msg)
+    return _U32.pack(len(body)) + body
+
+
+def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:
+    """Inverse of ``encode_frame`` minus the length prefix."""
+    buf = memoryview(body)
+    dest, off = _unpack_str(buf, 0)
+    return dest, decode(buf[off:])
