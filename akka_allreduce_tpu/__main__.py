@@ -87,12 +87,26 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace of the step loop (SURVEY.md §6); "
+        "view with tensorboard or xprof",
+    )
 
 
 def _run_training(trainer, ds, args, *, label: str) -> int:
+    import contextlib
+
     import numpy as np
 
     from akka_allreduce_tpu.utils.metrics import MetricsLogger
+
+    profile = contextlib.nullcontext()
+    if getattr(args, "profile_dir", None):
+        import jax
+
+        profile = jax.profiler.trace(args.profile_dir)
 
     logger = MetricsLogger(args.metrics_out)
     ckpt = None
@@ -105,17 +119,18 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
             print(f"resumed from step {step}")
     t0 = time.perf_counter()
     losses = []
-    for x, y in ds.batches(args.batch, args.steps):
-        st = time.perf_counter()
-        m = trainer.train_step(x, y)
-        dt = time.perf_counter() - st
-        losses.append(m.loss)
-        logger.log_event(
-            kind="train_step", workload=label, step=m.step, loss=m.loss,
-            contributors=m.contributors, step_time_s=round(dt, 6),
-        )
-        if ckpt and args.checkpoint_every and m.step % args.checkpoint_every == 0:
-            ckpt.save(trainer)
+    with profile:
+        for x, y in ds.batches(args.batch, args.steps):
+            st = time.perf_counter()
+            m = trainer.train_step(x, y)
+            dt = time.perf_counter() - st
+            losses.append(m.loss)
+            logger.log_event(
+                kind="train_step", workload=label, step=m.step, loss=m.loss,
+                contributors=m.contributors, step_time_s=round(dt, 6),
+            )
+            if ckpt and args.checkpoint_every and m.step % args.checkpoint_every == 0:
+                ckpt.save(trainer)
     total = time.perf_counter() - t0
     if ckpt:
         ckpt.save(trainer, force=True)
@@ -240,7 +255,13 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     p.add_argument("--rounds", type=int, default=20, help="-1 = run forever")
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=1.0, help="interval (s)")
+    p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
     args = p.parse_args(argv)
+    return _run_cluster_master(args)
+
+
+def _run_cluster_master(args) -> int:
+    """Shared master bootstrap for cluster-master / train-cluster-master."""
     logging.basicConfig(level=logging.INFO, format="%(message)s")
 
     import asyncio
@@ -253,6 +274,7 @@ def _cmd_cluster_master(argv: list[str]) -> int:
         ThresholdConfig,
     )
     from akka_allreduce_tpu.control.bootstrap import MasterProcess
+    from akka_allreduce_tpu.utils.metrics import MetricsLogger
 
     cfg = AllreduceConfig(
         threshold=ThresholdConfig(args.th, args.th, args.th),
@@ -266,7 +288,8 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     )
 
     async def run() -> None:
-        master = MasterProcess(cfg, args.host, args.port)
+        metrics = MetricsLogger(args.metrics_out) if args.metrics_out else None
+        master = MasterProcess(cfg, args.host, args.port, metrics=metrics)
         ep = await master.start()
         print(f"master listening on {ep}", flush=True)
         try:
@@ -278,6 +301,8 @@ def _cmd_cluster_master(argv: list[str]) -> int:
             await asyncio.sleep(2 * args.heartbeat)  # let Shutdown flush
         finally:
             await master.stop()
+            if metrics is not None:
+                metrics.close()
 
     asyncio.run(run())
     return 0
@@ -349,6 +374,104 @@ def _cmd_cluster_node(argv: list[str]) -> int:
     return asyncio.run(run())
 
 
+def _mlp_trainer(hidden, lr, seed=0):
+    import numpy as np
+
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.parallel import line_mesh
+    from akka_allreduce_tpu.train import DPTrainer
+
+    return DPTrainer(
+        MLP(hidden=tuple(hidden), classes=10),
+        line_mesh(1),  # local learner: one device per node process
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        learning_rate=lr,
+        seed=seed,
+    )
+
+
+def _cmd_train_cluster_master(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "train-cluster-master",
+        description="master for distributed elastic-averaging MLP training "
+        "(the reference's multi-JVM training deployment, SURVEY.md §4.4); "
+        "data_size is derived from the model so start nodes with the SAME "
+        "--hidden flags",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--nodes", type=int, default=2)
+    p.add_argument("--hidden", type=int, nargs="+", default=[32])
+    p.add_argument("--rounds", type=int, default=30, help="-1 = run forever")
+    p.add_argument("--chunk", type=int, default=65536)
+    p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
+    p.add_argument("--heartbeat", type=float, default=0.5, help="interval (s)")
+    p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
+    args = p.parse_args(argv)
+    args.size = _mlp_trainer(args.hidden, 0.1).param_count
+    print(f"model: {args.size} params -> data_size {args.size}", flush=True)
+    args.dims = 1
+    return _run_cluster_master(args)
+
+
+def _cmd_train_cluster_node(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "train-cluster-node",
+        description="training node: local MLP SGD on its own data shard + "
+        "asynchronous elastic-averaging weight sync over the cluster",
+    )
+    p.add_argument("--seed", required=True, help="master host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--node-id", type=int, default=-1, help="-1 = master assigns")
+    p.add_argument("--hidden", type=int, nargs="+", default=[32])
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--elastic-rate", type=float, default=0.5)
+    p.add_argument("--data-seed", type=int, default=None, help="shard RNG seed")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    import asyncio
+
+    from akka_allreduce_tpu.control.cluster import Endpoint
+    from akka_allreduce_tpu.models import data
+    from akka_allreduce_tpu.train import ElasticClusterNode
+
+    async def run() -> int:
+        trainer = _mlp_trainer(args.hidden, args.lr, seed=17)
+        ds = data.mnist_like(
+            seed=args.data_seed if args.data_seed is not None else 0
+        )
+        node = ElasticClusterNode(
+            Endpoint.parse(args.seed),
+            trainer,
+            iter(ds.batches(args.batch, args.steps)),
+            elastic_rate=args.elastic_rate,
+            host=args.host,
+            port=args.port,
+            preferred_node_id=args.node_id,
+        )
+        t0 = time.perf_counter()
+        steps = await node.run(args.steps)
+        dt = time.perf_counter() - t0
+        losses = node.losses
+        trend = (
+            f"loss {losses[0]:.4f} -> {losses[-1]:.4f}"
+            if losses
+            else "no steps taken"
+        )
+        print(
+            f"trained {steps} steps in {dt:.1f}s "
+            f"({node.rounds_applied} sync rounds applied); {trend}",
+            flush=True,
+        )
+        return 0
+
+    return asyncio.run(run())
+
+
 def _cmd_elastic_demo(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         "elastic-demo",
@@ -412,6 +535,8 @@ COMMANDS = {
     "local-demo": _cmd_local_demo,
     "cluster-master": _cmd_cluster_master,
     "cluster-node": _cmd_cluster_node,
+    "train-cluster-master": _cmd_train_cluster_master,
+    "train-cluster-node": _cmd_train_cluster_node,
     "bench": _cmd_bench,
     "train-mlp": _cmd_train_mlp,
     "train-resnet": _cmd_train_resnet,

@@ -24,7 +24,9 @@ pre-welcome return address (the joiner does not yet know its node id);
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
+import os
 import time
 from typing import Any, Callable
 
@@ -55,11 +57,16 @@ class MasterProcess:
         *,
         clock: Callable[[], float] = time.monotonic,
         phi_threshold: float = 8.0,
+        metrics=None,  # utils.metrics.MetricsLogger | None
     ) -> None:
         self.config = config
         self.clock = clock
+        self.metrics = metrics
         self.grid = GridMaster(
-            config.threshold, config.master, config.line_master
+            config.threshold,
+            config.master,
+            config.line_master,
+            on_round_complete=self._on_round_complete if metrics else None,
         )
         self.monitor = HeartbeatMonitor(
             PhiAccrualFailureDetector(
@@ -69,6 +76,7 @@ class MasterProcess:
         )
         self.book: dict[int, cl.Endpoint] = {}
         self.unreachable: set[int] = set()
+        self._incarnations: dict[int, int] = {}
         self.transport = RemoteTransport(host, port)
         self.transport.register("master", self._on_cluster_msg)
         self.transport.register_prefix("line_master", self.grid.handle_for_line)
@@ -99,10 +107,10 @@ class MasterProcess:
         await self.transport.stop()
 
     async def run_until_done(self, timeout: float | None = None) -> None:
-        """Wait for every line to finish ``max_rounds``, then broadcast
-        ``Shutdown`` (requires ``line_master.max_rounds >= 0``)."""
+        """Wait until every line finished ``max_rounds`` (requires
+        ``line_master.max_rounds >= 0``); the detector poll loop broadcasts
+        ``Shutdown`` to all nodes the moment that happens."""
         await asyncio.wait_for(self._done.wait(), timeout)
-        await self.transport.send_all(self._broadcast(cl.Shutdown("done")))
 
     # -- routing helpers -------------------------------------------------------
 
@@ -130,31 +138,63 @@ class MasterProcess:
             out = self.grid.member_unreachable(msg.node_id)
             self.book.pop(msg.node_id, None)
             self.unreachable.discard(msg.node_id)
+            self._incarnations.pop(msg.node_id, None)
             return out + self._broadcast(self._address_book())
         raise TypeError(f"master cannot handle {type(msg).__name__}")
 
     def _on_join(self, msg: cl.JoinCluster, now: float) -> list[Envelope]:
         nid = msg.preferred_node_id
-        if nid < 0 or (
-            nid in self.book and self.book[nid] != cl.Endpoint(msg.host, msg.port)
-        ):
-            nid = max(self.book, default=-1) + 1
-        self.book[nid] = cl.Endpoint(msg.host, msg.port)
-        self.unreachable.discard(nid)
-        # pre-welcome return address: the joiner doesn't know its id yet
-        self.transport.set_route(
-            f"client:{msg.port}", cl.Endpoint(msg.host, msg.port)
+        ep = cl.Endpoint(msg.host, msg.port)
+        # A join retry must resolve to the id assigned on the FIRST attempt,
+        # even with auto-assigned ids (preferred -1): match by incarnation +
+        # endpoint before minting a fresh id, or the retry would admit the
+        # same process as a ghost second member
+        for known_nid, inc in self._incarnations.items():
+            if inc == msg.incarnation and self.book.get(known_nid) == ep:
+                nid = known_nid
+                break
+        else:
+            if nid < 0 or (nid in self.book and self.book[nid] != ep):
+                # an endpoint hosts at most one node process, so a fresh
+                # incarnation from a booked endpoint is that node reborn —
+                # reclaim its id; otherwise mint the next one
+                reborn = next(
+                    (k for k, v in self.book.items() if v == ep), None
+                )
+                nid = (
+                    reborn
+                    if reborn is not None
+                    else max(self.book, default=-1) + 1
+                )
+        # Welcome goes straight to the joiner's endpoint (``via``): it doesn't
+        # know its node id yet, so it can't be in any route table.
+        welcome = Envelope(
+            "client", cl.Welcome(nid, self.config.to_json()), via=ep
         )
+        if (
+            self._incarnations.get(nid) == msg.incarnation
+            and nid in self.grid.nodes
+        ):
+            # join RETRY from a node we already admitted: its Welcome was
+            # lost in flight — re-send it, change no membership state
+            self.monitor.heartbeat(nid, now)
+            return [welcome]
+        restarted = nid in self.grid.nodes
+        self.book[nid] = ep
+        self._incarnations[nid] = msg.incarnation
+        self.unreachable.discard(nid)
         self.monitor.heartbeat(nid, now)
         log.info("master: node %d joined from %s:%d", nid, msg.host, msg.port)
-        out = [
-            Envelope(
-                f"client:{msg.port}",
-                cl.Welcome(nid, self.config.to_json()),
-            )
-        ]
+        out = [welcome]
         out.extend(self._broadcast(self._address_book()))
-        out.extend(self.grid.member_up(nid))
+        if restarted:
+            # same identity re-joining before the detector noticed the crash:
+            # its workers are fresh and unconfigured, so member_up's no-op is
+            # wrong — force the Prepare/Confirm handshake for everyone
+            log.info("master: node %d restarted -> reorganize", nid)
+            out.extend(self.grid.reorganize())
+        else:
+            out.extend(self.grid.member_up(nid))
         return out
 
     def _on_heartbeat(self, node_id: int, now: float) -> list[Envelope]:
@@ -169,6 +209,22 @@ class MasterProcess:
                 node_id
             )
         return []
+
+    def _on_round_complete(
+        self, line_id: int, r: int, latency_s: float, done: int, n: int
+    ) -> None:
+        """Per-round observability (SURVEY.md §6): one JSONL record per
+        completed line-round — latency, contributors at threshold, config."""
+        self.metrics.log_event(
+            kind="round",
+            line=line_id,
+            round=r,
+            latency_s=round(latency_s, 6),
+            completions=done,
+            workers=n,
+            config=self.grid.config_id,
+            data_bytes=self.config.metadata.data_size * 4,
+        )
 
     def _address_book(self) -> cl.AddressBook:
         return cl.AddressBook(
@@ -199,16 +255,30 @@ class MasterProcess:
                 expelled = True
         if expelled:
             out.extend(self._broadcast(self._address_book()))
+        # at-most-once delivery can eat a Prepare (e.g. into a connection
+        # whose peer just restarted): re-send to unconfirmed workers
+        interval = self.config.master.heartbeat_interval_s
+        for lm in self.grid.line_masters.values():
+            out.extend(lm.reprepare_pending(2.0 * interval))
         if out:
             await self.transport.send_all(out)
-        if self.grid.is_done:
+        if self.grid.is_done and not self._done.is_set():
             self._done.set()
+            await self.transport.send_all(self._broadcast(cl.Shutdown("done")))
 
     @property
     def rounds_completed(self) -> int:
         """Line-rounds completed across ALL configurations, not just the
         current one (re-organization replaces the line masters)."""
         return self.grid.total_completed
+
+
+_incarnation_counter = itertools.count(1)
+
+
+def _new_incarnation() -> int:
+    """Unique per NodeProcess lifetime across processes on one host."""
+    return (os.getpid() << 20) | (next(_incarnation_counter) & 0xFFFFF)
 
 
 class NodeProcess:
@@ -223,20 +293,25 @@ class NodeProcess:
         port: int = 0,
         *,
         preferred_node_id: int = -1,
+        join_retry_s: float = 0.5,
     ) -> None:
         self.seed = seed
         self.data_source = data_source
         self.data_sink = data_sink
         self.preferred_node_id = preferred_node_id
+        self.join_retry_s = join_retry_s
+        self.incarnation = _new_incarnation()
         self.node_id: int | None = None
         self.node: AllreduceNode | None = None
         self.config: AllreduceConfig | None = None
         self.book = cl.AddressBook(())
+        self._endpoints: dict[int, cl.Endpoint] = {}
         self.transport = RemoteTransport(host, port)
         self.transport.set_route("master", seed)
         self.transport.set_prefix_route("line_master", lambda _lid: seed)
         self.transport.set_prefix_route("worker", self._peer_endpoint)
         self._heartbeat_task: asyncio.Task | None = None
+        self._join_task: asyncio.Task | None = None
         self._welcomed = asyncio.Event()
         self._shutdown = asyncio.Event()
         self.shutdown_reason: str | None = None
@@ -245,15 +320,22 @@ class NodeProcess:
 
     async def start(self) -> None:
         ep = await self.transport.start()
-        self.transport.register_prefix(
-            "client", lambda _port, msg: self._on_cluster_msg(msg)
+        self.transport.register(
+            "client", lambda msg: self._on_cluster_msg(msg)
         )
-        await self.transport.send(
-            Envelope(
-                "master",
-                cl.JoinCluster(ep.host, ep.port, self.preferred_node_id),
-            )
+        # The joiner owns the handshake retry (Akka Cluster joins the same
+        # way): re-send JoinCluster until Welcomed — the Welcome can vanish
+        # into a connection whose peer only just noticed we restarted.
+        join = cl.JoinCluster(
+            ep.host, ep.port, self.preferred_node_id, self.incarnation
         )
+
+        async def join_until_welcomed() -> None:
+            while not self._welcomed.is_set():
+                await self.transport.send(Envelope("master", join))
+                await asyncio.sleep(self.join_retry_s)
+
+        self._join_task = asyncio.create_task(join_until_welcomed())
 
     async def wait_welcomed(self, timeout: float = 10.0) -> int:
         await asyncio.wait_for(self._welcomed.wait(), timeout)
@@ -272,13 +354,15 @@ class NodeProcess:
             )
 
     async def stop(self) -> None:
-        if self._heartbeat_task is not None:
-            self._heartbeat_task.cancel()
-            try:
-                await self._heartbeat_task
-            except asyncio.CancelledError:
-                pass
-            self._heartbeat_task = None
+        for attr in ("_heartbeat_task", "_join_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
         await self.transport.stop()
 
     # -- routing helpers -------------------------------------------------------
@@ -286,9 +370,8 @@ class NodeProcess:
     def _peer_endpoint(self, worker_id: int) -> cl.Endpoint | None:
         if self.config is None:
             return None
-        return self.book.endpoint_of(
-            worker_id // self.config.master.dimensions
-        )
+        # dict lookup: this resolver runs per outgoing chunk on the data path
+        return self._endpoints.get(worker_id // self.config.master.dimensions)
 
     # -- cluster protocol ------------------------------------------------------
 
@@ -297,6 +380,9 @@ class NodeProcess:
             return self._on_welcome(msg)
         if isinstance(msg, cl.AddressBook):
             self.book = msg
+            self._endpoints = {
+                nid: cl.Endpoint(host, port) for nid, host, port in msg.entries
+            }
             return []
         if isinstance(msg, cl.Shutdown):
             self.shutdown_reason = msg.reason
@@ -305,6 +391,8 @@ class NodeProcess:
         raise TypeError(f"node cannot handle {type(msg).__name__}")
 
     def _on_welcome(self, msg: cl.Welcome) -> list[Envelope]:
+        if self._welcomed.is_set():
+            return []  # duplicate Welcome from a join retry race
         self.config = AllreduceConfig.from_json(msg.config_json)
         self.node_id = msg.node_id
         dims = self.config.master.dimensions

@@ -42,11 +42,18 @@ class JoinCluster:
     ``host``/``port`` is the joiner's own server endpoint — peers will dial it
     to deliver ScatterBlock/ReduceBlock. ``preferred_node_id`` lets a restarted
     node ask for its old identity back (-1 = master assigns).
+
+    ``incarnation`` identifies one NodeProcess lifetime. Joins are retried
+    until Welcomed (delivery is at-most-once), so the master uses it to tell
+    a retry (same incarnation: just re-send Welcome) from a process restart
+    on the same endpoint (new incarnation: the workers are fresh — force the
+    Prepare handshake).
     """
 
     host: str
     port: int
     preferred_node_id: int = -1
+    incarnation: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,7 +18,14 @@ def master_addr(line_id: int = 0) -> str:
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class Envelope:
-    """One outgoing message: deliver ``msg`` to ``dest`` (an address string)."""
+    """One outgoing message: deliver ``msg`` to ``dest`` (an address string).
+
+    ``via``, when set, pins the delivery endpoint explicitly instead of
+    resolving ``dest`` through the route table — used for replies to peers
+    that are not (yet) in any address book, e.g. the Welcome to a joiner.
+    Local routers ignore it.
+    """
 
     dest: str
     msg: Any
+    via: Any = None  # control.cluster.Endpoint | None

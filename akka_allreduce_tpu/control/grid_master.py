@@ -42,10 +42,13 @@ class GridMaster:
         threshold: ThresholdConfig,
         config: MasterConfig = MasterConfig(),
         line_master_config: LineMasterConfig = LineMasterConfig(),
+        *,
+        on_round_complete=None,  # LineMaster RoundObserver, fanned to all lines
     ) -> None:
         self.threshold = threshold
         self.config = config
         self.line_master_config = line_master_config
+        self.on_round_complete = on_round_complete
         self.nodes: set[int] = set()
         self.config_id = 0
         self.organized = False
@@ -76,9 +79,26 @@ class GridMaster:
             return []
         log.info("master: lost node %d -> reorganize", node_id)
         if not self.nodes:
+            # cluster emptied: fold the dying configuration's progress and
+            # round high-water mark exactly as _organize would, so a later
+            # repopulation neither undercounts nor reuses round numbers
+            self.resume_round = max(
+                lm.next_round for lm in self.line_masters.values()
+            )
+            self._completed_before_reorg += sum(
+                lm.total_completed for lm in self.line_masters.values()
+            )
             self.organized = False
             self.line_masters.clear()
             self._line_of_worker.clear()
+            return []
+        return self._organize()
+
+    def reorganize(self) -> list[Envelope]:
+        """Force a fresh line organization + Prepare handshake with the
+        current member set (e.g. a node process restarted under the same
+        identity and needs its workers re-configured)."""
+        if not self.organized or not self.nodes:
             return []
         return self._organize()
 
@@ -118,7 +138,10 @@ class GridMaster:
         out: list[Envelope] = []
         for line_id, worker_ids in enumerate(lines):
             lm = LineMaster(
-                self.threshold, self.line_master_config, line_id=line_id
+                self.threshold,
+                self.line_master_config,
+                line_id=line_id,
+                on_round_complete=self.on_round_complete,
             )
             self.line_masters[line_id] = lm
             for w in worker_ids:

@@ -10,7 +10,8 @@ completions are ignored), matching the worker's discipline.
 from __future__ import annotations
 
 import logging
-from typing import Any
+import time
+from typing import Any, Callable
 
 from akka_allreduce_tpu.config import LineMasterConfig, ThresholdConfig
 from akka_allreduce_tpu.control.envelope import Envelope, peer_addr
@@ -23,6 +24,9 @@ from akka_allreduce_tpu.protocol import (
 
 log = logging.getLogger(__name__)
 
+# (line_id, round_num, latency_s, completions at threshold, n_workers)
+RoundObserver = Callable[[int, int, float, int, int], None]
+
 
 class LineMaster:
     """Drives rounds for one line (worker group) of the grid."""
@@ -32,10 +36,16 @@ class LineMaster:
         threshold: ThresholdConfig,
         config: LineMasterConfig = LineMasterConfig(),
         line_id: int = 0,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        on_round_complete: RoundObserver | None = None,
     ) -> None:
         self.threshold = threshold
         self.config = config
         self.line_id = line_id
+        self.clock = clock
+        self.on_round_complete = on_round_complete
+        self._started_at: dict[int, float] = {}
         self.worker_ids: tuple[int, ...] = ()
         self.config_id: int = -1
         self.next_round = 0  # next round number to start
@@ -45,6 +55,7 @@ class LineMaster:
         self.total_completed = 0
         self._confirmed: set[int] = set()
         self._preparing = False
+        self._prepared_at = 0.0
 
     # -- configuration / handshake ------------------------------------------
 
@@ -60,15 +71,37 @@ class LineMaster:
         self.completed_up_to = from_round - 1
         self._confirmed.clear()
         self._preparing = True
+        self._prepared_at = self.clock()
+        return self._prepare_envelopes(self.worker_ids)
+
+    def _prepare_envelopes(self, workers) -> list[Envelope]:
         return [
             Envelope(
                 peer_addr(w),
                 PrepareAllreduce(
-                    config_id, self.worker_ids, w, from_round, self.line_id
+                    self.config_id, self.worker_ids, w, self.next_round,
+                    self.line_id,
                 ),
             )
-            for w in self.worker_ids
+            for w in workers
         ]
+
+    def reprepare_pending(self, min_age_s: float) -> list[Envelope]:
+        """Re-send PrepareAllreduce to workers that have not confirmed within
+        ``min_age_s`` — delivery is at-most-once (a send can vanish into a
+        connection whose peer just restarted), so the handshake must retry
+        rather than wedge the line (SURVEY.md §4.5)."""
+        if not self._preparing or self.clock() - self._prepared_at < min_age_s:
+            return []
+        pending = [w for w in self.worker_ids if w not in self._confirmed]
+        self._prepared_at = self.clock()
+        log.info(
+            "line %d: re-sending Prepare(config %d) to unconfirmed %s",
+            self.line_id,
+            self.config_id,
+            pending,
+        )
+        return self._prepare_envelopes(pending)
 
     @property
     def n_workers(self) -> int:
@@ -117,9 +150,19 @@ class LineMaster:
         # round complete at threshold; abandon older in-flight rounds
         self.completed_up_to = max(self.completed_up_to, r)
         self.total_completed += 1
+        if self.on_round_complete is not None:
+            started = self._started_at.get(r)
+            self.on_round_complete(
+                self.line_id,
+                r,
+                self.clock() - started if started is not None else -1.0,
+                len(done),
+                self.n_workers,
+            )
         for stale in [x for x in self.started_rounds if x <= r]:
             self.started_rounds.discard(stale)
             self.completions.pop(stale, None)
+            self._started_at.pop(stale, None)
         return self._fill_window()
 
     # -- round window --------------------------------------------------------
@@ -135,6 +178,8 @@ class LineMaster:
             r = self.next_round
             self.next_round += 1
             self.started_rounds.add(r)
+            if self.on_round_complete is not None:
+                self._started_at[r] = self.clock()
             out.extend(
                 Envelope(peer_addr(w), StartAllreduce(r)) for w in self.worker_ids
             )

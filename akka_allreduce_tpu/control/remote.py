@@ -136,27 +136,39 @@ class RemoteTransport:
     # -- sending -----------------------------------------------------------------
 
     async def send(self, env: Envelope) -> None:
-        handler = self._local_handler(env.dest)
-        if handler is not None:  # local delivery: no wire, same FIFO inbox
-            await self._inbox.put((env.dest, env.msg))
-            return
-        ep = self._resolve(env.dest)
+        if env.via is None:
+            handler = self._local_handler(env.dest)
+            if handler is not None:  # local delivery: no wire, same FIFO inbox
+                await self._inbox.put((env.dest, env.msg))
+                return
+        ep = env.via if env.via is not None else self._resolve(env.dest)
         if ep is None:
             log.warning("no route for %s; dropping", env.dest)
             self.dropped += 1
             return
         frame = wire.encode_frame(env.dest, env.msg)
-        try:
-            await self._write(ep, frame)
-        except (OSError, asyncio.TimeoutError) as exc:
-            self.dropped += 1
-            log.warning("send to %s (%s) failed: %s", env.dest, ep, exc)
-            writer = self._conns.pop(ep, None)
-            if writer is not None:
-                writer.close()
-            self._conn_locks.pop(ep, None)
-            if self.on_send_error is not None:
-                self.on_send_error(ep, env)
+        # One reconnect-and-retry: a cached connection whose peer restarted
+        # fails on the first write after the restart — that staleness is this
+        # transport's problem, not the control plane's. A failure on a FRESH
+        # connection means the peer is genuinely gone: drop (at-most-once).
+        for attempt in (0, 1):
+            try:
+                await self._write(ep, frame)
+                return
+            except (OSError, asyncio.TimeoutError) as exc:
+                had_conn = ep in self._conns
+                writer = self._conns.pop(ep, None)
+                if writer is not None:
+                    writer.close()
+                if attempt == 1 or not had_conn:
+                    self.dropped += 1
+                    log.warning(
+                        "send to %s (%s) failed: %s", env.dest, ep, exc
+                    )
+                    self._conn_locks.pop(ep, None)
+                    if self.on_send_error is not None:
+                        self.on_send_error(ep, env)
+                    return
 
     async def send_all(self, envelopes: list[Envelope]) -> None:
         for env in envelopes:

@@ -117,7 +117,7 @@ def encode(msg: Any) -> bytes:
         return (
             head
             + _pack_str(msg.host)
-            + struct.pack("<Hi", msg.port, msg.preferred_node_id)
+            + struct.pack("<Hiq", msg.port, msg.preferred_node_id, msg.incarnation)
         )
     if tag == 8:
         return head + struct.pack("<i", msg.node_id) + _pack_str(msg.config_json)
@@ -162,8 +162,8 @@ def decode(data: bytes | memoryview) -> Any:
         return ConfirmPreparation(*struct.unpack_from("<qi", buf, off))
     if tag == 7:
         host, off = _unpack_str(buf, off)
-        port, preferred = struct.unpack_from("<Hi", buf, off)
-        return cl.JoinCluster(host, port, preferred)
+        port, preferred, incarnation = struct.unpack_from("<Hiq", buf, off)
+        return cl.JoinCluster(host, port, preferred, incarnation)
     if tag == 8:
         (node_id,) = struct.unpack_from("<i", buf, off)
         config_json, _ = _unpack_str(buf, off + 4)

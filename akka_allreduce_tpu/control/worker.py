@@ -111,6 +111,20 @@ class AllreduceWorker:
     def _on_prepare(self, msg: PrepareAllreduce) -> list[Envelope]:
         if self.metadata is None or self.threshold is None:
             raise RuntimeError("configure(metadata, threshold) before Prepare")
+        if (
+            msg.config_id == self.config_id
+            and msg.worker_id == self.worker_id
+            and self.rounds is not None
+        ):
+            # duplicate of the current config (the master re-sends Prepare
+            # when a confirm is slow/lost): just re-confirm — rebuilding would
+            # destroy in-flight round state
+            return [
+                Envelope(
+                    master_addr(self.line_id),
+                    ConfirmPreparation(msg.config_id, msg.worker_id),
+                )
+            ]
         self.worker_id = msg.worker_id
         self.peer_ids = msg.peer_ids
         self.config_id = msg.config_id

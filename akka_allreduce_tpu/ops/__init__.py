@@ -24,6 +24,8 @@ without hardware, mirroring the reference's probe-based test philosophy
 from akka_allreduce_tpu.ops.local_reduce import (
     elastic_average_step,
     masked_average,
+    pack_tiles,
+    unpack_tiles,
 )
 from akka_allreduce_tpu.ops.ring import pallas_ring_allreduce_sum
 from akka_allreduce_tpu.ops.ring_attention import (
@@ -36,6 +38,8 @@ __all__ = [
     "attention_reference",
     "elastic_average_step",
     "masked_average",
+    "pack_tiles",
+    "unpack_tiles",
     "pallas_ring_allreduce_sum",
     "ring_attention",
     "ulysses_attention",

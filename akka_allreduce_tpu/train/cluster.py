@@ -1,0 +1,163 @@
+"""Distributed elastic-averaging training over the TCP cluster.
+
+This is the reference's actual training deployment (SURVEY.md §4.4): per JVM,
+a BIDMach learner trains while an ``AllreduceWorker`` asynchronously syncs the
+model through the elastic-averaging binder — rounds overlap training steps and
+thresholds keep stragglers from blocking anyone. Here, per node process: a
+local ``DPTrainer`` steps on its own data shard in a worker thread while the
+``NodeProcess`` (control/bootstrap.py) runs allreduce rounds over TCP.
+
+Learner/binder coupling is asynchronous, as in the reference (and EASGD
+generally): the binder never blocks on the learner. The learner thread
+publishes a weight *snapshot* after each step; binder rounds read the latest
+snapshot and deposit their elastic-averaged result in an incoming mailbox,
+which the learner folds in before its next step. Both hand-offs are single
+atomic reference swaps — no lock is ever held across a training step or a
+round, so heartbeats keep flowing while the learner crunches (a step longer
+than the heartbeat timeout must not get the node expelled).
+
+The weights move over the wire as float chunks (host engine) because the
+nodes are separate OS processes — the cross-process analog of the reference's
+Netty data plane. Within one process, the TPU path syncs gradients in-step
+via the fused masked psum instead (train/trainer.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Iterator
+
+import numpy as np
+
+from akka_allreduce_tpu.binder.elastic import ElasticAverageBinder
+from akka_allreduce_tpu.control.bootstrap import NodeProcess
+from akka_allreduce_tpu.control.cluster import Endpoint
+
+log = logging.getLogger(__name__)
+
+
+class ElasticClusterNode:
+    """One training node: local SGD + asynchronous weight allreduce.
+
+    Args:
+      seed: the master's endpoint.
+      trainer: a ``DPTrainer`` (typically over this node's local devices).
+      batches: iterator of ``(x, y)`` global batches for the LOCAL trainer.
+      elastic_rate: pull strength toward the group average (reference
+        ``NodeConfig.elastic_rate``).
+    """
+
+    def __init__(
+        self,
+        seed: Endpoint,
+        trainer,
+        batches: Iterator,
+        *,
+        elastic_rate: float = 0.5,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        preferred_node_id: int = -1,
+        on_step: Callable[[object], None] | None = None,
+    ) -> None:
+        self.trainer = trainer
+        self.batches = batches
+        self.on_step = on_step
+        # Cross-thread hand-off cells; every access is one reference
+        # read/swap (atomic under the GIL), never a held lock:
+        #   _snapshot: latest weights, published by the learner thread,
+        #              read by binder rounds on the event loop;
+        #   _incoming: latest elastic-averaged weights, deposited by the
+        #              binder, consumed by the learner before its next step.
+        self._snapshot: np.ndarray = trainer.get_flat_params()
+        self._incoming: np.ndarray | None = None
+        self.binder = ElasticAverageBinder(
+            self._read_snapshot, self._deposit, elastic_rate
+        )
+        self.node = NodeProcess(
+            seed,
+            self.binder.data_source,
+            self.binder.data_sink,
+            host,
+            port,
+            preferred_node_id=preferred_node_id,
+        )
+        self.losses: list[float] = []
+
+    # -- binder seam (runs on the transport event loop; must never block) ------
+
+    def _read_snapshot(self) -> np.ndarray:
+        return self._snapshot
+
+    def _deposit(self, vec: np.ndarray) -> None:
+        self._incoming = vec
+
+    # -- learner thread --------------------------------------------------------
+
+    def _train_one(self) -> bool:
+        try:
+            x, y = next(self.batches)
+        except StopIteration:
+            return False
+        incoming, self._incoming = self._incoming, None
+        if incoming is not None:
+            self.trainer.set_flat_params(incoming)
+        m = self.trainer.train_step(x, y)
+        self._snapshot = self.trainer.get_flat_params()
+        self.losses.append(m.loss)
+        if self.on_step is not None:
+            self.on_step(m)
+        return True
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def run(self, max_steps: int | None = None) -> int:
+        """Join the cluster, then train until the batches run out, ``max_steps``
+        is reached, or the master broadcasts Shutdown. Returns steps taken."""
+        await self.node.start()
+        node_id = await self.node.wait_welcomed()
+        expected = self.node.config.metadata.data_size
+        if expected != self.trainer.param_count:
+            raise ValueError(
+                f"cluster data_size {expected} != model param count "
+                f"{self.trainer.param_count}: master and nodes must be "
+                "started with the same model flags"
+            )
+        log.info(
+            "trainer node %d: %d params, elastic_rate=%.2f",
+            node_id,
+            self.trainer.param_count,
+            self.binder.elastic_rate,
+        )
+        steps = 0
+        shutdown = asyncio.ensure_future(self.node.run_until_shutdown())
+        try:
+            # A step budget is the node's own contract: train it to the end,
+            # syncing while rounds last (the master finishing its round budget
+            # first just means later steps run unsynced — the reference's
+            # learners likewise never block on the allreduce). Only an
+            # unbounded learner stops on the master's Shutdown.
+            while max_steps is None or steps < max_steps:
+                if max_steps is None and shutdown.done():
+                    break
+                stepped = await asyncio.to_thread(self._train_one)
+                if not stepped:
+                    break
+                steps += 1
+            if not shutdown.done():
+                # master still running rounds: depart gracefully so the
+                # remaining members re-line without detector latency
+                await self.node.leave()
+            # fold the final round's average in before reporting weights
+            incoming, self._incoming = self._incoming, None
+            if incoming is not None:
+                self.trainer.set_flat_params(incoming)
+        finally:
+            if not shutdown.done():
+                shutdown.cancel()
+            await self.node.stop()
+        return steps
+
+    @property
+    def rounds_applied(self) -> int:
+        return self.binder.rounds_applied

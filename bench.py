@@ -163,13 +163,21 @@ def main() -> None:
                 return lax.fori_loop(0, trips, body, X)
 
         else:
-            from akka_allreduce_tpu.ops import elastic_average_step
+            from akka_allreduce_tpu.ops import (
+                elastic_average_step,
+                pack_tiles,
+                unpack_tiles,
+            )
 
             def kernel(X, V, trips):
-                def body(_, X):
-                    return elastic_average_step(X, V, alpha)
+                # carry the PRE-TILED form through the loop: reshaping inside
+                # the body defeats the kernel's input/output aliasing across
+                # the fori_loop carry (3x slower, ops/local_reduce.py)
+                def body(_, Xt):
+                    return elastic_average_step(Xt, V, alpha)
 
-                return lax.fori_loop(0, trips, body, X)
+                out = lax.fori_loop(0, trips, body, pack_tiles(X))
+                return unpack_tiles(out, X.shape[1])
 
         fn = jax.jit(kernel)
         metric = f"local_threshold_reduce_bw_{mfloat}Mfloat"

@@ -1,0 +1,101 @@
+"""Pallas fused threshold-reduce kernels vs the numpy oracle.
+
+Runs under the Pallas TPU interpreter on the CPU backend (SURVEY.md §5 test
+philosophy: numeric oracle = masked-sum / count in numpy). Covers full /
+partial / zero contributor masks and non-tile-aligned payload sizes (the
+kernels pad to (rows, 128) tiles internally and must trim exactly).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.ops import (
+    elastic_average_step,
+    masked_average,
+    pack_tiles,
+    unpack_tiles,
+)
+
+
+def _payloads(k=4, data=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((k, data)).astype(np.float32)
+
+
+def _oracle_avg(x, valid):
+    count = valid.sum()
+    total = (x * valid[:, None]).sum(0)
+    return total / max(count, 1.0), count
+
+
+@pytest.mark.parametrize("data", [1000, 128 * 512, 128 * 512 + 1, 17])
+def test_masked_average_full_mask(data):
+    x = _payloads(data=data)
+    valid = np.ones(4, np.float32)
+    avg, cnt = masked_average(x, valid)
+    exp, exp_cnt = _oracle_avg(x, valid)
+    assert float(cnt) == exp_cnt
+    np.testing.assert_allclose(np.asarray(avg), exp, rtol=1e-6, atol=1e-6)
+
+
+def test_masked_average_partial_mask():
+    x = _payloads(k=8)
+    valid = np.array([1, 0, 1, 1, 0, 0, 1, 0], np.float32)
+    avg, cnt = masked_average(x, valid)
+    exp, exp_cnt = _oracle_avg(x, valid)
+    assert float(cnt) == exp_cnt == 4.0
+    np.testing.assert_allclose(np.asarray(avg), exp, rtol=1e-6, atol=1e-6)
+
+
+def test_masked_average_zero_mask():
+    x = _payloads()
+    avg, cnt = masked_average(x, np.zeros(4, np.float32))
+    assert float(cnt) == 0.0
+    np.testing.assert_array_equal(np.asarray(avg), np.zeros_like(x[0]))
+
+
+@pytest.mark.parametrize("data", [1000, 128 * 512])
+def test_elastic_average_step(data):
+    x = _payloads(k=4, data=data)
+    valid = np.array([1, 1, 0, 1], np.float32)
+    alpha = 0.25
+    out = np.asarray(elastic_average_step(x, valid, alpha))
+    exp_avg, _ = _oracle_avg(x, valid)
+    exp = (1 - alpha) * x + alpha * exp_avg[None]
+    np.testing.assert_allclose(out, exp, rtol=1e-6, atol=1e-6)
+
+
+def test_elastic_average_step_zero_mask_keeps_state():
+    x = _payloads()
+    out = np.asarray(elastic_average_step(x, np.zeros(4, np.float32), 0.5))
+    np.testing.assert_array_equal(out, x)
+
+
+@pytest.mark.parametrize("data", [1000, 128 * 512])
+def test_elastic_average_step_tiled_matches_flat(data):
+    """The pre-tiled fast path (loop-carry form) equals the 2D path."""
+    x = _payloads(k=4, data=data, seed=3)
+    valid = np.array([1, 0, 1, 1], np.float32)
+    flat_out = np.asarray(elastic_average_step(x, valid, 0.3))
+    xt = pack_tiles(x)
+    tiled_out = np.asarray(
+        unpack_tiles(elastic_average_step(xt, valid, 0.3), data)
+    )
+    np.testing.assert_allclose(tiled_out, flat_out, rtol=1e-6, atol=1e-6)
+
+
+def test_elastic_average_step_tiled_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        elastic_average_step(
+            np.zeros((2, 100, 128), np.float32), np.ones(2, np.float32), 0.5
+        )
+
+
+def test_elastic_average_step_is_fixed_point_at_consensus():
+    # replicas already equal -> the update must be a no-op for any alpha
+    base = _payloads(k=1)[0]
+    x = np.tile(base, (4, 1))
+    out = np.asarray(elastic_average_step(x, np.ones(4, np.float32), 0.9))
+    np.testing.assert_allclose(out, x, rtol=1e-6, atol=1e-6)

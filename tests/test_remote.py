@@ -271,6 +271,37 @@ def test_cluster_late_joiner_participates():
     asyncio.run(run())
 
 
+def test_cluster_round_metrics_jsonl():
+    """Per-round observability (SURVEY.md §6): every completed line-round
+    emits a JSONL record with latency and contributor count."""
+    import json
+
+    from akka_allreduce_tpu.utils.metrics import MetricsLogger
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=5), 2)
+        metrics = MetricsLogger()  # in-memory
+        h.master = MasterProcess(h.config, port=0, metrics=metrics)
+        try:
+            await h.start(2)
+            await h.master.run_until_done(timeout=20.0)
+        finally:
+            await h.stop()
+        records = [
+            json.loads(line)
+            for line in metrics.dump().splitlines()
+            if json.loads(line).get("kind") == "round"
+        ]
+        assert len(records) == 5
+        assert {r["round"] for r in records} == set(range(5))
+        for r in records:
+            assert r["completions"] == 2 and r["workers"] == 2
+            assert r["latency_s"] > 0
+            assert r["data_bytes"] == h.config.metadata.data_size * 4
+
+    asyncio.run(run())
+
+
 def test_cluster_cli_multiprocess_smoke():
     """True multi-process deployment: master + 2 node OS processes over the
     CLI roles, every chunk crossing real process boundaries (SURVEY.md §4.1)."""
@@ -316,6 +347,58 @@ def test_cluster_cli_multiprocess_smoke():
         for proc in [master, *nodes]:
             if proc.poll() is None:
                 proc.kill()
+
+
+def test_join_retry_with_auto_id_is_deduplicated():
+    """A retried JoinCluster (lost Welcome) with auto-assigned node id must
+    resolve to the id minted on the first attempt, not admit a ghost member."""
+    from akka_allreduce_tpu.control.cluster import JoinCluster
+
+    master = MasterProcess(_config(2), port=0)
+    join = JoinCluster("127.0.0.1", 50001, -1, incarnation=7)
+    master._on_cluster_msg(join)
+    assert sorted(master.book) == [0]
+    retry = master._on_cluster_msg(join)  # identical retry (lost Welcome)
+    assert sorted(master.book) == [0], "retry minted a ghost member"
+    assert sorted(master.grid.nodes) == [0]
+    # the retry's only effect is a re-sent Welcome
+    assert [type(e.msg).__name__ for e in retry] == ["Welcome"]
+    # a NEW incarnation on the same endpoint IS a restart, not a retry
+    master._on_cluster_msg(JoinCluster("127.0.0.1", 50001, -1, incarnation=8))
+    assert sorted(master.book) == [0]
+    assert master._incarnations[0] == 8
+
+
+def test_restart_same_identity_is_reprepared():
+    """A node that crashes and restarts on the same port/id BEFORE the phi
+    detector notices must be re-Prepared (its workers are fresh): the master
+    forces a reorganization on a join from an already-live identity."""
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=-1), 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 2)
+            old = h.nodes.pop(1)
+            port = old.transport.endpoint.port
+            await old.stop()  # crash: no leave message
+            # restart immediately on the SAME endpoint with the SAME id
+            node = NodeProcess(
+                h.seed,
+                h._source(1),
+                h._sink(1),
+                port=port,
+                preferred_node_id=1,
+            )
+            await node.start()
+            await node.wait_welcomed()
+            h.nodes[1] = node
+            f1 = h.flushes(1)
+            await h.wait_for(lambda: h.flushes(1) >= f1 + 3, timeout=15.0)
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
 
 
 def test_rejoin_after_heartbeat_resume():
