@@ -144,6 +144,22 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
     return 0
 
 
+def _cmd_bench_suite(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "bench-suite",
+        description="run the full BASELINE config matrix (configs 1-5), one "
+        "JSON record each (BASELINE.md)",
+    )
+    p.add_argument("--out", default=None, help="append records to this JSONL")
+    p.add_argument("--quick", action="store_true", help="1/8-size payloads")
+    args = p.parse_args(argv)
+
+    from akka_allreduce_tpu.bench_suite import run_suite
+
+    run_suite(quick=args.quick, out=args.out)
+    return 0
+
+
 def _cmd_train_mlp(argv: list[str]) -> int:
     p = argparse.ArgumentParser("train-mlp", description="MLP/MNIST DP-SGD (config 3)")
     _train_flags(p)
@@ -538,6 +554,7 @@ COMMANDS = {
     "train-cluster-master": _cmd_train_cluster_master,
     "train-cluster-node": _cmd_train_cluster_node,
     "bench": _cmd_bench,
+    "bench-suite": _cmd_bench_suite,
     "train-mlp": _cmd_train_mlp,
     "train-resnet": _cmd_train_resnet,
     "train-lm": _cmd_train_lm,

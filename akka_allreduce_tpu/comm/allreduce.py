@@ -253,11 +253,20 @@ def build_threshold_allreduce(
                 vx = (xp * v[:, None]).reshape(-1)[:data_size]
             if schedule == "pallas_ring":
                 from akka_allreduce_tpu.ops.ring import (
+                    _DEF_SEG_ROWS,
+                    LANE,
                     pallas_ring_allreduce_sum,
                 )
 
+                # max_chunk_size doubles as the kernel's VMEM staging size:
+                # one ring step moves bucket_size/n elements per neighbor
+                seg_rows = (
+                    max(1, bucket_size // (n_devices * LANE))
+                    if bucket_size is not None
+                    else _DEF_SEG_ROWS
+                )
                 total = pallas_ring_allreduce_sum(
-                    vx, axis_names[0], n_devices
+                    vx, axis_names[0], n_devices, seg_rows=seg_rows
                 )
             else:
                 total = ring_allreduce_sum(vx, axis_names[0], n_devices)

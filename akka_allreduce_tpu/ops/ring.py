@@ -6,8 +6,7 @@ around a ring, accumulating as they go. Here that schedule is a compiled TPU
 kernel: reduce-scatter then all-gather over the ICI ring, double-buffered
 remote DMA per step, with explicit semaphore back-pressure so a fast neighbor
 can never overwrite a slot that has not been consumed yet (the Pallas
-interpreter's race detector verifies this in tests/test_ops.py — it catches
-the naive two-slot version without back-pressure).
+interpreter's race detector verifies this in tests/test_pallas_ring.py).
 
 Payloads are processed in VMEM-resident *buckets* — the framework's
 ``max_chunk_size`` granularity (SURVEY.md §3 "chunked buffers") doubles as

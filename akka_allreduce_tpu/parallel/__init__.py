@@ -15,3 +15,12 @@ from akka_allreduce_tpu.parallel.mesh import (  # noqa: F401
     grid_mesh,
     line_mesh,
 )
+from akka_allreduce_tpu.parallel.multihost import (  # noqa: F401
+    global_line_mesh,
+    host_local_to_global,
+    process_allgather,
+    slice_grid_mesh,
+)
+from akka_allreduce_tpu.parallel.multihost import (  # noqa: F401
+    initialize as initialize_multihost,
+)

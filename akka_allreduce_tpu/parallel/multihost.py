@@ -1,0 +1,120 @@
+"""Multi-host (multi-slice / pod) bootstrap glue.
+
+The reference scales across JVMs with Akka Cluster over the host network
+(SURVEY.md §3 "Distributed communication backend"); the TPU-native equivalent
+splits by traffic class: *payloads* ride ICI within a slice and DCN across
+slices via XLA collectives — exactly the same ``psum``/``shard_map`` code as
+single-host, just over a global mesh — while *control* (membership, round
+scheduling, elasticity) stays on the host network (control/bootstrap.py, or
+``jax.distributed``'s coordination service bootstrapped here).
+
+Division of labor with the rest of the framework:
+
+- this module: process-group init (``jax.distributed``) + global mesh
+  construction + host-local <-> global array plumbing;
+- ``comm/``: the collectives themselves — unchanged, they take a Mesh;
+- ``control/``: threshold rounds + elastic membership — unchanged, its
+  transport already crosses hosts.
+
+On a TPU pod each process (host) owns 4-8 local chips; after
+:func:`initialize` every process sees the global device list and builds the
+SAME mesh, and jitted SPMD programs launch collectively. There is no
+multi-host hardware in CI, so these helpers are exercised there only for
+their single-process degenerate forms; the multi-chip sharding itself is
+validated by ``__graft_entry__.dryrun_multichip`` on the virtual mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.parallel.mesh import LINE_AXIS, grid_factors
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the JAX process group (idempotent for single-process runs).
+
+    With no arguments, defers to ``jax.distributed``'s auto-detection (TPU
+    pod metadata, or the ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+    / ``JAX_PROCESS_ID`` environment, matching the reference's seed-node
+    configuration in ``application.conf``). Single-process runs (everything
+    in CI here) skip initialization entirely.
+    """
+    env = os.environ
+    coordinator_address = coordinator_address or env.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and env.get("JAX_NUM_PROCESSES"):
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and env.get("JAX_PROCESS_ID"):
+        process_id = int(env["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        return  # single process: nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_line_mesh(axis: str = LINE_AXIS) -> Mesh:
+    """1D mesh over every chip of every process (pod-wide allreduce line)."""
+    return jax.make_mesh((len(jax.devices()),), (axis,))
+
+
+def slice_grid_mesh(axes: tuple[str, str] = ("rows", "cols")) -> Mesh:
+    """2D butterfly grid over the global device list, laid out so the
+    ``cols`` reduction stage stays entirely within one process/slice (rides
+    ICI) while the ``rows`` stage crosses hosts (rides DCN) — SURVEY.md §4.3
+    scaled up.
+
+    ``jax.devices()`` orders devices process-contiguously, so shaping the
+    grid as ``(n_processes, chips_per_process)`` puts each grid row inside
+    one process: a psum over ``cols`` (fixed row, varying col) never leaves
+    the host, and a psum over ``rows`` is the cross-host stage.
+    """
+    devs = jax.devices()
+    n_local = max(1, len(jax.local_devices()))
+    n = len(devs)
+    if n % n_local == 0 and n // n_local > 1:
+        rows, cols = n // n_local, n_local
+    else:
+        rows, cols = grid_factors(n)
+    grid = np.array(devs).reshape(rows, cols)
+    return Mesh(grid, axes)
+
+
+def host_local_to_global(
+    x: np.ndarray, mesh: Mesh, spec: P
+) -> jax.Array:
+    """Assemble per-process host arrays into one global sharded array.
+
+    Each process passes ITS shard (the reference's per-worker payload); the
+    result is the global array the collectives consume. Single-process: a
+    plain ``device_put``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(x, mesh, spec)
+
+
+def process_allgather(x) -> np.ndarray:
+    """Gather a small host value from every process (control-plane sync
+    helper, e.g. agreeing on a contributor mask before a round)."""
+    if jax.process_count() == 1:
+        return np.asarray(x)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x))
