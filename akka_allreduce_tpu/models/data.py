@@ -42,6 +42,29 @@ class SyntheticClassification:
             images = self.templates[labels] + self.noise * noise
             yield images, labels.astype(np.int32)
 
+    def device_sampler(self):
+        """A traced ``(key, batch_size) -> (x, y)`` drawing the same task
+        distribution ON DEVICE — the data-loader path that keeps training
+        loops free of host->device transfers (each DP device draws its own
+        shard inside the jitted step; see ``DPTrainer.train_chain``)."""
+        import jax
+        import jax.numpy as jnp
+
+        templates = jnp.asarray(self.templates)
+        noise_scale = self.noise
+        classes = self.classes
+        shape = self.input_shape
+
+        def sample(key, batch_size: int):
+            kl, kn = jax.random.split(key)
+            labels = jax.random.randint(kl, (batch_size,), 0, classes)
+            x = templates[labels] + noise_scale * jax.random.normal(
+                kn, (batch_size, *shape), dtype=jnp.float32
+            )
+            return x, labels.astype(jnp.int32)
+
+        return sample
+
 
 class SyntheticCopyLM:
     """Long-range-dependency LM stream: the second half of every sequence

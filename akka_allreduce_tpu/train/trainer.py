@@ -83,6 +83,7 @@ class DPTrainer:
         data_spec = P(
             self.axis_names if len(self.axis_names) > 1 else self.axis_names[0]
         )
+        self._data_spec = data_spec
         self._data_sharding = NamedSharding(mesh, data_spec)
         self._replicated = NamedSharding(mesh, P())
         axis_names = self.axis_names
@@ -147,6 +148,8 @@ class DPTrainer:
             out_specs=(P(), P(), P(), P()),
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
+        self._raw_step = step  # reused by train_chain's on-device loop
+        self._chains: dict = {}
 
         def eval_correct(params, x, y):
             logits = model_apply(params, x)
@@ -210,6 +213,93 @@ class DPTrainer:
         xd, yd = self._place_batch(x, y)
         hits = self._eval(self.params, xd, yd)
         return float(hits) / x.shape[0]
+
+    # -- on-device training chain (data-loader path, no host I/O per step) ---
+
+    def _build_chain(self, sampler, steps: int, batch_per_device: int):
+        axis_names = self.axis_names
+        raw_step = self._raw_step
+
+        def chain(params, opt_state, key, valid):
+            # independent per-device stream: fold the device's mesh
+            # coordinates into the key (this IS the DP data shard)
+            dkey = key
+            for a in axis_names:
+                dkey = jax.random.fold_in(dkey, lax.axis_index(a))
+
+            def body(carry, i):
+                p, o = carry
+                k = jax.random.fold_in(dkey, i)
+                x, y = sampler(k, batch_per_device)
+                p, o, loss, cnt = raw_step(p, o, x, y, valid)
+                return (p, o), (loss, cnt)
+
+            (params, opt_state), (losses, cnts) = lax.scan(
+                body, (params, opt_state), jnp.arange(steps)
+            )
+            return params, opt_state, losses, cnts
+
+        mapped = jax.shard_map(
+            chain,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), self._data_spec),
+            out_specs=(P(), P(), P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_chain(
+        self,
+        sampler,
+        steps: int,
+        batch_per_device: int,
+        *,
+        valid: Sequence[float] | None = None,
+        seed: int = 0,
+    ) -> list[TrainStepMetrics]:
+        """Run ``steps`` DP steps entirely on device in ONE dispatch.
+
+        ``sampler`` is a traced ``(key, batch_size) -> (x, y)`` (e.g.
+        ``SyntheticClassification.device_sampler``); each device draws its own
+        batch shard per step, so no host->device transfer happens inside the
+        loop — the data-loader discipline for tunneled/remote chips where a
+        per-step host round trip costs more than the step itself.
+        """
+        cache_key = (id(sampler), steps, batch_per_device)
+        if cache_key not in self._chains:
+            self._chains[cache_key] = self._build_chain(
+                sampler, steps, batch_per_device
+            )
+        if valid is None:
+            valid_arr = np.ones((self.n_devices,), np.float32)
+        else:
+            valid_arr = np.asarray(valid, np.float32)
+            if valid_arr.shape != (self.n_devices,):
+                raise ValueError(
+                    f"valid must have shape ({self.n_devices},), got {valid_arr.shape}"
+                )
+        vd = jax.device_put(valid_arr, self._data_sharding)
+        # fold the current step count in so consecutive chain calls continue
+        # the data stream instead of replaying the same batches
+        key = jax.device_put(
+            jax.random.fold_in(jax.random.PRNGKey(seed), self.step_num),
+            self._replicated,
+        )
+        self.params, self.opt_state, losses, cnts = self._chains[cache_key](
+            self.params, self.opt_state, key, vd
+        )
+        losses = np.asarray(jax.device_get(losses))
+        cnts = np.asarray(jax.device_get(cnts))
+        out = []
+        for loss, cnt in zip(losses, cnts):
+            self.step_num += 1
+            out.append(
+                TrainStepMetrics(
+                    step=self.step_num,
+                    loss=float(loss),
+                    contributors=float(cnt),
+                )
+            )
+        return out
 
     # -- weights as a flat buffer (binder/checkpoint seam) -------------------
 
