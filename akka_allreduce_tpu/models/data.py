@@ -91,6 +91,27 @@ class SyntheticCopyLM:
             seq = np.concatenate([first, first[:, 1:]], axis=1)  # len + 1
             yield seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
 
+    def device_sampler(self):
+        """Traced ``(key, batch_rows) -> (tokens, labels)`` of GLOBAL
+        sequences, drawn on device. Under sequence parallelism every seq
+        shard of a replica row must agree on the row's data, so the chain
+        gives all shards of a row the same key and each slices its own
+        ``T_local`` columns (``LongContextTrainer.train_chain``)."""
+        import jax
+        import jax.numpy as jnp
+
+        half = self.seq_len // 2
+        vocab = self.vocab
+
+        def sample(key, batch_rows: int):
+            first = jax.random.randint(
+                key, (batch_rows, half + 1), 0, vocab, dtype=jnp.int32
+            )
+            seq = jnp.concatenate([first, first[:, 1:]], axis=1)
+            return seq[:, :-1], seq[:, 1:]
+
+        return sample
+
 
 def lm_copy_task(seq_len: int = 128, vocab: int = 64, seed: int = 0) -> SyntheticCopyLM:
     """The long-context LM workload (no analog in the reference — SURVEY.md §6)."""

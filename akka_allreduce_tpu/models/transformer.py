@@ -81,7 +81,11 @@ class Attention(nn.Module):
         q, k = rope(q, offset), rope(k, offset)
 
         if self.seq_axis is None:
-            out = attention_reference(q, k, v, causal=True)
+            # dense single-device form: dispatch to the best local core
+            # (flash kernel on TPU, blockwise off-chip for long T)
+            from akka_allreduce_tpu.ops.local_attention import local_attention
+
+            out = local_attention(q, k, v, causal=True)
         elif self.seq_impl == "ring":
             out = ring_attention(q, k, v, self.seq_axis, causal=True)
         elif self.seq_impl == "ulysses":

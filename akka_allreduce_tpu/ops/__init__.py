@@ -28,6 +28,10 @@ from akka_allreduce_tpu.ops.local_reduce import (
     unpack_tiles,
 )
 from akka_allreduce_tpu.ops.ring import pallas_ring_allreduce_sum
+from akka_allreduce_tpu.ops.local_attention import (
+    blockwise_attention,
+    local_attention,
+)
 from akka_allreduce_tpu.ops.ring_attention import (
     attention_reference,
     ring_attention,
@@ -36,6 +40,8 @@ from akka_allreduce_tpu.ops.ring_attention import (
 
 __all__ = [
     "attention_reference",
+    "blockwise_attention",
+    "local_attention",
     "elastic_average_step",
     "masked_average",
     "pack_tiles",

@@ -1,0 +1,163 @@
+"""Single-device attention cores: blockwise (flash-style) + kernel dispatch.
+
+Dense attention materializes the (B, H, T, T) score matrix — ~1 GB per layer
+at T=2048/B=8/H=8 fp32 — so every long-context path that lands on ONE device
+(the sp=1 fast path of ring attention, and Ulysses' full-sequence local core)
+was HBM-bound on score traffic, not FLOPs. Two fixes, dispatched by
+:func:`local_attention`:
+
+- :func:`blockwise_attention` — portable memory-efficient attention: an
+  online-softmax ``lax.scan`` over K/V blocks (the same recurrence ring
+  attention runs across devices, applied within one device), with
+  ``jax.checkpoint`` on the block step so autodiff RECOMPUTES block scores in
+  the backward pass instead of saving them — O(T·block) live memory for
+  forward+backward instead of O(T^2).
+- the Pallas TPU flash-attention kernel (``jax.experimental.pallas.ops``) when
+  running on a real TPU backend and the shape fits its tiling — the fused
+  MXU kernel, used for both forward and backward via its custom VJP.
+
+Measured on v5e: the 4-layer LM step (B=8, H=8, T=2048, D=32) went from
+786 ms/step dense to 85 ms/step on the flash path with bf16 activations
+(BENCHMARKS.md).
+
+Convention for a query row with NO visible keys (fully-causal-masked or
+all-padding window): the output row is zero — masked positions contribute
+exactly nothing (``online_softmax_update``), unlike a dense softmax which
+would fall back to a uniform average of whatever it was given.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from akka_allreduce_tpu.ops.ring_attention import (
+    attention_reference,
+    online_softmax_update,
+)
+
+# dense is fine (and fastest) below this sequence length: the score block
+# fits comfortably in VMEM-scale working sets
+_DENSE_MAX_T = 512
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    q_offset: int | jax.Array = 0,
+    k_offset: int | jax.Array = 0,
+    block_k: int = 512,
+) -> jax.Array:
+    """Memory-efficient attention over K/V blocks; same result as
+    :func:`attention_reference` to float tolerance.
+
+    Shapes: ``q`` (B, Tq, H, D); ``k``/``v`` (B, Tk, H, D). Offsets position
+    the local windows globally for causal masking (as in ring attention).
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    nb = -(-tk // block_k)
+    pad = nb * block_k - tk
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (nb, B, block, H, D) so scan carries one block per step
+    kb = kp.reshape(b, nb, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(b, nb, block_k, h, d).transpose(1, 0, 2, 3, 4)
+
+    qf = q.astype(jnp.float32)
+    q_pos = q_offset + jnp.arange(tq)
+
+    def block_step(olm, blk):
+        idx, kk, vv = blk
+        k_pos = k_offset + idx * block_k + jnp.arange(block_k)
+        valid = k_pos < k_offset + tk  # mask the zero-padding tail
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (tq, block_k))
+        return online_softmax_update(olm, qf, kk, vv, scale, valid), None
+
+    from akka_allreduce_tpu.ops.ring_attention import _MASK_VALUE
+
+    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    m0 = jnp.full((b, h, tq), _MASK_VALUE, jnp.float32)
+    # checkpoint: backward recomputes each block's scores instead of storing
+    # them — this is what keeps live memory O(T * block) through autodiff
+    step = jax.checkpoint(block_step)
+    (o, l, _), _ = lax.scan(
+        step, (o0, l0, m0), (jnp.arange(nb), kb, vb)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def flash_shapes_ok(t: int, d: int) -> bool:
+    """Would the Pallas TPU flash kernel accept (T=t, head_dim=d)?
+
+    Conservative static gate (the kernel tiles T in 128-row blocks); also the
+    question trainers ask to decide whether shard_map's vma check must be
+    relaxed (the kernel's outputs carry no varying-axes annotation).
+    """
+    return t > _DENSE_MAX_T and t % 512 == 0 and d % 32 == 0
+
+
+def _flash_ok(q: jax.Array, k: jax.Array, q_offset, k_offset) -> bool:
+    """Shape/placement gate for the Pallas TPU flash kernel."""
+    if jax.default_backend() != "tpu":
+        return False
+    if not (isinstance(q_offset, int) and q_offset == 0):
+        return False
+    if not (isinstance(k_offset, int) and k_offset == 0):
+        return False
+    b, tq, h, d = q.shape
+    return tq == k.shape[1] and flash_shapes_ok(tq, d)
+
+
+def local_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    q_offset: int | jax.Array = 0,
+    k_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Best single-device attention for the shape/backend at hand.
+
+    Dispatch: dense for short sequences (fastest, fits on chip), the Pallas
+    TPU flash kernel when on TPU with kernel-friendly shapes, else the
+    portable blockwise path. All three agree with the dense oracle.
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[1] <= _DENSE_MAX_T and k.shape[1] <= _DENSE_MAX_T:
+        return attention_reference(
+            q, k, v, causal=causal, sm_scale=scale,
+            q_offset=q_offset, k_offset=k_offset,
+        )
+    if _flash_ok(q, k, q_offset, k_offset):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3),  # (B, H, T, D) kernel layout
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=causal,
+            sm_scale=scale,
+        )
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    return blockwise_attention(
+        q, k, v, causal=causal, sm_scale=scale,
+        q_offset=q_offset, k_offset=k_offset,
+    )

@@ -40,6 +40,34 @@ from jax import lax
 _MASK_VALUE = -1e30
 
 
+def online_softmax_update(olm, qf, kk, vv, scale, mask):
+    """One flash-style block fold: merge K/V block (kk, vv) into the running
+    ``(o, l, m)`` statistics for queries ``qf`` (all fp32).
+
+    ``mask``: boolean (Tq, Tk_block) visibility, or None for a fully visible
+    block. Masked positions contribute EXACTLY zero — including the corner
+    case where a whole row has seen nothing yet (m still at the sentinel):
+    there ``exp(score - m) = 1`` would otherwise leak mask/padding entries
+    into ``l``. Shared by ring attention (cross-device blocks) and the
+    single-device blockwise path so the numerically delicate recurrence
+    exists once.
+    """
+    o, l, m = olm
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kk.astype(jnp.float32)) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, _MASK_VALUE)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, vv.astype(jnp.float32)
+    )
+    return o, l, m_new
+
+
 def attention_reference(
     q: jax.Array,
     k: jax.Array,
@@ -97,7 +125,9 @@ def ring_attention(
     n = lax.axis_size(axis_name)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if n == 1:
-        return attention_reference(q, k, v, causal=causal, sm_scale=scale)
+        from akka_allreduce_tpu.ops.local_attention import local_attention
+
+        return local_attention(q, k, v, causal=causal, sm_scale=scale)
     b, t, h, d = q.shape
     my = lax.axis_index(axis_name)
     fwd = [(i, (i + 1) % n) for i in range(n)]
@@ -107,22 +137,12 @@ def ring_attention(
 
     def block_update(olm, src, kk, vv):
         """Fold the K/V shard that originated on device `src` into (o, l, m)."""
-        o, l, m = olm
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, kk.astype(jnp.float32)
-        ) * scale
         if causal:
             k_pos = src * t + jnp.arange(t)
             mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, _MASK_VALUE)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        p = jnp.exp(scores - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vv.astype(jnp.float32)
-        )
-        return o, l, m_new
+        else:
+            mask = None
+        return online_softmax_update(olm, qf, kk, vv, scale, mask)
 
     def step(s, carry):
         o, l, m, kk, vv = carry
@@ -158,9 +178,11 @@ def ulysses_attention(
     full-sequence dense attention on the local head group, and re-shards back.
     Requires ``H % lax.axis_size(axis_name) == 0``.
     """
+    from akka_allreduce_tpu.ops.local_attention import local_attention
+
     n = lax.axis_size(axis_name)
     if n == 1:
-        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        return local_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     if q.shape[2] % n:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by axis size {n}"
@@ -170,5 +192,6 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention_reference(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    # full-sequence local core: memory-efficient/flash, not dense
+    out = local_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

@@ -69,6 +69,7 @@ class LongContextTrainer:
         optimizer: optax.GradientTransformation | None = None,
         learning_rate: float = 0.1,
         seed: int = 0,
+        compute_dtype=jnp.float32,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import TransformerLM
 
@@ -93,6 +94,7 @@ class LongContextTrainer:
             n_layers=n_layers,
             seq_axis=self.seq_axis,
             seq_impl=seq_impl,
+            compute_dtype=compute_dtype,
         )
         self.tx = optimizer or optax.adam(learning_rate)
 
@@ -103,6 +105,7 @@ class LongContextTrainer:
             d_model=d_model,
             n_heads=n_heads,
             n_layers=n_layers,
+            compute_dtype=compute_dtype,
         )
         tokens0 = jnp.zeros((1, seq_len // self.sp), jnp.int32)
         self.params = init_model.init(jax.random.PRNGKey(seed), tokens0)
@@ -145,13 +148,32 @@ class LongContextTrainer:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, loss_avg, contributors
 
+        # The Pallas flash-attention kernel emits outputs with no varying-
+        # axes annotation, so shard_map's static vma check cannot type it.
+        # Relax the check ONLY when flash can actually dispatch for this
+        # configuration (TPU backend + kernel-friendly shapes on a path that
+        # runs a full local attention: sp==1, or Ulysses' local core);
+        # everywhere else the check stays on — it is the static safety net.
+        from akka_allreduce_tpu.ops.local_attention import flash_shapes_ok
+
+        head_dim = d_model // n_heads
+        local_t = seq_len if (self.sp == 1 or seq_impl == "ulysses") else 0
+        self._check_vma = not (
+            jax.default_backend() == "tpu"
+            and local_t > 0
+            and flash_shapes_ok(local_t, head_dim)
+        )
         mapped = jax.shard_map(
             step,
             mesh=mesh,
             in_specs=(P(), P(), data_spec, data_spec, P(self.data_axis)),
             out_specs=(P(), P(), P(), P()),
+            check_vma=self._check_vma,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
+        self._raw_step = step  # reused by train_chain's on-device loop
+        self._replicated = NamedSharding(mesh, P())
+        self._chains: dict = {}
 
     # -- stepping ------------------------------------------------------------
 
@@ -199,3 +221,91 @@ class LongContextTrainer:
 
     def train(self, batches: Iterable) -> list[LongContextStepMetrics]:
         return [self.train_step(x, y) for x, y in batches]
+
+    # -- on-device training chain (data-loader path, no host I/O per step) ---
+
+    def _build_chain(self, sampler, steps: int, rows_per_replica: int):
+        raw_step = self._raw_step
+        data_axis, seq_axis = self.data_axis, self.seq_axis
+        t_local = self.seq_len // self.sp
+
+        def chain(params, opt_state, key, valid):
+            # one stream per DP replica ROW: all seq shards of a row fold the
+            # same data-axis coordinate, so they agree on the row's tokens
+            # and each slices its own T_local columns
+            rkey = jax.random.fold_in(key, lax.axis_index(data_axis))
+            s = lax.axis_index(seq_axis)
+
+            def body(carry, i):
+                p, o = carry
+                k = jax.random.fold_in(rkey, i)
+                x_g, y_g = sampler(k, rows_per_replica)
+                x = lax.dynamic_slice_in_dim(x_g, s * t_local, t_local, axis=1)
+                y = lax.dynamic_slice_in_dim(y_g, s * t_local, t_local, axis=1)
+                p, o, loss, cnt = raw_step(p, o, x, y, valid)
+                return (p, o), (loss, cnt)
+
+            (params, opt_state), (losses, cnts) = lax.scan(
+                body, (params, opt_state), jnp.arange(steps)
+            )
+            return params, opt_state, losses, cnts
+
+        mapped = jax.shard_map(
+            chain,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(data_axis)),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=self._check_vma,  # flash outputs carry no vma (see step)
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_chain(
+        self,
+        sampler,
+        steps: int,
+        rows_per_replica: int,
+        *,
+        valid: Sequence[float] | None = None,
+        seed: int = 0,
+    ) -> list[LongContextStepMetrics]:
+        """Run ``steps`` DP x SP steps entirely on device in ONE dispatch.
+
+        ``sampler`` is a traced ``(key, rows) -> (tokens, labels)`` producing
+        GLOBAL (rows, seq_len) sequences (``SyntheticCopyLM.device_sampler``);
+        each replica row draws its own stream and its seq shards slice their
+        local columns, so nothing crosses the host inside the loop.
+        """
+        cache_key = (id(sampler), steps, rows_per_replica)
+        if cache_key not in self._chains:
+            self._chains[cache_key] = self._build_chain(
+                sampler, steps, rows_per_replica
+            )
+        if valid is None:
+            valid_arr = np.ones((self.dp,), np.float32)
+        else:
+            valid_arr = np.asarray(valid, np.float32)
+            if valid_arr.shape != (self.dp,):
+                raise ValueError(
+                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
+                )
+        vd = jax.device_put(valid_arr, self._valid_sharding)
+        key = jax.device_put(
+            jax.random.fold_in(jax.random.PRNGKey(seed), self.step_num),
+            self._replicated,
+        )
+        self.params, self.opt_state, losses, cnts = self._chains[cache_key](
+            self.params, self.opt_state, key, vd
+        )
+        losses = np.asarray(jax.device_get(losses))
+        cnts = np.asarray(jax.device_get(cnts))
+        out = []
+        for loss, cnt in zip(losses, cnts):
+            self.step_num += 1
+            out.append(
+                LongContextStepMetrics(
+                    step=self.step_num,
+                    loss=float(loss),
+                    contributors=float(cnt),
+                )
+            )
+        return out

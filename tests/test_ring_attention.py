@@ -136,6 +136,29 @@ def test_long_context_trainer_loss_decreases():
     assert hist[-1].contributors == 2.0
 
 
+def test_long_context_train_chain_on_device():
+    """On-device chain for DP x SP: the copy task must still be learnable —
+    proving every seq shard of a row sampled CONSISTENT data (a mismatched
+    second half would make the task unlearnable)."""
+    mesh = data_seq_mesh(2, 4)
+    seq_len = 64
+    trainer = LongContextTrainer(
+        mesh, vocab=16, d_model=32, n_heads=4, n_layers=1,
+        seq_len=seq_len, learning_rate=3e-3,
+    )
+    sampler = data.lm_copy_task(seq_len, vocab=16).device_sampler()
+    hist = trainer.train_chain(sampler, steps=30, rows_per_replica=4)
+    assert len(hist) == 30 and trainer.step_num == 30
+    assert all(np.isfinite(m.loss) for m in hist)
+    assert hist[-1].loss < hist[0].loss
+    assert hist[-1].contributors == 2.0
+    # masked DP row still completes with one contributor
+    hist2 = trainer.train_chain(
+        sampler, steps=2, rows_per_replica=4, valid=[1.0, 0.0]
+    )
+    assert all(m.contributors == 1.0 for m in hist2)
+
+
 def test_long_context_trainer_threshold_mask():
     """A masked DP row contributes nothing: stepping with row 1 masked equals
     stepping a trainer that never saw row 1's data (same seed)."""
