@@ -99,6 +99,11 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
         help="sample batches ON DEVICE inside one jitted chain (no host I/O "
         "per step — the right mode over a slow host<->device link)",
     )
+    p.add_argument(
+        "--bf16",
+        action="store_true",
+        help="bfloat16 activations/matmuls, fp32 params (MXU-native dtype)",
+    )
 
 
 def _run_training_chain(trainer, ds, args, *, label: str) -> int:
@@ -111,10 +116,10 @@ def _run_training_chain(trainer, ds, args, *, label: str) -> int:
 
     from akka_allreduce_tpu.utils.metrics import MetricsLogger
 
-    if args.batch % trainer.n_devices:
+    shards = trainer.data_shards
+    if args.batch % shards:
         raise SystemExit(
-            f"global batch {args.batch} not divisible by "
-            f"{trainer.n_devices} devices"
+            f"global batch {args.batch} not divisible by {shards} data shards"
         )
     profile = contextlib.nullcontext()
     if getattr(args, "profile_dir", None):
@@ -132,7 +137,13 @@ def _run_training_chain(trainer, ds, args, *, label: str) -> int:
 
     logger = MetricsLogger(args.metrics_out)
     sampler = ds.device_sampler()
-    per_dev = args.batch // trainer.n_devices
+    if ckpt and getattr(sampler, "diverges_from_host_stream", False):
+        print(
+            "warning: this dataset's device sampler regenerates templates on "
+            "device; a checkpoint from the host loop continues on a "
+            "DIFFERENT synthetic task"
+        )
+    per_dev = args.batch // shards
     block = (
         args.checkpoint_every
         if ckpt and args.checkpoint_every
@@ -159,11 +170,15 @@ def _run_training_chain(trainer, ds, args, *, label: str) -> int:
         )
     logger.close()
     losses = [m.loss for m in history]
+    trend = (
+        f"loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
+        if losses
+        else "no steps taken"
+    )
     print(
         f"{label}: {len(losses)} on-device steps on {trainer.n_devices} "
         f"devices in {total:.2f}s incl. compile "
-        f"({total / max(len(losses), 1) * 1e3:.1f} ms/step amortized); "
-        f"loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
+        f"({total / max(len(losses), 1) * 1e3:.1f} ms/step amortized); {trend}"
     )
     return 0
 
@@ -212,10 +227,15 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
         ckpt.save(trainer, force=True)
         ckpt.close()
     logger.close()
+    trend = (
+        f"loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
+        if losses
+        else "no steps taken"
+    )
     print(
         f"{label}: {len(losses)} steps on {trainer.n_devices} devices in "
         f"{total:.2f}s ({total / max(len(losses), 1) * 1e3:.1f} ms/step); "
-        f"loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
+        f"{trend}"
     )
     return 0
 
@@ -242,13 +262,18 @@ def _cmd_train_mlp(argv: list[str]) -> int:
     p.add_argument("--hidden", type=int, nargs="+", default=[128])
     args = p.parse_args(argv)
 
+    import jax.numpy as jnp
     import numpy as np
 
     from akka_allreduce_tpu.models import MLP, data
     from akka_allreduce_tpu.train import DPTrainer
 
     trainer = DPTrainer(
-        MLP(hidden=tuple(args.hidden), classes=10),
+        MLP(
+            hidden=tuple(args.hidden),
+            classes=10,
+            compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        ),
         _make_mesh(args),
         example_input=np.zeros((1, 28, 28, 1), np.float32),
         learning_rate=args.lr,
@@ -266,13 +291,17 @@ def _cmd_train_resnet(argv: list[str]) -> int:
     p.add_argument("--classes", type=int, default=10)
     args = p.parse_args(argv)
 
+    import jax.numpy as jnp
     import numpy as np
 
     from akka_allreduce_tpu.models import ResNet50, data
     from akka_allreduce_tpu.train import DPTrainer
 
     trainer = DPTrainer(
-        ResNet50(classes=args.classes),
+        ResNet50(
+            classes=args.classes,
+            compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        ),
         _make_mesh(args),
         example_input=np.zeros(
             (1, args.image_size, args.image_size, 3), np.float32
@@ -320,13 +349,11 @@ def _cmd_train_lm(argv: list[str]) -> int:
     args.checkpoint_dir = None
     args.checkpoint_every = 0
 
-    import numpy as np
+    import jax.numpy as jnp
 
     from akka_allreduce_tpu.models import data
     from akka_allreduce_tpu.parallel import data_seq_mesh
     from akka_allreduce_tpu.train import LongContextTrainer
-
-    import jax.numpy as jnp
 
     mesh = data_seq_mesh(args.dp, args.sp)
     trainer = LongContextTrainer(
@@ -345,41 +372,8 @@ def _cmd_train_lm(argv: list[str]) -> int:
         f"dp={trainer.dp} x sp={trainer.sp}, seq_len={args.seq_len} ({args.impl})"
     )
     ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
-    if args.device_data:
-        import contextlib
-
-        from akka_allreduce_tpu.utils.metrics import MetricsLogger
-
-        if args.batch % trainer.dp:
-            raise SystemExit(
-                f"global batch {args.batch} not divisible by dp={trainer.dp}"
-            )
-        profile = contextlib.nullcontext()
-        if getattr(args, "profile_dir", None):
-            import jax
-
-            profile = jax.profiler.trace(args.profile_dir)
-        logger = MetricsLogger(args.metrics_out)
-        t0 = time.perf_counter()
-        with profile:
-            hist = trainer.train_chain(
-                ds.device_sampler(), args.steps, args.batch // trainer.dp
-            )
-        total = time.perf_counter() - t0
-        label = f"lm_{args.impl}"
-        for m in hist:
-            logger.log_event(
-                kind="train_step", workload=label, step=m.step, loss=m.loss,
-                contributors=m.contributors,
-            )
-        logger.close()
-        losses = [m.loss for m in hist]
-        print(
-            f"{label}: {len(losses)} on-device steps in {total:.2f}s "
-            f"incl. compile ({total / max(len(losses), 1) * 1e3:.1f} ms/step "
-            f"amortized); loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
-        )
-        return 0
+    # --device-data is handled inside _run_training via _run_training_chain
+    # (trainer.data_shards tells it rows are per DP replica, not per device)
     return _run_training(trainer, ds, args, label=f"lm_{args.impl}")
 
 

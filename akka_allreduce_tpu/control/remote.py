@@ -58,6 +58,9 @@ class RemoteTransport:
         self.delivered = 0
         self.dropped = 0
         self.on_send_error: Callable[[Endpoint, Envelope], None] | None = None
+        # fault injection (the reference tests by omitting messages,
+        # SURVEY.md §5): return True to swallow an outgoing envelope
+        self.drop_filter: Callable[[Envelope], bool] | None = None
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -136,6 +139,9 @@ class RemoteTransport:
     # -- sending -----------------------------------------------------------------
 
     async def send(self, env: Envelope) -> None:
+        if self.drop_filter is not None and self.drop_filter(env):
+            self.dropped += 1
+            return
         if env.via is None:
             handler = self._local_handler(env.dest)
             if handler is not None:  # local delivery: no wire, same FIFO inbox

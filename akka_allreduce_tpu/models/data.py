@@ -42,18 +42,45 @@ class SyntheticClassification:
             images = self.templates[labels] + self.noise * noise
             yield images, labels.astype(np.int32)
 
+    # host template tensors up to this size are uploaded so the device
+    # sampler presents the IDENTICAL task to the host batches() stream
+    _UPLOAD_MAX_BYTES = 32 * 1024 * 1024
+
     def device_sampler(self):
-        """A traced ``(key, batch_size) -> (x, y)`` drawing the same task
-        distribution ON DEVICE — the data-loader path that keeps training
-        loops free of host->device transfers (each DP device draws its own
-        shard inside the jitted step; see ``DPTrainer.train_chain``)."""
+        """A traced ``(key, batch_size) -> (x, y)`` drawing batches on device
+        — the data-loader path that keeps training loops free of host->device
+        transfers (each DP device draws its own shard inside the jitted step;
+        see ``DPTrainer.train_chain``).
+
+        Small template tensors (MNIST-scale) are uploaded once, so the device
+        stream and the host ``batches()`` stream share the exact same task —
+        checkpoints and chains mix freely. At ImageNet scale the host tensor
+        is ~600 MB (minutes over a slow host<->device link), so templates are
+        regenerated ON DEVICE from the dataset seed instead: same structure,
+        different template values. That divergence is flagged on the returned
+        function as ``diverges_from_host_stream`` so callers mixing the two
+        paths (e.g. resuming a host-loop checkpoint with --device-data) can
+        warn.
+        """
         import jax
         import jax.numpy as jnp
 
-        templates = jnp.asarray(self.templates)
         noise_scale = self.noise
         classes = self.classes
         shape = self.input_shape
+        diverges = self.templates.nbytes > self._UPLOAD_MAX_BYTES
+        if diverges:
+            # eager device-side generation, ONCE (a closure constant of the
+            # jitted chain) — never inside the per-step scan body
+            templates = jax.jit(
+                lambda: jax.random.normal(
+                    jax.random.PRNGKey(self._seed),
+                    (classes, *shape),
+                    jnp.float32,
+                )
+            )()
+        else:
+            templates = jnp.asarray(self.templates)
 
         def sample(key, batch_size: int):
             kl, kn = jax.random.split(key)
@@ -63,6 +90,7 @@ class SyntheticClassification:
             )
             return x, labels.astype(jnp.int32)
 
+        sample.diverges_from_host_stream = diverges
         return sample
 
 

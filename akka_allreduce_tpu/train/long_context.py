@@ -82,6 +82,7 @@ class LongContextTrainer:
         self.dp = int(mesh.shape[self.data_axis])
         self.sp = int(mesh.shape[self.seq_axis])
         self.n_devices = self.dp * self.sp
+        self.data_shards = self.dp  # train_chain streams: one per replica row
         if seq_len % self.sp:
             raise ValueError(f"{seq_len=} not divisible by seq shards {self.sp}")
         self.seq_len = seq_len
@@ -275,10 +276,14 @@ class LongContextTrainer:
         each replica row draws its own stream and its seq shards slice their
         local columns, so nothing crosses the host inside the loop.
         """
-        cache_key = (id(sampler), steps, rows_per_replica)
-        if cache_key not in self._chains:
-            self._chains[cache_key] = self._build_chain(
-                sampler, steps, rows_per_replica
+        # same keying discipline as DPTrainer.train_chain: shape-config key,
+        # sampler pinned in the entry (id() could be a recycled address)
+        cache_key = (steps, rows_per_replica)
+        entry = self._chains.get(cache_key)
+        if entry is None or entry[0] is not sampler:
+            self._chains[cache_key] = (
+                sampler,
+                self._build_chain(sampler, steps, rows_per_replica),
             )
         if valid is None:
             valid_arr = np.ones((self.dp,), np.float32)
@@ -293,7 +298,7 @@ class LongContextTrainer:
             jax.random.fold_in(jax.random.PRNGKey(seed), self.step_num),
             self._replicated,
         )
-        self.params, self.opt_state, losses, cnts = self._chains[cache_key](
+        self.params, self.opt_state, losses, cnts = self._chains[cache_key][1](
             self.params, self.opt_state, key, vd
         )
         losses = np.asarray(jax.device_get(losses))

@@ -66,6 +66,9 @@ class DPTrainer:
         self.n_devices = int(np.prod([mesh.shape[a] for a in self.axis_names]))
         self.tx = optimizer or optax.sgd(learning_rate)
         self.bucket_size = bucket_size
+        # how many independent data streams train_chain samples (one per
+        # device here; the long-context trainer has one per DP replica row)
+        self.data_shards = self.n_devices
         self._loss = loss_fn or (
             lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
@@ -264,10 +267,16 @@ class DPTrainer:
         loop — the data-loader discipline for tunneled/remote chips where a
         per-step host round trip costs more than the step itself.
         """
-        cache_key = (id(sampler), steps, batch_per_device)
-        if cache_key not in self._chains:
-            self._chains[cache_key] = self._build_chain(
-                sampler, steps, batch_per_device
+        # key by shape config and pin the sampler object in the entry: id()
+        # alone could match a NEW sampler allocated at a recycled address
+        # after the old one was garbage-collected, silently reusing a chain
+        # compiled against the old closure
+        cache_key = (steps, batch_per_device)
+        entry = self._chains.get(cache_key)
+        if entry is None or entry[0] is not sampler:
+            self._chains[cache_key] = (
+                sampler,
+                self._build_chain(sampler, steps, batch_per_device),
             )
         if valid is None:
             valid_arr = np.ones((self.n_devices,), np.float32)
@@ -284,7 +293,7 @@ class DPTrainer:
             jax.random.fold_in(jax.random.PRNGKey(seed), self.step_num),
             self._replicated,
         )
-        self.params, self.opt_state, losses, cnts = self._chains[cache_key](
+        self.params, self.opt_state, losses, cnts = self._chains[cache_key][1](
             self.params, self.opt_state, key, vd
         )
         losses = np.asarray(jax.device_get(losses))

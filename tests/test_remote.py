@@ -271,6 +271,47 @@ def test_cluster_late_joiner_participates():
     asyncio.run(run())
 
 
+def test_threshold_completion_under_tcp_message_loss():
+    """The reference's core capability over the REAL wire: one worker's
+    scatter/reduce messages are silently dropped at its transport, and with
+    th=0.75 rounds still complete — at reduced contributor counts — without
+    any membership change (SURVEY.md §4.2: thresholds absorb within-round
+    loss; the node keeps heartbeating so the detector never fires)."""
+    from akka_allreduce_tpu.protocol import ReduceBlock, ScatterBlock
+
+    async def run():
+        h = _Harness(_config(4, max_rounds=-1, th=0.75), 4)
+        try:
+            await h.start(4)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(4)) >= 2)
+            # mute node 3's data-plane output (control traffic still flows)
+            h.nodes[3].transport.drop_filter = lambda env: isinstance(
+                env.msg, (ScatterBlock, ReduceBlock)
+            )
+            f0 = h.flushes(0)
+            await h.wait_for(lambda: h.flushes(0) >= f0 + 4)
+            assert sorted(h.master.grid.nodes) == [0, 1, 2, 3]  # no expulsion
+        finally:
+            await h.stop()
+        out = h.outputs[0][-1]
+        # worker 3's whole block never arrived (count 0 there — exactly the
+        # 0.75 completion fraction), and its contribution is missing from
+        # every other block (count 3, not 4): thresholds absorbed all of it
+        assert out.count.min() == 0 and out.count.max() == 3
+        expected = h.inputs[:3]
+        avg = out.average()
+        # elements with 3 contributors equal the 3-worker mean exactly
+        full = out.count == 3
+        np.testing.assert_allclose(
+            avg[full],
+            np.mean(expected, axis=0)[full],
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    asyncio.run(run())
+
+
 def test_cluster_round_metrics_jsonl():
     """Per-round observability (SURVEY.md §6): every completed line-round
     emits a JSONL record with latency and contributor count."""
