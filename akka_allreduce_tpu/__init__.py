@@ -37,6 +37,7 @@ Layout (mirrors SURVEY.md §2's layer map):
 __version__ = "0.1.0"
 
 from akka_allreduce_tpu.config import (  # noqa: F401
+    AllreduceConfig,
     LineMasterConfig,
     MasterConfig,
     MetaDataConfig,
@@ -44,3 +45,35 @@ from akka_allreduce_tpu.config import (  # noqa: F401
     ThresholdConfig,
     WorkerConfig,
 )
+
+# Lazy re-exports (PEP 562): the package's front door without paying the
+# jax/flax import cost for control-plane-only uses (configs, wire protocol,
+# cluster tooling import in milliseconds; the data plane loads on first use).
+_LAZY_EXPORTS = {
+    "threshold_allreduce": "akka_allreduce_tpu.comm.allreduce",
+    "build_threshold_allreduce": "akka_allreduce_tpu.comm.allreduce",
+    "AllreduceResult": "akka_allreduce_tpu.comm.allreduce",
+    "line_mesh": "akka_allreduce_tpu.parallel",
+    "grid_mesh": "akka_allreduce_tpu.parallel",
+    "data_seq_mesh": "akka_allreduce_tpu.parallel",
+    "DPTrainer": "akka_allreduce_tpu.train",
+    "ElasticDPTrainer": "akka_allreduce_tpu.train",
+    "LongContextTrainer": "akka_allreduce_tpu.train",
+    "ElasticClusterNode": "akka_allreduce_tpu.train",
+    "TrainerCheckpointer": "akka_allreduce_tpu.train",
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY_EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # cache: later lookups bypass __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

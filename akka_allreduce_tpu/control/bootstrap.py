@@ -132,7 +132,7 @@ class MasterProcess:
         if isinstance(msg, cl.JoinCluster):
             return self._on_join(msg, now)
         if isinstance(msg, cl.Heartbeat):
-            return self._on_heartbeat(msg.node_id, now)
+            return self._on_heartbeat(msg.node_id, msg.incarnation, now)
         if isinstance(msg, cl.LeaveCluster):
             self.monitor.leave(msg.node_id, now)
             out = self.grid.member_unreachable(msg.node_id)
@@ -154,7 +154,15 @@ class MasterProcess:
                 nid = known_nid
                 break
         else:
-            if nid < 0 or (nid in self.book and self.book[nid] != ep):
+            # a preferred id may be reclaimed from a NEW endpoint when its
+            # previous holder is dead (crashed on another port) — only a
+            # LIVE member's identity is protected from takeover
+            taken = (
+                nid in self.book
+                and self.book[nid] != ep
+                and nid in self.grid.nodes
+            )
+            if nid < 0 or taken:
                 # an endpoint hosts at most one node process, so a fresh
                 # incarnation from a booked endpoint is that node reborn —
                 # reclaim its id; otherwise mint the next one
@@ -183,6 +191,11 @@ class MasterProcess:
         self.book[nid] = ep
         self._incarnations[nid] = msg.incarnation
         self.unreachable.discard(nid)
+        # a new incarnation is a new process: its predecessor's inter-arrival
+        # history (and the death gap since) must not poison the detector —
+        # this covers the fast same-endpoint restart where the monitor state
+        # is still UP and HeartbeatMonitor's own reset branch would not run
+        self.monitor.detector.remove(nid)
         self.monitor.heartbeat(nid, now)
         log.info("master: node %d joined from %s:%d", nid, msg.host, msg.port)
         out = [welcome]
@@ -197,9 +210,16 @@ class MasterProcess:
             out.extend(self.grid.member_up(nid))
         return out
 
-    def _on_heartbeat(self, node_id: int, now: float) -> list[Envelope]:
+    def _on_heartbeat(
+        self, node_id: int, incarnation: int, now: float
+    ) -> list[Envelope]:
         if node_id not in self.book:
             return []  # stale heartbeat from a node we already expelled
+        if self._incarnations.get(node_id) != incarnation:
+            # zombie: a partitioned process whose id was reclaimed by a newer
+            # joiner — its stale heartbeats must not alias the current
+            # holder's liveness
+            return []
         event = self.monitor.heartbeat(node_id, now)
         if event is not None and node_id not in self.grid.nodes:
             # silence marked it unreachable but the process lives: rejoin it
@@ -425,5 +445,5 @@ class NodeProcess:
     async def _send_heartbeat(self) -> None:
         assert self.node_id is not None
         await self.transport.send(
-            Envelope("master", cl.Heartbeat(self.node_id))
+            Envelope("master", cl.Heartbeat(self.node_id, self.incarnation))
         )

@@ -72,9 +72,15 @@ class Welcome:
 
 @dataclasses.dataclass(frozen=True)
 class Heartbeat:
-    """Node -> master: liveness signal feeding the phi-accrual detector."""
+    """Node -> master: liveness signal feeding the phi-accrual detector.
+
+    Carries the sender's incarnation so a zombie (a partitioned process
+    whose node id was legitimately reclaimed by a newer joiner) cannot
+    alias the current holder's liveness with its stale heartbeats.
+    """
 
     node_id: int
+    incarnation: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -128,9 +128,15 @@ class HeartbeatMonitor:
 
     def heartbeat(self, node_id: int, now: float) -> MembershipEvent | None:
         """Record a heartbeat; returns an UP event if this (re)joins the node."""
-        self.detector.heartbeat(node_id, now)
         if self.states.get(node_id) is not MemberState.UP:
+            # (re)joining after death or silence: the dead gap must not enter
+            # the inter-arrival model — each such sample inflates mean/std and
+            # makes the detector progressively slower until real crashes go
+            # undetected (observed across repeated crash/rejoin cycles)
+            self.detector.remove(node_id)
+            self.detector.heartbeat(node_id, now)
             return self._transition(node_id, MemberState.UP, now)
+        self.detector.heartbeat(node_id, now)
         return None
 
     def leave(self, node_id: int, now: float) -> MembershipEvent | None:

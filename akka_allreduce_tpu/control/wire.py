@@ -122,7 +122,7 @@ def encode(msg: Any) -> bytes:
     if tag == 8:
         return head + struct.pack("<i", msg.node_id) + _pack_str(msg.config_json)
     if tag == 9:
-        return head + struct.pack("<i", msg.node_id)
+        return head + struct.pack("<iq", msg.node_id, msg.incarnation)
     if tag == 10:
         return head + struct.pack("<i", msg.node_id)
     if tag == 11:
@@ -169,7 +169,7 @@ def decode(data: bytes | memoryview) -> Any:
         config_json, _ = _unpack_str(buf, off + 4)
         return cl.Welcome(node_id, config_json)
     if tag == 9:
-        return cl.Heartbeat(*struct.unpack_from("<i", buf, off))
+        return cl.Heartbeat(*struct.unpack_from("<iq", buf, off))
     if tag == 10:
         return cl.LeaveCluster(*struct.unpack_from("<i", buf, off))
     if tag == 11:

@@ -410,6 +410,34 @@ def test_join_retry_with_auto_id_is_deduplicated():
     assert master._incarnations[0] == 8
 
 
+def test_zombie_heartbeats_cannot_alias_reclaimed_id():
+    """A partitioned process whose node id was reclaimed by a newer joiner
+    must not keep the id 'alive' with its stale heartbeats: the master
+    accepts liveness only from the CURRENT incarnation."""
+    from akka_allreduce_tpu.control.cluster import Heartbeat, JoinCluster
+
+    clock = {"t": 0.0}
+    master = MasterProcess(_config(2), port=0, clock=lambda: clock["t"])
+    master._on_cluster_msg(JoinCluster("10.0.0.1", 1000, -1, incarnation=5))
+    assert sorted(master.book) == [0]
+    # partition: detector expels node 0 from the grid (book entry kept)
+    master.grid.member_unreachable(0)
+    master.unreachable.add(0)
+    master.grid.nodes.discard(0)
+    # a new process reclaims the dead id from a different endpoint
+    master._on_cluster_msg(JoinCluster("10.0.0.2", 2000, 0, incarnation=9))
+    assert master.book[0].host == "10.0.0.2"
+    assert master._incarnations[0] == 9
+    # the zombie's heartbeats are ignored wholesale...
+    last_before = master.monitor.detector._last.get(0)
+    clock["t"] = 100.0
+    assert master._on_cluster_msg(Heartbeat(0, incarnation=5)) == []
+    assert master.monitor.detector._last.get(0) == last_before
+    # ...while the current holder's are recorded
+    master._on_cluster_msg(Heartbeat(0, incarnation=9))
+    assert master.monitor.detector._last.get(0) == 100.0
+
+
 def test_restart_same_identity_is_reprepared():
     """A node that crashes and restarts on the same port/id BEFORE the phi
     detector notices must be re-Prepared (its workers are fresh): the master
