@@ -77,6 +77,10 @@ class MasterProcess:
         self.book: dict[int, cl.Endpoint] = {}
         self.unreachable: set[int] = set()
         self._incarnations: dict[int, int] = {}
+        # last superseded incarnation per node id: (incarnation, endpoint) of
+        # the process whose id was reclaimed — so its surviving heartbeats can
+        # be answered with a Shutdown instead of silently orphaning it
+        self._superseded: dict[int, tuple[int, cl.Endpoint]] = {}
         self.transport = RemoteTransport(host, port)
         self.transport.register("master", self._on_cluster_msg)
         self.transport.register_prefix("line_master", self.grid.handle_for_line)
@@ -139,6 +143,7 @@ class MasterProcess:
             self.book.pop(msg.node_id, None)
             self.unreachable.discard(msg.node_id)
             self._incarnations.pop(msg.node_id, None)
+            self._superseded.pop(msg.node_id, None)
             return out + self._broadcast(self._address_book())
         raise TypeError(f"master cannot handle {type(msg).__name__}")
 
@@ -188,6 +193,12 @@ class MasterProcess:
             self.monitor.heartbeat(nid, now)
             return [welcome]
         restarted = nid in self.grid.nodes
+        prev_inc = self._incarnations.get(nid)
+        prev_ep = self.book.get(nid)
+        if prev_inc is not None and prev_ep is not None and prev_ep != ep:
+            # id reclaimed from a different endpoint: remember the superseded
+            # process so a late heartbeat from it gets a Shutdown reply
+            self._superseded[nid] = (prev_inc, prev_ep)
         self.book[nid] = ep
         self._incarnations[nid] = msg.incarnation
         self.unreachable.discard(nid)
@@ -218,7 +229,17 @@ class MasterProcess:
         if self._incarnations.get(node_id) != incarnation:
             # zombie: a partitioned process whose id was reclaimed by a newer
             # joiner — its stale heartbeats must not alias the current
-            # holder's liveness
+            # holder's liveness. Tell it to stand down rather than letting it
+            # run (and heartbeat) orphaned forever.
+            sup = self._superseded.get(node_id)
+            if sup is not None and sup[0] == incarnation:
+                return [
+                    Envelope(
+                        f"node:{node_id}",
+                        cl.Shutdown("superseded"),
+                        via=sup[1],
+                    )
+                ]
             return []
         event = self.monitor.heartbeat(node_id, now)
         if event is not None and node_id not in self.grid.nodes:

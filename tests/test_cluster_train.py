@@ -85,6 +85,63 @@ def test_elastic_cluster_training_two_nodes():
     asyncio.run(run())
 
 
+def test_training_continues_after_member_departs():
+    """When a cluster member departs gracefully mid-run, the remaining
+    training node keeps training AND keeps receiving sync rounds solo.
+    The departure is driven explicitly (a plain non-training member is
+    removed once sync is established) so the ordering is deterministic."""
+    from akka_allreduce_tpu.control.bootstrap import NodeProcess
+    from akka_allreduce_tpu.protocol import AllReduceInput
+
+    async def run():
+        trainer = _trainer(2)
+        cfg = AllreduceConfig(
+            threshold=ThresholdConfig(1.0, 1.0, 1.0),
+            metadata=MetaDataConfig(
+                data_size=trainer.param_count, max_chunk_size=4096
+            ),
+            line_master=LineMasterConfig(round_window=2, max_rounds=-1),
+            master=MasterConfig(
+                node_num=2, dimensions=1, heartbeat_interval_s=0.05
+            ),
+        )
+        master = MasterProcess(cfg, port=0)
+        seed_ep = await master.start()
+        zeros = np.zeros(trainer.param_count, np.float32)
+        plain = NodeProcess(
+            seed_ep,
+            lambda req: AllReduceInput(zeros),
+            lambda out: None,
+            preferred_node_id=0,
+        )
+        await plain.start()
+        await plain.wait_welcomed()
+        node = ElasticClusterNode(
+            seed_ep, trainer,
+            iter(data.mnist_like(seed=1).batches(16, 60)),
+            preferred_node_id=1,
+        )
+        from tests.test_remote import wait_until
+
+        try:
+            task = asyncio.ensure_future(node.run(60))
+            # both members syncing
+            await wait_until(lambda: node.rounds_applied >= 5, 30.0)
+            await plain.leave()
+            await plain.stop()
+            await wait_until(lambda: sorted(master.grid.nodes) == [1], 30.0)
+            snap = node.rounds_applied
+            steps = await asyncio.wait_for(task, timeout=90.0)
+        finally:
+            await master.stop()
+        assert steps == 60 and len(node.losses) == 60
+        # the survivor kept receiving sync rounds solo after the departure
+        assert node.rounds_applied > snap
+        assert np.mean(node.losses[-5:]) < node.losses[0]
+
+    asyncio.run(run())
+
+
 def test_elastic_cluster_node_rejects_size_mismatch():
     async def run():
         trainer = _trainer(1)

@@ -101,6 +101,16 @@ def _config(n_nodes, *, dims=1, max_rounds=4, size=1000, th=1.0, hb=0.05):
     )
 
 
+async def wait_until(pred, timeout: float = 20.0) -> None:
+    """Poll ``pred`` until true or ``timeout`` (shared by the cluster tests)."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while not pred():
+        if loop.time() > deadline:
+            raise TimeoutError("condition not reached")
+        await asyncio.sleep(0.02)
+
+
 class _Harness:
     """Master + N in-process NodeProcesses over real loopback TCP."""
 
@@ -149,12 +159,7 @@ class _Harness:
         return len(self.outputs.get(i, []))
 
     async def wait_for(self, pred, timeout: float = 20.0) -> None:
-        loop = asyncio.get_event_loop()
-        deadline = loop.time() + timeout
-        while not pred():
-            if loop.time() > deadline:
-                raise TimeoutError("condition not reached")
-            await asyncio.sleep(0.02)
+        await wait_until(pred, timeout)
 
 
 # --- end-to-end cluster tests -------------------------------------------------
@@ -428,11 +433,17 @@ def test_zombie_heartbeats_cannot_alias_reclaimed_id():
     master._on_cluster_msg(JoinCluster("10.0.0.2", 2000, 0, incarnation=9))
     assert master.book[0].host == "10.0.0.2"
     assert master._incarnations[0] == 9
-    # the zombie's heartbeats are ignored wholesale...
+    # the zombie's heartbeat does not touch liveness state, and the zombie
+    # itself is answered with a Shutdown at its OLD endpoint so it stands
+    # down instead of running orphaned forever
     last_before = master.monitor.detector._last.get(0)
     clock["t"] = 100.0
-    assert master._on_cluster_msg(Heartbeat(0, incarnation=5)) == []
+    out = master._on_cluster_msg(Heartbeat(0, incarnation=5))
     assert master.monitor.detector._last.get(0) == last_before
+    assert len(out) == 1
+    assert type(out[0].msg).__name__ == "Shutdown"
+    assert out[0].msg.reason == "superseded"
+    assert out[0].via.host == "10.0.0.1"  # the zombie's endpoint, not B's
     # ...while the current holder's are recorded
     master._on_cluster_msg(Heartbeat(0, incarnation=9))
     assert master.monitor.detector._last.get(0) == 100.0

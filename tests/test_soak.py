@@ -42,6 +42,31 @@ def test_detector_history_resets_on_rejoin():
     assert [e.node_id for e in mon.poll(now)] == [7]
 
 
+def test_butterfly_grid_survives_node_loss():
+    """2D butterfly cluster: losing a node re-factors the grid (2x2 -> 1x3)
+    and rounds continue with exact 3-worker averages."""
+    import numpy as np
+
+    async def run():
+        h = _Harness(_config(4, dims=2, max_rounds=-1, size=600), 4)
+        try:
+            await h.start(4)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(4)) >= 2)
+            await h.nodes.pop(3).stop()  # hard crash
+            await h.wait_for(lambda: sorted(h.master.grid.nodes) == [0, 1, 2], 15.0)
+            f0 = h.flushes(0)
+            await h.wait_for(lambda: h.flushes(0) >= f0 + 3)
+        finally:
+            await h.stop()
+        out = h.outputs[0][-1]
+        assert out.count.min() == 3  # both butterfly stages over 3 nodes
+        np.testing.assert_allclose(
+            out.average(), np.mean(h.inputs[:3], axis=0), rtol=1e-5, atol=1e-6
+        )
+
+    asyncio.run(run())
+
+
 def test_repeated_crash_rejoin_cycles():
     async def run():
         h = _Harness(_config(3, max_rounds=-1), 3)
