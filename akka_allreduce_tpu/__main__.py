@@ -104,6 +104,13 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
         action="store_true",
         help="bfloat16 activations/matmuls, fp32 params (MXU-native dtype)",
     )
+    p.add_argument(
+        "--accum",
+        type=int,
+        default=1,
+        help="gradient-accumulation microbatches per step: one collective "
+        "per effective batch, bigger batches in fixed memory",
+    )
 
 
 def _run_training_chain(trainer, ds, args, *, label: str) -> int:
@@ -120,6 +127,11 @@ def _run_training_chain(trainer, ds, args, *, label: str) -> int:
     if args.batch % shards:
         raise SystemExit(
             f"global batch {args.batch} not divisible by {shards} data shards"
+        )
+    if getattr(args, "accum", 1) != 1:
+        raise SystemExit(
+            "--accum is not supported with --device-data (the on-device "
+            "chain samples fixed per-device batches); drop one of the flags"
         )
     profile = contextlib.nullcontext()
     if getattr(args, "profile_dir", None):
@@ -208,12 +220,18 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
         if ckpt.latest_step() is not None:
             step = ckpt.restore(trainer)
             print(f"resumed from step {step}")
+    accum = getattr(args, "accum", 1)
+    if accum < 1:
+        raise SystemExit(f"--accum must be >= 1, got {accum}")
     t0 = time.perf_counter()
     losses = []
     with profile:
         for x, y in ds.batches(args.batch, args.steps):
             st = time.perf_counter()
-            m = trainer.train_step(x, y)
+            if accum > 1:
+                m = trainer.train_step_accum(x, y, accum)
+            else:
+                m = trainer.train_step(x, y)
             dt = time.perf_counter() - st
             losses.append(m.loss)
             logger.log_event(

@@ -153,6 +153,7 @@ class DPTrainer:
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
         self._chains: dict = {}
+        self._accum_steps_fns: dict = {}
 
         def eval_correct(params, x, y):
             logits = model_apply(params, x)
@@ -170,6 +171,17 @@ class DPTrainer:
 
     # -- stepping ------------------------------------------------------------
 
+    def _normalize_valid(self, valid: Sequence[float] | None) -> np.ndarray:
+        """Contributor mask -> validated (n_devices,) float32 array."""
+        if valid is None:
+            return np.ones((self.n_devices,), np.float32)
+        arr = np.asarray(valid, np.float32)
+        if arr.shape != (self.n_devices,):
+            raise ValueError(
+                f"valid must have shape ({self.n_devices},), got {arr.shape}"
+            )
+        return arr
+
     def _place_batch(self, x, y):
         if x.shape[0] % self.n_devices:
             raise ValueError(
@@ -183,14 +195,7 @@ class DPTrainer:
         self, x: np.ndarray, y: np.ndarray, valid: Sequence[float] | None = None
     ) -> TrainStepMetrics:
         """One DP step on a GLOBAL batch (first dim divisible by n_devices)."""
-        if valid is None:
-            valid_arr = np.ones((self.n_devices,), np.float32)
-        else:
-            valid_arr = np.asarray(valid, np.float32)
-            if valid_arr.shape != (self.n_devices,):
-                raise ValueError(
-                    f"valid must have shape ({self.n_devices},), got {valid_arr.shape}"
-                )
+        valid_arr = self._normalize_valid(valid)
         xd, yd = self._place_batch(x, y)
         vd = jax.device_put(valid_arr, self._data_sharding)
         self.params, self.opt_state, loss, cnt = self._step(
@@ -216,6 +221,128 @@ class DPTrainer:
         xd, yd = self._place_batch(x, y)
         hits = self._eval(self.params, xd, yd)
         return float(hits) / x.shape[0]
+
+    # -- gradient accumulation (microbatching) -------------------------------
+
+    def _build_accum_step(self, accum_steps: int):
+        """One optimizer step over ``accum_steps`` microbatches: grads are
+        accumulated per device across a ``lax.scan`` and synced with ONE
+        masked psum at the end — bigger effective batches in fixed memory,
+        and one collective per effective batch instead of per microbatch.
+        Exactly equivalent to a single step on the concatenated batch (the
+        mean of equal-size microbatch mean-gradients IS the full-batch mean).
+        """
+        axis_names = self.axis_names
+        model_apply = self.model.apply
+        loss_impl = self._loss
+        tx = self.tx
+        bucket = self.bucket_size
+
+        def step(params, opt_state, x, y, valid):
+            # x: (accum, micro, ...) per-device block
+            v = valid.reshape(())
+            scalar_cnt = lax.psum(v, axis_names)
+            denom = jnp.maximum(scalar_cnt, 1.0)
+            params_local = jax.tree.map(
+                lambda p: lax.pcast(p, axis_names, to="varying"), params
+            )
+
+            def micro(carry, xy):
+                g_acc, l_acc = carry
+                xm, ym = xy
+
+                def local_loss(p):
+                    return loss_impl(model_apply(p, xm), ym)
+
+                loss, grads = jax.value_and_grad(local_loss)(params_local)
+                return (
+                    jax.tree.map(jnp.add, g_acc, grads),
+                    l_acc + loss,
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params_local)
+            # the loss carry must enter the scan device-varying like the
+            # losses that accumulate into it
+            l0 = lax.pcast(jnp.float32(0.0), axis_names, to="varying")
+            (gsum, lsum), _ = lax.scan(micro, (zeros, l0), (x, y))
+            # local mean over microbatches, then the SAME single fused (or
+            # bucketed) masked collective the plain step uses — never one
+            # psum per parameter leaf
+            flat, unravel = ravel_pytree(
+                jax.tree.map(lambda g: g / accum_steps, gsum)
+            )
+            if bucket is None:
+                total, cnt = masked_psum(flat, v, axis_names)
+                denom_el = jnp.maximum(cnt, 1.0)
+            else:
+                n_buckets = -(-flat.shape[0] // bucket)
+                total, cnt = masked_psum(
+                    flat,
+                    jnp.full((n_buckets,), v),
+                    axis_names,
+                    bucket_size=bucket,
+                )
+                denom_el = jnp.maximum(
+                    expand_counts(cnt, flat.shape[0], bucket), 1.0
+                )
+            gavg = unravel(total / denom_el)
+            loss_avg = lax.psum(lsum * v / accum_steps, axis_names) / denom
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, loss_avg, scalar_cnt
+
+        mapped = jax.shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(), P(), self._data_spec, self._data_spec, self._data_spec),
+            out_specs=(P(), P(), P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_step_accum(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        accum_steps: int,
+        valid: Sequence[float] | None = None,
+    ) -> TrainStepMetrics:
+        """One optimizer step over a GLOBAL batch split into ``accum_steps``
+        microbatches per device (batch divisible by n_devices * accum_steps).
+        """
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        n = self.n_devices * accum_steps
+        if x.shape[0] % n:
+            raise ValueError(
+                f"global batch {x.shape[0]} not divisible by "
+                f"{self.n_devices} devices x {accum_steps} accumulation steps"
+            )
+        if accum_steps not in self._accum_steps_fns:
+            self._accum_steps_fns[accum_steps] = self._build_accum_step(
+                accum_steps
+            )
+        micro = x.shape[0] // n
+        # (global_batch, ...) -> (n_dev, accum, micro, ...) -> flatten dev dim
+        # back so the data sharding splits the leading axis across devices
+        def rearrange(a):
+            a = np.asarray(a)
+            return a.reshape(
+                self.n_devices, accum_steps, micro, *a.shape[1:]
+            ).reshape(self.n_devices * accum_steps, micro, *a.shape[1:])
+
+        valid_arr = self._normalize_valid(valid)
+        xd = jax.device_put(
+            rearrange(np.asarray(x, np.float32)), self._data_sharding
+        )
+        yd = jax.device_put(rearrange(np.asarray(y, np.int32)), self._data_sharding)
+        vd = jax.device_put(valid_arr, self._data_sharding)
+        self.params, self.opt_state, loss, cnt = self._accum_steps_fns[
+            accum_steps
+        ](self.params, self.opt_state, xd, yd, vd)
+        self.step_num += 1
+        return TrainStepMetrics(
+            step=self.step_num, loss=float(loss), contributors=float(cnt)
+        )
 
     # -- on-device training chain (data-loader path, no host I/O per step) ---
 
@@ -278,14 +405,7 @@ class DPTrainer:
                 sampler,
                 self._build_chain(sampler, steps, batch_per_device),
             )
-        if valid is None:
-            valid_arr = np.ones((self.n_devices,), np.float32)
-        else:
-            valid_arr = np.asarray(valid, np.float32)
-            if valid_arr.shape != (self.n_devices,):
-                raise ValueError(
-                    f"valid must have shape ({self.n_devices},), got {valid_arr.shape}"
-                )
+        valid_arr = self._normalize_valid(valid)
         vd = jax.device_put(valid_arr, self._data_sharding)
         # fold the current step count in so consecutive chain calls continue
         # the data stream instead of replaying the same batches

@@ -6,6 +6,7 @@ because the masked average of per-shard mean gradients equals the full-batch
 mean gradient.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -101,6 +102,51 @@ class TestMLPTraining:
         x, y = next(iter(ds.batches(64, 1)))
         with pytest.raises(ValueError, match="valid"):
             t.train_step(x, y, valid=[1.0, 0.0])
+
+
+class TestGradAccumulation:
+    """Microbatched steps: one collective per effective batch, numerically
+    identical to a single step on the concatenated batch."""
+
+    def test_accum_matches_full_batch_step(self, line8):
+        a, b = mlp_trainer(line8, seed=0), mlp_trainer(line8, seed=0)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        m_full = a.train_step(x, y)
+        m_acc = b.train_step_accum(x, y, accum_steps=4)
+        assert abs(m_full.loss - m_acc.loss) < 1e-5
+        fa = np.concatenate([np.ravel(p) for p in jax.tree.leaves(a.params)])
+        fb = np.concatenate([np.ravel(p) for p in jax.tree.leaves(b.params)])
+        np.testing.assert_allclose(fa, fb, atol=2e-5)
+
+    def test_accum_bucketed_matches_full_batch_step(self, line8):
+        """Accumulation composes with the bucketed (chunked) collective."""
+        a = mlp_trainer(line8, seed=0, bucket=4096)
+        b = mlp_trainer(line8, seed=0, bucket=4096)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        m_full = a.train_step(x, y)
+        m_acc = b.train_step_accum(x, y, accum_steps=2)
+        assert abs(m_full.loss - m_acc.loss) < 1e-5
+        fa = np.concatenate([np.ravel(p) for p in jax.tree.leaves(a.params)])
+        fb = np.concatenate([np.ravel(p) for p in jax.tree.leaves(b.params)])
+        np.testing.assert_allclose(fa, fb, atol=2e-5)
+
+    def test_accum_masked_devices(self, line8):
+        trainer = mlp_trainer(line8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(32, 1)))
+        valid = np.ones(8, np.float32)
+        valid[0] = 0.0
+        m = trainer.train_step_accum(x, y, accum_steps=2, valid=valid)
+        assert m.contributors == 7.0 and np.isfinite(m.loss)
+
+    def test_accum_rejects_indivisible(self, line8):
+        trainer = mlp_trainer(line8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(40, 1)))
+        with pytest.raises(ValueError):
+            trainer.train_step_accum(x, y, accum_steps=3)
 
 
 class TestTrainChain:
