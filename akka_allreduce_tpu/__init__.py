@@ -60,6 +60,7 @@ _LAZY_EXPORTS = {
     "ElasticDPTrainer": "akka_allreduce_tpu.train",
     "LongContextTrainer": "akka_allreduce_tpu.train",
     "ElasticClusterNode": "akka_allreduce_tpu.train",
+    "Zero1DPTrainer": "akka_allreduce_tpu.train",
     "TrainerCheckpointer": "akka_allreduce_tpu.train",
 }
 

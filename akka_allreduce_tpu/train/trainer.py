@@ -36,6 +36,34 @@ class TrainStepMetrics:
     contributors: float
 
 
+def default_classification_loss():
+    """Mean softmax cross-entropy over integer labels (the trainers' default)."""
+    return lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
+        logits, y
+    ).mean()
+
+
+def normalize_valid(valid: Sequence[float] | None, n: int) -> np.ndarray:
+    """Contributor mask -> validated (n,) float32 array (shared by trainers)."""
+    if valid is None:
+        return np.ones((n,), np.float32)
+    arr = np.asarray(valid, np.float32)
+    if arr.shape != (n,):
+        raise ValueError(f"valid must have shape ({n},), got {arr.shape}")
+    return arr
+
+
+def place_batch(x, y, n_devices: int, data_sharding):
+    """Validate divisibility and place a global (x, y) batch on the mesh."""
+    if x.shape[0] % n_devices:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by {n_devices}"
+        )
+    x = jax.device_put(np.asarray(x, np.float32), data_sharding)
+    y = jax.device_put(np.asarray(y, np.int32), data_sharding)
+    return x, y
+
+
 class DPTrainer:
     """Data-parallel trainer over every axis of ``mesh``.
 
@@ -69,11 +97,7 @@ class DPTrainer:
         # how many independent data streams train_chain samples (one per
         # device here; the long-context trainer has one per DP replica row)
         self.data_shards = self.n_devices
-        self._loss = loss_fn or (
-            lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
-                logits, y
-            ).mean()
-        )
+        self._loss = loss_fn or default_classification_loss()
 
         key = jax.random.PRNGKey(seed)
         self.params = model.init(key, jnp.asarray(example_input))
@@ -172,24 +196,10 @@ class DPTrainer:
     # -- stepping ------------------------------------------------------------
 
     def _normalize_valid(self, valid: Sequence[float] | None) -> np.ndarray:
-        """Contributor mask -> validated (n_devices,) float32 array."""
-        if valid is None:
-            return np.ones((self.n_devices,), np.float32)
-        arr = np.asarray(valid, np.float32)
-        if arr.shape != (self.n_devices,):
-            raise ValueError(
-                f"valid must have shape ({self.n_devices},), got {arr.shape}"
-            )
-        return arr
+        return normalize_valid(valid, self.n_devices)
 
     def _place_batch(self, x, y):
-        if x.shape[0] % self.n_devices:
-            raise ValueError(
-                f"global batch {x.shape[0]} not divisible by {self.n_devices}"
-            )
-        x = jax.device_put(np.asarray(x, np.float32), self._data_sharding)
-        y = jax.device_put(np.asarray(y, np.int32), self._data_sharding)
-        return x, y
+        return place_batch(x, y, self.n_devices, self._data_sharding)
 
     def train_step(
         self, x: np.ndarray, y: np.ndarray, valid: Sequence[float] | None = None
@@ -311,6 +321,8 @@ class DPTrainer:
         """
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if accum_steps == 1:  # identical math; reuse the already-built step
+            return self.train_step(x, y, valid)
         n = self.n_devices * accum_steps
         if x.shape[0] % n:
             raise ValueError(
@@ -322,13 +334,12 @@ class DPTrainer:
                 accum_steps
             )
         micro = x.shape[0] // n
-        # (global_batch, ...) -> (n_dev, accum, micro, ...) -> flatten dev dim
-        # back so the data sharding splits the leading axis across devices
+        # (global_batch, ...) -> (n_dev*accum, micro, ...): the data sharding
+        # splits the leading axis, so device d gets its contiguous
+        # (accum, micro, ...) block — the same rows train_step would give it
         def rearrange(a):
             a = np.asarray(a)
-            return a.reshape(
-                self.n_devices, accum_steps, micro, *a.shape[1:]
-            ).reshape(self.n_devices * accum_steps, micro, *a.shape[1:])
+            return a.reshape(n, micro, *a.shape[1:])
 
         valid_arr = self._normalize_valid(valid)
         xd = jax.device_put(
