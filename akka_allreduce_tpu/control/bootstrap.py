@@ -356,6 +356,15 @@ class NodeProcess:
         self._welcomed = asyncio.Event()
         self._shutdown = asyncio.Event()
         self.shutdown_reason: str | None = None
+        # master-loss detection: consecutive failed sends to the master seed.
+        # The reference restarts its seed JVM and workers re-join via Akka
+        # Cluster; here the node notices its heartbeats bouncing and re-runs
+        # the join handshake against whatever master now owns the endpoint.
+        self._master_send_failures = 0
+        self._rejoining = False
+        self._rejoin_task: asyncio.Task | None = None
+        self.rejoin_after_failures = 3
+        self.transport.on_send_error = self._on_send_error
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -395,7 +404,7 @@ class NodeProcess:
             )
 
     async def stop(self) -> None:
-        for attr in ("_heartbeat_task", "_join_task"):
+        for attr in ("_heartbeat_task", "_join_task", "_rejoin_task"):
             task = getattr(self, attr)
             if task is not None:
                 task.cancel()
@@ -416,7 +425,53 @@ class NodeProcess:
 
     # -- cluster protocol ------------------------------------------------------
 
+    def _on_send_error(self, ep: cl.Endpoint, env: Envelope) -> None:
+        if env.dest != "master" or not self._welcomed.is_set():
+            return
+        self._master_send_failures += 1
+        if (
+            self._master_send_failures >= self.rejoin_after_failures
+            and not self._rejoining
+        ):
+            self._rejoining = True
+            log.info(
+                "node %s: master unreachable (%d failed sends) -> re-join",
+                self.node_id,
+                self._master_send_failures,
+            )
+            self._rejoin_task = asyncio.ensure_future(self._rejoin_master())
+
+    async def _rejoin_master(self) -> None:
+        """The master endpoint stopped answering: run the join handshake
+        again (keeping our preferred id) against whatever owns the endpoint.
+
+        A rejoin wipes this node's worker state, so it presents a NEW
+        incarnation: a replacement master welcomes it normally, and a master
+        that was merely unreachable for a moment treats it as a restart and
+        re-runs the Prepare handshake — either way the fresh workers get
+        configured instead of silently wedging.
+        """
+        try:
+            if self._heartbeat_task is not None:
+                self._heartbeat_task.cancel()
+                self._heartbeat_task = None
+            self._welcomed.clear()
+            self.incarnation = _new_incarnation()
+            join = cl.JoinCluster(
+                self.transport.endpoint.host,
+                self.transport.endpoint.port,
+                self.node_id if self.node_id is not None else -1,
+                self.incarnation,
+            )
+            while not self._welcomed.is_set() and not self._shutdown.is_set():
+                await self.transport.send(Envelope("master", join))
+                await asyncio.sleep(self.join_retry_s)
+        finally:
+            self._rejoining = False
+            self._master_send_failures = 0
+
     def _on_cluster_msg(self, msg: Any) -> list[Envelope]:
+        self._master_send_failures = 0  # the master is talking to us
         if isinstance(msg, cl.Welcome):
             return self._on_welcome(msg)
         if isinstance(msg, cl.AddressBook):
@@ -434,6 +489,9 @@ class NodeProcess:
     def _on_welcome(self, msg: cl.Welcome) -> list[Envelope]:
         if self._welcomed.is_set():
             return []  # duplicate Welcome from a join retry race
+        if self._heartbeat_task is not None:  # re-welcome after master loss
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
         self.config = AllreduceConfig.from_json(msg.config_json)
         self.node_id = msg.node_id
         dims = self.config.master.dimensions

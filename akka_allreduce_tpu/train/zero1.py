@@ -193,11 +193,14 @@ class Zero1DPTrainer:
         )
 
     def set_flat_params(self, vec: np.ndarray) -> None:
+        vec = jnp.asarray(vec, jnp.float32)
+        if vec.shape != (self.param_count,):
+            raise ValueError(
+                f"expected flat params of shape ({self.param_count},), "
+                f"got {vec.shape}"
+            )
         self.flat_params = jax.device_put(
-            jnp.pad(
-                jnp.asarray(vec, jnp.float32),
-                (0, self._padded - self.param_count),
-            ),
+            jnp.pad(vec, (0, self._padded - self.param_count)),
             self._replicated,
         )
 

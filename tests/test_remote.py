@@ -24,6 +24,7 @@ from akka_allreduce_tpu.config import (
 from akka_allreduce_tpu.control import cluster as cl
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.control.bootstrap import MasterProcess, NodeProcess
+from akka_allreduce_tpu.control.envelope import Envelope
 from akka_allreduce_tpu.protocol import (
     AllReduceInput,
     CompleteAllreduce,
@@ -473,6 +474,66 @@ def test_restart_same_identity_is_reprepared():
             await node.start()
             await node.wait_welcomed()
             h.nodes[1] = node
+            f1 = h.flushes(1)
+            await h.wait_for(lambda: h.flushes(1) >= f1 + 3, timeout=15.0)
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_master_restart_recovery():
+    """The master process dies and a replacement starts on the SAME seed
+    endpoint: nodes notice their heartbeats bouncing, re-run the join
+    handshake, and rounds resume — the control plane's single point of
+    failure is recoverable without restarting the workers."""
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=-1), 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 2)
+            port = h.master.transport.endpoint.port
+            await h.master.stop()  # master crash
+            await asyncio.sleep(0.3)  # a few heartbeats bounce
+            h.master = MasterProcess(_config(2, max_rounds=-1), port=port)
+            await h.master.start()
+            # both nodes re-join the replacement under their old ids...
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0, 1], timeout=20.0
+            )
+            # ...and rounds flow again
+            f0, f1 = h.flushes(0), h.flushes(1)
+            await h.wait_for(
+                lambda: h.flushes(0) >= f0 + 3 and h.flushes(1) >= f1 + 3,
+                timeout=20.0,
+            )
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_spurious_rejoin_against_alive_master_recovers():
+    """A node that wrongly concludes the master died (transient send
+    failures) rejoins with a FRESH incarnation, so the still-alive master
+    treats it as a restart and re-runs Prepare — its wiped worker state gets
+    reconfigured instead of wedging rounds forever."""
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=-1), 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 2)
+            node = h.nodes[1]
+            cfg_before = h.master.grid.config_id
+            # simulate the blip: report enough master-send failures
+            fake_env = Envelope("master", object())
+            for _ in range(node.rejoin_after_failures):
+                node._on_send_error(h.seed, fake_env)
+            await h.wait_for(
+                lambda: h.master.grid.config_id > cfg_before, timeout=15.0
+            )
             f1 = h.flushes(1)
             await h.wait_for(lambda: h.flushes(1) >= f1 + 3, timeout=15.0)
         finally:
