@@ -427,6 +427,7 @@ def _run_cluster_master(args) -> int:
         MasterConfig,
         MetaDataConfig,
         ThresholdConfig,
+        WorkerConfig,
     )
     from akka_allreduce_tpu.control.bootstrap import MasterProcess
     from akka_allreduce_tpu.utils.metrics import MetricsLogger
@@ -440,6 +441,9 @@ def _run_cluster_master(args) -> int:
             dimensions=args.dims,
             heartbeat_interval_s=args.heartbeat,
         ),
+        # both CLI node roles publish snapshots (fixed demo arrays / weights
+        # replaced by reference), so the zero-copy scatter path is sound
+        worker=WorkerConfig(zero_copy_scatter=True),
     )
 
     async def run() -> None:

@@ -41,6 +41,7 @@ def config1_local_engine(size: int = 1_000_000, rounds: int = 10) -> dict:
         MasterConfig,
         MetaDataConfig,
         ThresholdConfig,
+        WorkerConfig,
     )
     from akka_allreduce_tpu.control.local import LocalAllreduceSystem
     from akka_allreduce_tpu.protocol import AllReduceInput
@@ -51,6 +52,7 @@ def config1_local_engine(size: int = 1_000_000, rounds: int = 10) -> dict:
         metadata=MetaDataConfig(data_size=size, max_chunk_size=262_144),
         line_master=LineMasterConfig(round_window=2, max_rounds=rounds),
         master=MasterConfig(node_num=n, dimensions=1),
+        worker=WorkerConfig(zero_copy_scatter=True),  # fixed input arrays
     )
     rng = np.random.default_rng(0)
     inputs = [rng.standard_normal(size).astype(np.float32) for _ in range(n)]
@@ -311,6 +313,7 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
         MasterConfig,
         MetaDataConfig,
         ThresholdConfig,
+        WorkerConfig,
     )
     from akka_allreduce_tpu.control.envelope import peer_addr
     from akka_allreduce_tpu.control.local import LocalAllreduceSystem
@@ -323,6 +326,7 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
         metadata=MetaDataConfig(data_size=size, max_chunk_size=16_384),
         line_master=LineMasterConfig(round_window=2, max_rounds=rounds),
         master=MasterConfig(node_num=n, dimensions=1),
+        worker=WorkerConfig(zero_copy_scatter=True),  # fixed input arrays
     )
     rng = np.random.default_rng(0)
     inputs = [rng.standard_normal(size).astype(np.float32) for _ in range(n)]

@@ -16,7 +16,15 @@ from akka_allreduce_tpu.protocol import (
 
 
 class AllreduceBinder(Protocol):
-    """What a worker needs from the ML side (reference ``AllreduceBinder``)."""
+    """What a worker needs from the ML side (reference ``AllreduceBinder``).
+
+    Contract: by default the engine snapshots the source's array before any
+    asynchronous delivery, so ``data_source`` may reuse one buffer. With
+    ``WorkerConfig(zero_copy_scatter=True)`` the engine scatters zero-copy
+    views instead — then the returned array must stay unmutated until the
+    round completes (publish new values by replacing the array, not by
+    writing into it).
+    """
 
     def data_source(self, req: AllReduceInputRequest) -> AllReduceInput: ...
 

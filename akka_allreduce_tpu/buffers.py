@@ -63,7 +63,11 @@ class ScatteredDataBuffer:
         self.num_chunks = max(
             1, -(-self.block_size // metadata.max_chunk_size)
         )  # ceil div
-        self._sums = np.zeros(self.block_size, dtype=np.float32)
+        # np.empty, not zeros: the first store per chunk copies instead of
+        # accumulating, so the storage is never read uninitialized and the
+        # page-touching zero pass is skipped (one full-buffer write per round
+        # saved on the engine hot path)
+        self._sums = np.empty(self.block_size, dtype=np.float32)
         self._counts = np.zeros(self.num_chunks, dtype=np.int32)
         self._contributed = np.zeros((self.num_chunks, peer_size), dtype=bool)
         self._reduced = np.zeros(self.num_chunks, dtype=bool)
@@ -96,7 +100,14 @@ class ScatteredDataBuffer:
             raise ValueError(
                 f"chunk {chunk_id} expects shape ({stop - start},), got {value.shape}"
             )
-        native.accumulate(self._sums[start:stop], value)
+        # After reduce() the sum has been broadcast: late arrivals are counted
+        # (observability) but no longer accumulated — nothing reads the sum
+        # again, and skipping the add lets reduce() hand out a zero-copy view.
+        if not self._reduced[chunk_id]:
+            if self._counts[chunk_id] == 0:  # first contribution: plain copy
+                np.copyto(self._sums[start:stop], value)
+            else:
+                native.accumulate(self._sums[start:stop], value)
         self._counts[chunk_id] += 1
         self._contributed[chunk_id, src_id] = True
         return (
@@ -121,10 +132,20 @@ class ScatteredDataBuffer:
         )
 
     def reduce(self, chunk_id: int) -> tuple[np.ndarray, int]:
-        """Return (summed chunk, contributor count) and mark the chunk reduced."""
+        """Return (summed chunk, contributor count) and mark the chunk reduced.
+
+        The returned array is a read-only view into the buffer's storage —
+        marking the chunk reduced freezes it (``store`` stops accumulating),
+        so no copy is needed on the broadcast hot path.
+        """
         start, stop = self._chunk_bounds(chunk_id)
+        if int(self._counts[chunk_id]) == 0 and not self._reduced[chunk_id]:
+            # no contributions: the storage was never written — present zeros
+            self._sums[start:stop] = 0.0
         self._reduced[chunk_id] = True
-        return self._sums[start:stop].copy(), int(self._counts[chunk_id])
+        out = self._sums[start:stop]
+        out.flags.writeable = False
+        return out, int(self._counts[chunk_id])
 
 
 class ReducedDataBuffer:
@@ -207,16 +228,22 @@ class ReducedDataBuffer:
     def reach_completion_threshold(self) -> bool:
         return self.filled_chunks >= self.completion_trigger
 
-    def get_with_counts(self) -> tuple[np.ndarray, np.ndarray]:
+    def get_with_counts(self, copy: bool = True) -> tuple[np.ndarray, np.ndarray]:
         """(data, per-element contributor counts), trimmed to ``data_size``.
 
         Unfilled chunks read as zeros with count 0 — the consumer's divide
         leaves them untouched (partial completion is visible in the counts).
+
+        ``copy=False`` returns a view into the buffer's storage — only for
+        callers that immediately retire the buffer (the worker flushes and
+        evicts the round in the same step); later ``store`` calls would write
+        through the view.
         """
         n = self.metadata.data_size
         lengths = np.tile(self._chunk_lengths, self.peer_size)
         counts = native.expand_counts(self._chunk_counts.reshape(-1), lengths, n)
-        return self._data[:n].copy(), counts
+        data = self._data[:n]
+        return (data.copy() if copy else data), counts
 
 
 class RoundBuffers:

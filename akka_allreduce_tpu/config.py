@@ -102,6 +102,13 @@ class WorkerConfig:
 
     stats_reporting_round_frequency: int = 10
     round_window: int = 4  # max out-of-order rounds buffered concurrently
+    # Scatter the data source's array as zero-copy views instead of copying
+    # each chunk. Saves a full-buffer copy per round, but is only sound when
+    # the source publishes SNAPSHOTS — a fresh (or never-mutated) array per
+    # round, replaced by reference — because frames may be encoded after the
+    # handler returns (deferred queues / event-loop awaits). Sources that
+    # reuse and mutate one buffer in place must leave this False.
+    zero_copy_scatter: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -136,7 +136,7 @@ class MasterProcess:
         if isinstance(msg, cl.JoinCluster):
             return self._on_join(msg, now)
         if isinstance(msg, cl.Heartbeat):
-            return self._on_heartbeat(msg.node_id, msg.incarnation, now)
+            return self._on_heartbeat(msg, now)
         if isinstance(msg, cl.LeaveCluster):
             self.monitor.leave(msg.node_id, now)
             out = self.grid.member_unreachable(msg.node_id)
@@ -221,11 +221,25 @@ class MasterProcess:
             out.extend(self.grid.member_up(nid))
         return out
 
-    def _on_heartbeat(
-        self, node_id: int, incarnation: int, now: float
-    ) -> list[Envelope]:
+    def _on_heartbeat(self, msg: cl.Heartbeat, now: float) -> list[Envelope]:
+        node_id, incarnation = msg.node_id, msg.incarnation
         if node_id not in self.book:
-            return []  # stale heartbeat from a node we already expelled
+            # A heartbeat from a node this master has never admitted: either a
+            # stale beat from an expelled node, or — the dangerous case — this
+            # is a REPLACEMENT master (restarted on the seed endpoint, empty
+            # book) and the sender is a healthy member of its predecessor.
+            # Its sends all succeed, so the node's failure counter never
+            # trips; without a reply it heartbeats into the void forever.
+            # Tell it to re-run the join handshake at its advertised endpoint.
+            if msg.port > 0:
+                return [
+                    Envelope(
+                        f"node:{node_id}",
+                        cl.Rejoin("unknown-node"),
+                        via=cl.Endpoint(msg.host, msg.port),
+                    )
+                ]
+            return []
         if self._incarnations.get(node_id) != incarnation:
             # zombie: a partitioned process whose id was reclaimed by a newer
             # joiner — its stale heartbeats must not alias the current
@@ -362,9 +376,11 @@ class NodeProcess:
         # the join handshake against whatever master now owns the endpoint.
         self._master_send_failures = 0
         self._rejoining = False
+        self._left = False  # graceful leave announced; never rejoin after
         self._rejoin_task: asyncio.Task | None = None
         self.rejoin_after_failures = 3
         self.transport.on_send_error = self._on_send_error
+        self.transport.on_send_ok = self._on_send_ok
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -398,6 +414,14 @@ class NodeProcess:
 
     async def leave(self) -> None:
         """Graceful departure (the reference's Cluster leave)."""
+        # Stop heartbeating BEFORE announcing the leave, and latch _left so a
+        # master reply to an already-in-flight heartbeat (Rejoin from a
+        # replacement that no longer knows us) cannot drag this node back
+        # into the cluster on its way out.
+        self._left = True
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
         if self.node_id is not None:
             await self.transport.send(
                 Envelope("master", cl.LeaveCluster(self.node_id))
@@ -425,8 +449,16 @@ class NodeProcess:
 
     # -- cluster protocol ------------------------------------------------------
 
+    def _on_send_ok(self, ep: cl.Endpoint, env: Envelope) -> None:
+        # rejoin triggers on CONSECUTIVE master-send failures: a transient
+        # blip must not accumulate forever toward a spurious cluster-wide
+        # rejoin (the master rarely sends anything back in steady state, so
+        # resetting only on inbound traffic would never clear the counter)
+        if env.dest == "master":
+            self._master_send_failures = 0
+
     def _on_send_error(self, ep: cl.Endpoint, env: Envelope) -> None:
-        if env.dest != "master" or not self._welcomed.is_set():
+        if env.dest != "master" or not self._welcomed.is_set() or self._left:
             return
         self._master_send_failures += 1
         if (
@@ -484,6 +516,19 @@ class NodeProcess:
             self.shutdown_reason = msg.reason
             self._shutdown.set()
             return []
+        if isinstance(msg, cl.Rejoin):
+            # the master does not recognize us (replacement master on the
+            # seed endpoint): run the join handshake again, fresh incarnation
+            # — unless we are the reason it doesn't know us (graceful leave)
+            if self._welcomed.is_set() and not self._rejoining and not self._left:
+                log.info(
+                    "node %s: master replied Rejoin(%s) -> re-join",
+                    self.node_id,
+                    msg.reason,
+                )
+                self._rejoining = True
+                self._rejoin_task = asyncio.ensure_future(self._rejoin_master())
+            return []
         raise TypeError(f"node cannot handle {type(msg).__name__}")
 
     def _on_welcome(self, msg: cl.Welcome) -> list[Envelope]:
@@ -523,6 +568,12 @@ class NodeProcess:
 
     async def _send_heartbeat(self) -> None:
         assert self.node_id is not None
+        # advertise our server endpoint: a replacement master (same seed
+        # address, empty address book) uses it to reply Rejoin
+        ep = self.transport.endpoint
         await self.transport.send(
-            Envelope("master", cl.Heartbeat(self.node_id, self.incarnation))
+            Envelope(
+                "master",
+                cl.Heartbeat(self.node_id, self.incarnation, ep.host, ep.port),
+            )
         )

@@ -76,11 +76,26 @@ class Heartbeat:
 
     Carries the sender's incarnation so a zombie (a partitioned process
     whose node id was legitimately reclaimed by a newer joiner) cannot
-    alias the current holder's liveness with its stale heartbeats.
+    alias the current holder's liveness with its stale heartbeats, and the
+    sender's own server endpoint (``host``/``port``) so a master that does
+    NOT know the sender — a replacement master that restarted on the seed
+    endpoint with an empty address book — can reply ``Rejoin`` instead of
+    dropping the heartbeat and leaving the node wedged forever.
     """
 
     node_id: int
     incarnation: int = 0
+    host: str = ""
+    port: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Rejoin:
+    """Master -> node: your membership is not recognized here — run the join
+    handshake again (new incarnation). Sent by a replacement master that
+    receives heartbeats from nodes of its predecessor."""
+
+    reason: str = "unknown-node"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -136,6 +136,10 @@ class GridMaster:
             raise ValueError(f"dimensions must be 1 or 2, got {dims}")
 
         out: list[Envelope] = []
+        # Completed-round budget carried into the new lines: prior configs'
+        # completions, split evenly (line count/shape may have changed — the
+        # run-level target is ~max_rounds useful rounds per current line).
+        prior_per_line = self._completed_before_reorg // len(lines)
         for line_id, worker_ids in enumerate(lines):
             lm = LineMaster(
                 self.threshold,
@@ -147,7 +151,12 @@ class GridMaster:
             for w in worker_ids:
                 self._line_of_worker[w] = line_id
             out.extend(
-                lm.prepare(tuple(worker_ids), self.config_id, self.resume_round)
+                lm.prepare(
+                    tuple(worker_ids),
+                    self.config_id,
+                    self.resume_round,
+                    completed_so_far=prior_per_line,
+                )
             )
         log.info(
             "master: organized %d nodes into %d line(s), config %d, resume at %d",

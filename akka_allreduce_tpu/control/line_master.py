@@ -53,6 +53,11 @@ class LineMaster:
         self.started_rounds: set[int] = set()
         self.completions: dict[int, set[int]] = {}  # round -> worker ids
         self.total_completed = 0
+        # line-rounds completed by this line's predecessors (earlier configs);
+        # max_rounds budgets COMPLETED rounds across the lineage, not round
+        # numbers — reorganization churn burns round numbers (they are never
+        # reused, stale messages must not collide) but must not burn budget
+        self.completed_so_far = 0
         self._confirmed: set[int] = set()
         self._preparing = False
         self._prepared_at = 0.0
@@ -60,12 +65,17 @@ class LineMaster:
     # -- configuration / handshake ------------------------------------------
 
     def prepare(
-        self, worker_ids: tuple[int, ...], config_id: int, from_round: int
+        self,
+        worker_ids: tuple[int, ...],
+        config_id: int,
+        from_round: int,
+        completed_so_far: int = 0,
     ) -> list[Envelope]:
         """Begin the PrepareAllreduce handshake with a (new) worker set."""
         self.worker_ids = tuple(worker_ids)
         self.config_id = config_id
         self.next_round = from_round
+        self.completed_so_far = completed_so_far
         self.started_rounds.clear()
         self.completions.clear()
         self.completed_up_to = from_round - 1
@@ -172,7 +182,10 @@ class LineMaster:
         while len(self.started_rounds) < self.config.round_window:
             if (
                 self.config.max_rounds >= 0
-                and self.next_round >= self.config.max_rounds
+                and self.completed_so_far
+                + self.total_completed
+                + len(self.started_rounds)
+                >= self.config.max_rounds
             ):
                 break
             r = self.next_round
@@ -187,9 +200,13 @@ class LineMaster:
 
     @property
     def is_done(self) -> bool:
-        """All max_rounds rounds completed (only meaningful with max_rounds >= 0)."""
+        """max_rounds line-rounds COMPLETED across the line's lineage (only
+        meaningful with max_rounds >= 0). Budgeting completions, not round
+        numbers, means reorganization churn can never satisfy the budget
+        without actual work."""
         return (
             self.config.max_rounds >= 0
             and not self._preparing
-            and self.completed_up_to >= self.config.max_rounds - 1
+            and self.completed_so_far + self.total_completed
+            >= self.config.max_rounds
         )

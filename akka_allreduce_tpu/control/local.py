@@ -160,6 +160,7 @@ def _main() -> None:
         MasterConfig,
         MetaDataConfig,
         ThresholdConfig,
+        WorkerConfig,
     )
 
     cfg = AllreduceConfig(
@@ -167,6 +168,8 @@ def _main() -> None:
         metadata=MetaDataConfig(data_size=args.size, max_chunk_size=args.chunk),
         line_master=LineMasterConfig(round_window=2, max_rounds=args.rounds),
         master=MasterConfig(node_num=args.nodes, dimensions=args.dims),
+        # demo sources return fixed arrays -> snapshot contract holds
+        worker=WorkerConfig(zero_copy_scatter=True),
     )
 
     rng = np.random.default_rng(0)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import socket
 from typing import Any, Awaitable, Callable
 
 from akka_allreduce_tpu.control import wire
@@ -58,6 +59,10 @@ class RemoteTransport:
         self.delivered = 0
         self.dropped = 0
         self.on_send_error: Callable[[Endpoint, Envelope], None] | None = None
+        # called after a frame reaches the socket buffer — lets callers treat
+        # failure counts as CONSECUTIVE (reset on success) rather than
+        # cumulative-since-forever
+        self.on_send_ok: Callable[[Endpoint, Envelope], None] | None = None
         # fault injection (the reference tests by omitting messages,
         # SURVEY.md §5): return True to swallow an outgoing envelope
         self.drop_filter: Callable[[Envelope], bool] | None = None
@@ -160,6 +165,8 @@ class RemoteTransport:
         for attempt in (0, 1):
             try:
                 await self._write(ep, frame)
+                if self.on_send_ok is not None:
+                    self.on_send_ok(ep, env)
                 return
             except (OSError, asyncio.TimeoutError) as exc:
                 had_conn = ep in self._conns
@@ -180,6 +187,14 @@ class RemoteTransport:
         for env in envelopes:
             await self.send(env)
 
+    # Back-pressure point: drain (bounded) only once this much is buffered.
+    # Draining every frame costs a timer + task round-trip through the event
+    # loop per message; letting the OS buffer absorb bursts nearly doubles
+    # small-chunk message rate while still bounding memory at an
+    # unresponsive peer (the drain timeout turns a stalled peer into
+    # dropped messages, not a stalled control plane).
+    write_buffer_high_water = 1 << 20
+
     async def _write(self, ep: Endpoint, frame: bytes) -> None:
         # Bounded connect/drain: sends run inline in the pump consumer, so an
         # unresponsive peer (SYN blackhole) must not stall the whole control
@@ -192,9 +207,16 @@ class RemoteTransport:
                     asyncio.open_connection(ep.host, ep.port),
                     self.connect_timeout_s,
                 )
+                sock = writer.get_extra_info("socket")
+                if sock is not None:  # control frames are latency-sensitive
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._conns[ep] = writer
             writer.write(frame)
-            await asyncio.wait_for(writer.drain(), self.connect_timeout_s)
+            if (
+                writer.transport.get_write_buffer_size()
+                > self.write_buffer_high_water
+            ):
+                await asyncio.wait_for(writer.drain(), self.connect_timeout_s)
 
     # -- receiving ----------------------------------------------------------------
 

@@ -40,6 +40,7 @@ _TAGS: dict[type, int] = {
     cl.LeaveCluster: 10,
     cl.AddressBook: 11,
     cl.Shutdown: 12,
+    cl.Rejoin: 13,
 }
 
 _U16 = struct.Struct("<H")
@@ -57,9 +58,11 @@ def _unpack_str(buf: memoryview, off: int) -> tuple[str, int]:
     return bytes(buf[off : off + n]).decode("utf-8"), off + n
 
 
-def _pack_floats(value: np.ndarray) -> bytes:
-    arr = np.ascontiguousarray(value, dtype=np.float32)
-    return _U32.pack(arr.size) + arr.tobytes()
+def _pack_floats(value: np.ndarray) -> tuple[bytes, memoryview]:
+    """(length prefix, payload view) — the view is copied exactly once, by the
+    final frame join, instead of once per concatenation level."""
+    arr = np.ascontiguousarray(value, dtype="<f4")
+    return _U32.pack(arr.size), memoryview(arr).cast("B")
 
 
 def _unpack_floats(buf: memoryview, off: int) -> tuple[np.ndarray, int]:
@@ -71,67 +74,90 @@ def _unpack_floats(buf: memoryview, off: int) -> tuple[np.ndarray, int]:
 
 def encode(msg: Any) -> bytes:
     """Message -> ``[tag][body]`` bytes."""
+    return b"".join(_encode_parts(msg))
+
+
+def _encode_parts(msg: Any) -> list:
+    """Message -> list of buffer segments (bytes / memoryviews).
+
+    Payload-carrying messages keep the float array as a zero-copy view so the
+    caller's single ``join`` is the only copy on the send path.
+    """
     tag = _TAGS.get(type(msg))
     if tag is None:
         raise TypeError(f"no wire tag for {type(msg).__name__}")
     head = bytes([tag])
     if tag == 1:
-        return head + struct.pack("<q", msg.round_num)
+        return [head, struct.pack("<q", msg.round_num)]
     if tag == 2:
-        return (
-            head
-            + struct.pack(
+        n, payload = _pack_floats(msg.value)
+        return [
+            head,
+            struct.pack(
                 "<iiiq", msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num
-            )
-            + _pack_floats(msg.value)
-        )
+            ),
+            n,
+            payload,
+        ]
     if tag == 3:
-        return (
-            head
-            + struct.pack(
+        n, payload = _pack_floats(msg.value)
+        return [
+            head,
+            struct.pack(
                 "<iiiqi",
                 msg.src_id,
                 msg.dest_id,
                 msg.chunk_id,
                 msg.round_num,
                 msg.count,
-            )
-            + _pack_floats(msg.value)
-        )
+            ),
+            n,
+            payload,
+        ]
     if tag == 4:
-        return head + struct.pack("<iq", msg.src_id, msg.round_num)
+        return [head, struct.pack("<iq", msg.src_id, msg.round_num)]
     if tag == 5:
         peers = msg.peer_ids
-        return head + struct.pack(
-            f"<qiqiH{len(peers)}i",
-            msg.config_id,
-            msg.worker_id,
-            msg.round_num,
-            msg.line_id,
-            len(peers),
-            *peers,
-        )
+        return [
+            head,
+            struct.pack(
+                f"<qiqiH{len(peers)}i",
+                msg.config_id,
+                msg.worker_id,
+                msg.round_num,
+                msg.line_id,
+                len(peers),
+                *peers,
+            ),
+        ]
     if tag == 6:
-        return head + struct.pack("<qi", msg.config_id, msg.worker_id)
+        return [head, struct.pack("<qi", msg.config_id, msg.worker_id)]
     if tag == 7:
-        return (
-            head
-            + _pack_str(msg.host)
-            + struct.pack("<Hiq", msg.port, msg.preferred_node_id, msg.incarnation)
-        )
+        return [
+            head,
+            _pack_str(msg.host),
+            struct.pack("<Hiq", msg.port, msg.preferred_node_id, msg.incarnation),
+        ]
     if tag == 8:
-        return head + struct.pack("<i", msg.node_id) + _pack_str(msg.config_json)
+        return [head, struct.pack("<i", msg.node_id), _pack_str(msg.config_json)]
     if tag == 9:
-        return head + struct.pack("<iq", msg.node_id, msg.incarnation)
+        return [
+            head,
+            struct.pack("<iq", msg.node_id, msg.incarnation),
+            _pack_str(msg.host),
+            _U16.pack(msg.port),
+        ]
     if tag == 10:
-        return head + struct.pack("<i", msg.node_id)
+        return [head, struct.pack("<i", msg.node_id)]
     if tag == 11:
         parts = [head, _U16.pack(len(msg.entries))]
         for nid, host, port in msg.entries:
             parts.append(struct.pack("<i", nid) + _pack_str(host) + _U16.pack(port))
-        return b"".join(parts)
+        return parts
     if tag == 12:
-        return head + _pack_str(msg.reason)
+        return [head, _pack_str(msg.reason)]
+    if tag == 13:
+        return [head, _pack_str(msg.reason)]
     raise AssertionError(f"unhandled tag {tag}")
 
 
@@ -169,7 +195,10 @@ def decode(data: bytes | memoryview) -> Any:
         config_json, _ = _unpack_str(buf, off + 4)
         return cl.Welcome(node_id, config_json)
     if tag == 9:
-        return cl.Heartbeat(*struct.unpack_from("<iq", buf, off))
+        node_id, incarnation = struct.unpack_from("<iq", buf, off)
+        host, off = _unpack_str(buf, off + 12)
+        (port,) = _U16.unpack_from(buf, off)
+        return cl.Heartbeat(node_id, incarnation, host, port)
     if tag == 10:
         return cl.LeaveCluster(*struct.unpack_from("<i", buf, off))
     if tag == 11:
@@ -186,13 +215,22 @@ def decode(data: bytes | memoryview) -> Any:
     if tag == 12:
         reason, _ = _unpack_str(buf, off)
         return cl.Shutdown(reason)
+    if tag == 13:
+        reason, _ = _unpack_str(buf, off)
+        return cl.Rejoin(reason)
     raise ValueError(f"unknown wire tag {tag}")
 
 
 def encode_frame(dest: str, msg: Any) -> bytes:
-    """Framed envelope: ``[u32 len][u16 dest_len][dest][tag][body]``."""
-    body = _pack_str(dest) + encode(msg)
-    return _U32.pack(len(body)) + body
+    """Framed envelope: ``[u32 len][u16 dest_len][dest][tag][body]``.
+
+    Built with a single ``join`` over header + payload segments — the float
+    payload is copied exactly once, here, on its way to the socket.
+    """
+    parts = [b"", _pack_str(dest), *_encode_parts(msg)]
+    body_len = sum(len(p) for p in parts)
+    parts[0] = _U32.pack(body_len)
+    return b"".join(parts)
 
 
 def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:

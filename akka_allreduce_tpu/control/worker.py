@@ -178,10 +178,15 @@ class AllreduceWorker:
         out: list[Envelope] = []
         block = meta.block_size(self.peer_size)
         n_chunks = meta.chunks_per_block(self.peer_size)
-        # partition my input into one block per peer, chunk each block; the
-        # trailing block may run past data_size -> zero-pad (peers trim on flush)
-        padded = np.zeros(block * self.peer_size, dtype=np.float32)
-        padded[: meta.data_size] = data
+        # Partition my input into one block per peer, chunk each block; only
+        # chunks running past data_size materialize a zero-padded tail (peers
+        # trim the padding on flush). With ``zero_copy_scatter`` the chunks
+        # are views of the source's array (receivers only accumulate from
+        # scatter payloads, and frames are encoded from live memory later —
+        # sound only for snapshot-publishing sources, see WorkerConfig);
+        # otherwise each chunk is snapshotted here, synchronously.
+        data = np.ascontiguousarray(data, dtype=np.float32)
+        zero_copy = self.config.zero_copy_scatter
         my_id = self.worker_id
         assert my_id is not None
         my_rank = self.peer_ids.index(my_id)
@@ -189,7 +194,13 @@ class AllreduceWorker:
             for c in range(n_chunks):
                 lo = dest_rank * block + c * meta.max_chunk_size
                 hi = min(lo + meta.max_chunk_size, (dest_rank + 1) * block)
-                sb = ScatterBlock(padded[lo:hi], my_rank, dest_rank, c, r)
+                if hi <= meta.data_size:
+                    chunk = data[lo:hi] if zero_copy else data[lo:hi].copy()
+                else:
+                    chunk = np.zeros(hi - lo, dtype=np.float32)
+                    if lo < meta.data_size:
+                        chunk[: meta.data_size - lo] = data[lo:]
+                sb = ScatterBlock(chunk, my_rank, dest_rank, c, r)
                 if dest_id == my_id:
                     out.extend(self._on_scatter(sb))  # self-delivery, no wire
                 else:
@@ -229,7 +240,9 @@ class AllreduceWorker:
         buf.store(msg.value, msg.src_id, msg.chunk_id, msg.count)
         if not buf.reach_completion_threshold():
             return []
-        data, counts = buf.get_with_counts()
+        # copy=False: the round is evicted on the next line, so the flushed
+        # view's storage is never written again
+        data, counts = buf.get_with_counts(copy=False)
         rounds.complete(r)  # evicts this round AND abandons older in-flight ones
         self.completed_rounds += 1
         self.data_sink(AllReduceOutput(data, counts, r))

@@ -324,3 +324,33 @@ class TestLocalSystemEndToEnd:
             for out in sinks[i]:
                 np.testing.assert_allclose(out.data, oracle, rtol=1e-4, atol=1e-5)
                 assert (out.count == 4).all()
+
+
+class TestScatterSnapshotting:
+    """Default scatter snapshots the source's array; zero_copy_scatter shares
+    it (sound only for snapshot-publishing sources — WorkerConfig docs)."""
+
+    def _scatters(self, zero_copy):
+        data = np.arange(32, dtype=np.float32)
+        w = AllreduceWorker(
+            data_source=lambda req: AllReduceInput(data),
+            data_sink=lambda out: None,
+            config=WorkerConfig(zero_copy_scatter=zero_copy),
+        )
+        w.configure(MetaDataConfig(data_size=32, max_chunk_size=8), ThresholdConfig())
+        w.handle(PrepareAllreduce(1, (0, 1, 2, 3), worker_id=1, round_num=0))
+        out = w.handle(StartAllreduce(0))
+        return data, [e.msg for e in out if isinstance(e.msg, ScatterBlock)]
+
+    def test_default_copies_so_source_may_mutate_its_buffer(self):
+        data, blocks = self._scatters(zero_copy=False)
+        assert blocks
+        expected = [b.value.copy() for b in blocks]
+        data += 100.0  # source reuses its buffer after the round starts
+        for b, want in zip(blocks, expected):
+            assert not np.shares_memory(b.value, data)
+            np.testing.assert_array_equal(b.value, want)
+
+    def test_zero_copy_shares_source_memory(self):
+        data, blocks = self._scatters(zero_copy=True)
+        assert blocks and all(np.shares_memory(b.value, data) for b in blocks)

@@ -572,3 +572,124 @@ def test_rejoin_after_heartbeat_resume():
             await h.stop()
 
     asyncio.run(run())
+
+
+def test_transport_burst_past_high_water_delivers_everything():
+    """A burst far past the write-buffer high-water mark exercises the
+    conditional-drain back-pressure path; every frame must still arrive, in
+    order (FIFO per connection)."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+
+    async def run():
+        rx, tx = RemoteTransport(), RemoteTransport()
+        got: list[int] = []
+        rx.register("sink", lambda msg: got.append(msg.round_num) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            payload = np.arange(65536, dtype=np.float32)  # 256 KB/frame
+            n = 64  # 16 MB total >> 1 MB high-water mark
+            for r in range(n):
+                await tx.send(
+                    Envelope("sink", ScatterBlock(payload, 0, 1, 0, r))
+                )
+            await wait_until(lambda: len(got) == n)
+            assert got == list(range(n))
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_master_fast_replacement_rejoin_via_heartbeat_reply():
+    """The master is replaced so fast that node sends barely fail (the
+    failure counter never trips): the replacement answers the first unknown
+    heartbeat with Rejoin, and the node re-runs the join handshake."""
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=-1), 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 2)
+            port = h.master.transport.endpoint.port
+            await h.master.stop()
+            # replacement binds the seed endpoint IMMEDIATELY — before
+            # rejoin_after_failures sends can fail
+            h.master = MasterProcess(_config(2, max_rounds=-1), port=port)
+            await h.master.start()
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0, 1], timeout=20.0
+            )
+            f0, f1 = h.flushes(0), h.flushes(1)
+            await h.wait_for(
+                lambda: h.flushes(0) >= f0 + 3 and h.flushes(1) >= f1 + 3,
+                timeout=20.0,
+            )
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_master_send_failures_count_consecutively():
+    """Sparse, non-consecutive send failures must never accumulate into a
+    spurious rejoin: a success between failures resets the counter."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=-1), 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 1)
+            node = h.nodes[0]
+            # two failures (below the trip threshold of 3)...
+            for _ in range(2):
+                node._on_send_error(
+                    h.master.transport.endpoint,
+                    Envelope("master", cl.Heartbeat(0)),
+                )
+            assert node._master_send_failures == 2
+            assert not node._rejoining
+            # ...then one successful heartbeat resets the streak
+            await node._send_heartbeat()
+            await h.wait_for(lambda: node._master_send_failures == 0, 5.0)
+            # two MORE sparse failures still do not trip it
+            for _ in range(2):
+                node._on_send_error(
+                    h.master.transport.endpoint,
+                    Envelope("master", cl.Heartbeat(0)),
+                )
+            assert not node._rejoining
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_rejoin_ignored_after_graceful_leave():
+    """A Rejoin reply racing a graceful leave (the master answered an
+    in-flight heartbeat after LeaveCluster emptied its book) must not drag
+    the departing node back into the cluster."""
+
+    async def run():
+        h = _Harness(_config(2, max_rounds=-1), 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 1)
+            node = h.nodes[1]
+            await node.leave()
+            assert node._heartbeat_task is None  # heartbeats stopped first
+            # the racing reply arrives after the leave
+            node._on_cluster_msg(cl.Rejoin("unknown-node"))
+            assert not node._rejoining and node._rejoin_task is None
+            await h.wait_for(lambda: sorted(h.master.grid.nodes) == [0], 15.0)
+            # the cluster settles to node 0 alone; node 1 stays out
+            f0 = h.flushes(0)
+            await h.wait_for(lambda: h.flushes(0) > f0)
+            assert sorted(h.master.grid.nodes) == [0]
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
