@@ -59,6 +59,13 @@ def _cmd_bench(argv: list[str]) -> int:
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--schedule", choices=("psum", "butterfly", "ring"), default="psum")
     p.add_argument("--bucket", type=int, default=None)
+    p.add_argument(
+        "--compress",
+        choices=("bf16", "int8"),
+        default=None,
+        help="wire compression: bf16 halves collective bytes "
+        "(psum/butterfly/ring), int8 quarters them (ring only)",
+    )
     _add_mesh_flags(p)
     args = p.parse_args(argv)
 
@@ -73,6 +80,7 @@ def _cmd_bench(argv: list[str]) -> int:
         iters=args.iters,
         schedule=args.schedule,
         bucket_size=args.bucket,
+        compress=args.compress,
     )
     print(json.dumps(r.to_dict()))
     return 0
@@ -110,6 +118,13 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
         default=1,
         help="gradient-accumulation microbatches per step: one collective "
         "per effective batch, bigger batches in fixed memory",
+    )
+    p.add_argument(
+        "--compress",
+        choices=("bf16",),
+        default=None,
+        help="sync gradients in bfloat16 on the wire (half the ICI bytes; "
+        "optimizer state stays fp32)",
     )
 
 
@@ -296,6 +311,7 @@ def _cmd_train_mlp(argv: list[str]) -> int:
         example_input=np.zeros((1, 28, 28, 1), np.float32),
         learning_rate=args.lr,
         bucket_size=args.bucket,
+        compress=args.compress,
     )
     return _run_training(trainer, data.mnist_like(), args, label="mlp_mnist")
 
@@ -326,6 +342,7 @@ def _cmd_train_resnet(argv: list[str]) -> int:
         ),
         learning_rate=args.lr,
         bucket_size=args.bucket or 262_144,  # the reference's chunk geometry
+        compress=args.compress,
     )
     print(f"ResNet params: {trainer.param_count / 1e6:.1f}M")
     ds = data.SyntheticClassification(

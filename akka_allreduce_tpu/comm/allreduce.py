@@ -80,6 +80,7 @@ def masked_psum(
     axis_names: str | Axes,
     *,
     bucket_size: int | None = None,
+    wire_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Fused threshold-masked allreduce; use inside ``shard_map``.
 
@@ -88,14 +89,19 @@ def masked_psum(
       valid: scalar 0/1 contribution mask, or per-bucket mask ``(n_buckets,)``
         when ``bucket_size`` is given.
       axis_names: mesh axis (or axes) to reduce over.
+      wire_dtype: optional dtype (e.g. ``jnp.bfloat16``) the PAYLOAD collective
+        runs in — halves ICI bytes at bf16. The count collective ALWAYS runs
+        float32: 0/1 sums must stay exact on meshes larger than bf16's
+        contiguous-integer range (256).
     Returns:
-      ``(sum, count)`` — both replicated across the axes; ``sum`` has x's shape,
-      ``count`` has the mask's shape (per-element expansion is the caller's
-      choice via :func:`expand_counts`).
+      ``(sum, count)`` — both replicated across the axes; ``sum`` has x's shape
+      and dtype, ``count`` is float32 with the mask's shape (per-element
+      expansion is the caller's choice via :func:`expand_counts`).
     """
-    valid = jnp.asarray(valid, dtype=x.dtype)
+    valid = jnp.asarray(valid, dtype=jnp.float32)
+    mask = valid.astype(x.dtype)
     if bucket_size is None:
-        masked = x * valid
+        masked = x * mask
     else:
         n_buckets = _num_buckets(x.shape[0], bucket_size)
         if valid.shape != (n_buckets,):
@@ -104,8 +110,11 @@ def masked_psum(
             )
         pad = n_buckets * bucket_size - x.shape[0]
         xp = jnp.pad(x, (0, pad)).reshape(n_buckets, bucket_size)
-        masked = (xp * valid[:, None]).reshape(-1)[: x.shape[0]]
-    total = lax.psum(masked, axis_names)
+        masked = (xp * mask[:, None]).reshape(-1)[: x.shape[0]]
+    if wire_dtype is not None and masked.dtype != wire_dtype:
+        total = lax.psum(masked.astype(wire_dtype), axis_names).astype(x.dtype)
+    else:
+        total = lax.psum(masked, axis_names)
     count = lax.psum(valid, axis_names)
     return total, count
 
@@ -124,35 +133,79 @@ def _staged_masked_psum(
     valid: jax.Array,
     axis_names: Axes,
     bucket_size: int | None,
+    wire_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Butterfly: reduce one grid axis at a time (dim-0 sink feeds dim-1 source,
     SURVEY.md §4.3). Numerically equals the fused psum; structurally it is the
-    reference's staged grid round and lets each stage ride a different ICI axis."""
-    total, count = x, jnp.asarray(valid, dtype=x.dtype)
+    reference's staged grid round and lets each stage ride a different ICI axis.
+    ``wire_dtype`` (e.g. bf16) compresses each stage's collective payload;
+    counts always ride float32 (see :func:`masked_psum`)."""
+    count = jnp.asarray(valid, dtype=jnp.float32)
+    mask = count.astype(x.dtype)
     if bucket_size is not None:
         n_buckets = _num_buckets(x.shape[0], bucket_size)
         pad = n_buckets * bucket_size - x.shape[0]
         xp = jnp.pad(x, (0, pad)).reshape(n_buckets, bucket_size)
-        total = (xp * count[:, None]).reshape(-1)[: x.shape[0]]
+        total = (xp * mask[:, None]).reshape(-1)[: x.shape[0]]
     else:
-        total = x * count
+        total = x * mask
     for name in axis_names:
-        total = lax.psum(total, name)
+        if wire_dtype is not None and total.dtype != wire_dtype:
+            total = lax.psum(total.astype(wire_dtype), name).astype(x.dtype)
+        else:
+            total = lax.psum(total, name)
         count = lax.psum(count, name)
     return total, count
 
 
-def ring_allreduce_sum(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+def _compress_seg(seg: jax.Array, mode: str) -> tuple[jax.Array, jax.Array]:
+    """Quantize one ring segment for the wire: (payload, scale).
+
+    ``bf16``: truncate mantissa, scale unused (sent as 1.0 to keep one code
+    path). ``int8``: symmetric per-segment max-abs scaling — the classic
+    gradient-compression scheme; an all-zero segment maps to scale 1 so the
+    dequantize never divides by zero.
+    """
+    if mode == "bf16":
+        return seg.astype(jnp.bfloat16), jnp.ones((), jnp.float32)
+    amax = jnp.max(jnp.abs(seg))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(seg / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _decompress_seg(payload: jax.Array, scale: jax.Array, mode: str) -> jax.Array:
+    if mode == "bf16":
+        return payload.astype(jnp.float32)
+    return payload.astype(jnp.float32) * scale
+
+
+def ring_allreduce_sum(
+    x: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    *,
+    compress: str | None = None,
+) -> jax.Array:
     """Explicit bidirectional-naive ring allreduce of ``x`` over ``axis_name``.
 
     Reduce-scatter then all-gather via ``ppermute``, each in ``axis_size - 1``
     steps — the reference's ring schedule for large buffers (BASELINE.json:9)
     expressed as a compiled XLA loop. Payload is padded to ``axis_size`` equal
     segments.
+
+    ``compress`` ("bf16" | "int8") quantizes every hop's payload, halving
+    (bf16) or quartering (int8) the bytes each ICI/DCN transfer moves while
+    accumulation stays float32. Partial sums are re-quantized per hop, so the
+    error grows ~linearly in ring length — the standard compressed-ring
+    trade. The reduced result is quantized ONCE more for the gather phase (on
+    the owner too), so every device returns bit-identical output.
     """
     n = axis_size
     if n == 1:
         return x
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}")
     data = x.shape[0]
     seg = math.ceil(data / n)
     segs = jnp.pad(x, (0, n * seg - data)).reshape(n, seg)
@@ -162,7 +215,14 @@ def ring_allreduce_sum(x: jax.Array, axis_name: str, axis_size: int) -> jax.Arra
     def rs_step(s, segs):
         send_i = jnp.mod(idx - s, n)
         block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
-        recv = lax.ppermute(block, axis_name, fwd)
+        if compress is None:
+            recv = lax.ppermute(block, axis_name, fwd)
+        else:
+            payload, scale = _compress_seg(block, compress)
+            payload = lax.ppermute(payload, axis_name, fwd)
+            if compress == "int8":  # bf16 has no scale to carry
+                scale = lax.ppermute(scale, axis_name, fwd)
+            recv = _decompress_seg(payload, scale, compress)
         recv_i = jnp.mod(idx - s - 1, n)
         cur = lax.dynamic_slice_in_dim(segs, recv_i, 1, axis=0)
         return lax.dynamic_update_slice_in_dim(segs, cur + recv, recv_i, axis=0)
@@ -170,10 +230,26 @@ def ring_allreduce_sum(x: jax.Array, axis_name: str, axis_size: int) -> jax.Arra
     segs = lax.fori_loop(0, n - 1, rs_step, segs)
     # device i now owns fully-reduced segment (i + 1) mod n
 
+    if compress is not None:
+        # one final quantization of the reduced segment, applied to the
+        # owner's copy as well: the gather then replicates EXACTLY
+        own_i = jnp.mod(idx + 1, n)
+        own = lax.dynamic_slice_in_dim(segs, own_i, 1, axis=0)
+        payload, scale = _compress_seg(own, compress)
+        own = _decompress_seg(payload, scale, compress)
+        segs = lax.dynamic_update_slice_in_dim(segs, own, own_i, axis=0)
+
     def ag_step(s, segs):
         send_i = jnp.mod(idx + 1 - s, n)
         block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
-        recv = lax.ppermute(block, axis_name, fwd)
+        if compress is None:
+            recv = lax.ppermute(block, axis_name, fwd)
+        else:
+            payload, scale = _compress_seg(block, compress)
+            payload = lax.ppermute(payload, axis_name, fwd)
+            if compress == "int8":  # bf16 has no scale to carry
+                scale = lax.ppermute(scale, axis_name, fwd)
+            recv = _decompress_seg(payload, scale, compress)
         recv_i = jnp.mod(idx - s, n)
         return lax.dynamic_update_slice_in_dim(segs, recv, recv_i, axis=0)
 
@@ -208,12 +284,19 @@ def build_threshold_allreduce(
     bucket_size: int | None = None,
     schedule: str = "psum",
     donate: bool = True,
+    compress: str | None = None,
 ):
     """Build a jitted ``(xs, valid) -> (sum, count)`` collective over ``mesh``.
 
     ``xs`` has shape ``(n_devices, data)`` sharded on its first dim across all
     of ``axes``; ``valid`` is ``(n_devices,)`` (whole-payload mask) or
     ``(n_devices, n_buckets)`` (per-chunk mask). Outputs are replicated.
+
+    ``compress`` trades precision for wire bytes on bandwidth-bound syncs:
+    ``"bf16"`` runs the psum/butterfly collective in bfloat16 (or bf16 ring
+    hops), halving ICI/DCN traffic; ``"int8"`` (ring only — a summed int8
+    collective has no shared scale) quarters it with per-segment max-abs
+    scaling. Counts always stay float32, so threshold semantics are exact.
     """
     axis_names = _normalize_axes(mesh, axes)
     if set(axis_names) != set(mesh.axis_names):
@@ -229,6 +312,15 @@ def build_threshold_allreduce(
         raise ValueError("butterfly schedule needs a 2D grid mesh")
     if schedule in ("ring", "pallas_ring") and len(axis_names) != 1:
         raise ValueError("ring schedules reduce over exactly one axis")
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}")
+    if compress == "int8" and schedule != "ring":
+        raise ValueError(
+            "int8 compression needs per-hop scales: only the explicit ring "
+            "schedule carries them (psum/butterfly sum on the wire)"
+        )
+    if compress is not None and schedule == "pallas_ring":
+        raise ValueError("pallas_ring does not support compression yet")
 
     spec_in = P(axis_names if len(axis_names) > 1 else axis_names[0])
 
@@ -269,12 +361,23 @@ def build_threshold_allreduce(
                     vx, axis_names[0], n_devices, seg_rows=seg_rows
                 )
             else:
-                total = ring_allreduce_sum(vx, axis_names[0], n_devices)
+                total = ring_allreduce_sum(
+                    vx, axis_names[0], n_devices, compress=compress
+                )
             count = lax.psum(jnp.asarray(v, x.dtype), axis_names)
         elif schedule == "butterfly":
-            total, count = _staged_masked_psum(x, v, axis_names, bucket_size)
+            total, count = _staged_masked_psum(
+                x, v, axis_names, bucket_size,
+                wire_dtype=jnp.bfloat16 if compress else None,
+            )
         else:
-            total, count = masked_psum(x, v, axis_names, bucket_size=bucket_size)
+            total, count = masked_psum(
+                x,
+                v,
+                axis_names,
+                bucket_size=bucket_size,
+                wire_dtype=jnp.bfloat16 if compress else None,
+            )
         return total, expand_counts(count, data_size, bucket_size)
 
     mapped = jax.shard_map(
@@ -297,14 +400,16 @@ def threshold_allreduce(
     axes: str | Sequence[str] | None = None,
     bucket_size: int | None = None,
     schedule: str = "psum",
+    compress: str | None = None,
 ) -> AllreduceResult:
     """Convenience entry: threshold-masked allreduce of per-device payloads.
 
     ``xs``: ``(n_devices, data)`` (host or device). ``valid``: None (all
     contribute), ``(n_devices,)``, or ``(n_devices, n_buckets)``.
+    ``compress``: None | "bf16" | "int8" — see :func:`build_threshold_allreduce`.
     """
     axis_names = _normalize_axes(mesh, axes)
-    key = (mesh, axis_names, bucket_size, schedule)
+    key = (mesh, axis_names, bucket_size, schedule, compress)
     if key not in _CACHE:
         # full-mesh-axes validation happens inside the build
         _CACHE[key] = build_threshold_allreduce(
@@ -312,6 +417,7 @@ def threshold_allreduce(
             axes=axis_names,
             bucket_size=bucket_size,
             schedule=schedule,
+            compress=compress,
             # never donate here: the caller may hand us an already-correctly-
             # sharded device array that device_put returns unchanged, and the
             # convenience API must not invalidate the caller's buffer

@@ -56,12 +56,23 @@ def measure_allreduce(
     warmup: int = 2,
     logger: MetricsLogger | None = None,
     seed: int = 0,
+    compress: str | None = None,
 ) -> BandwidthReport:
-    """Time the threshold allreduce at full participation and report bus GB/s."""
+    """Time the threshold allreduce at full participation and report bus GB/s.
+
+    Bus GB/s is reported in PAYLOAD bytes (fp32) regardless of ``compress`` —
+    a compressed run moving the same payload in fewer wire bytes shows up as
+    higher payload throughput, which is the number a training step cares
+    about.
+    """
     axis_names = _normalize_axes(mesh, axes)
     n = int(np.prod([mesh.shape[a] for a in axis_names]))
     fn = build_threshold_allreduce(
-        mesh, axes=axis_names, bucket_size=bucket_size, schedule=schedule
+        mesh,
+        axes=axis_names,
+        bucket_size=bucket_size,
+        schedule=schedule,
+        compress=compress,
     )
     spec = P(axis_names if len(axis_names) > 1 else axis_names[0])
     sharding = NamedSharding(mesh, spec)

@@ -74,6 +74,10 @@ class DPTrainer:
       example_input: one device's worth of input used for ``init``.
       optimizer: optax transform (default: SGD).
       bucket_size: gradient bucket size in elements (None = single fused psum).
+      compress: None | "bf16" — sync gradients in bfloat16 on the wire
+        (halves ICI bytes on the bandwidth-bound grad allreduce; counts and
+        the optimizer state stay float32). Forces the explicit-collective
+        path (one bucket when ``bucket_size`` is None).
     """
 
     def __init__(
@@ -87,13 +91,20 @@ class DPTrainer:
         bucket_size: int | None = None,
         loss_fn: Callable | None = None,
         seed: int = 0,
+        compress: str | None = None,
     ) -> None:
+        if compress not in (None, "bf16"):
+            raise ValueError(
+                f"compress must be None or 'bf16', got {compress!r} "
+                "(int8 needs per-hop scales: use the ring schedule in comm/)"
+            )
         self.model = model
         self.mesh = mesh
         self.axis_names = tuple(mesh.axis_names)
         self.n_devices = int(np.prod([mesh.shape[a] for a in self.axis_names]))
         self.tx = optimizer or optax.sgd(learning_rate)
         self.bucket_size = bucket_size
+        self.compress = compress
         # how many independent data streams train_chain samples (one per
         # device here; the long-context trainer has one per DP replica row)
         self.data_shards = self.n_devices
@@ -118,13 +129,14 @@ class DPTrainer:
         model_apply = model.apply
         loss_impl = self._loss
         tx = self.tx
+        wire_bf16 = compress == "bf16"
 
         def step(params, opt_state, x, y, valid):
             v = valid.reshape(())
             scalar_cnt = lax.psum(v, axis_names)
             denom = jnp.maximum(scalar_cnt, 1.0)
 
-            if bucket is None:
+            if bucket is None and not wire_bf16:
                 # Differentiating the v-weighted local loss w.r.t. REPLICATED
                 # params makes JAX's shard_map autodiff insert the cross-device
                 # psum itself (the transpose of the params broadcast), so the
@@ -151,15 +163,19 @@ class DPTrainer:
 
                 loss, grads = jax.value_and_grad(local_loss)(params_local)
                 flat, unravel = ravel_pytree(grads)
-                n_buckets = -(-flat.shape[0] // bucket)
+                b = bucket if bucket is not None else flat.shape[0]
+                n_buckets = -(-flat.shape[0] // b)
+                # bf16 wire: masked_psum runs the payload collective at half
+                # width; counts stay float32 (exact at any mesh size)
                 gsum, cnt = masked_psum(
                     flat,
                     jnp.full((n_buckets,), v),
                     axis_names,
-                    bucket_size=bucket,
+                    bucket_size=b,
+                    wire_dtype=jnp.bfloat16 if wire_bf16 else None,
                 )
                 denom_el = jnp.maximum(
-                    expand_counts(cnt, flat.shape[0], bucket), 1.0
+                    expand_counts(cnt, flat.shape[0], b), 1.0
                 )
                 gavg = unravel(gsum / denom_el)
                 loss_avg = lax.psum(loss * v, axis_names) / denom
@@ -281,8 +297,9 @@ class DPTrainer:
             flat, unravel = ravel_pytree(
                 jax.tree.map(lambda g: g / accum_steps, gsum)
             )
+            wire = jnp.bfloat16 if self.compress == "bf16" else None
             if bucket is None:
-                total, cnt = masked_psum(flat, v, axis_names)
+                total, cnt = masked_psum(flat, v, axis_names, wire_dtype=wire)
                 denom_el = jnp.maximum(cnt, 1.0)
             else:
                 n_buckets = -(-flat.shape[0] // bucket)
@@ -291,6 +308,7 @@ class DPTrainer:
                     jnp.full((n_buckets,), v),
                     axis_names,
                     bucket_size=bucket,
+                    wire_dtype=wire,
                 )
                 denom_el = jnp.maximum(
                     expand_counts(cnt, flat.shape[0], bucket), 1.0

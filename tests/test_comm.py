@@ -176,3 +176,71 @@ class TestMeshHelpers:
     def test_grid_mesh_auto(self):
         m = grid_mesh(devices=jax.devices()[:8])
         assert m.shape == {"rows": 2, "cols": 4}
+
+
+class TestCompressedSchedules:
+    """Wire compression: bf16 halves / int8 quarters the bytes per hop while
+    counts (threshold semantics) stay exact float32."""
+
+    def _oracle(self, xs, valid):
+        return (xs * valid[:, None]).sum(0), valid.sum()
+
+    def test_bf16_psum_close_and_counts_exact(self, line8):
+        xs = rand(8, 513)
+        valid = np.array([1, 1, 0, 1, 1, 1, 0, 1], dtype=np.float32)
+        res = threshold_allreduce(line8, xs, valid, compress="bf16")
+        want, n = self._oracle(xs, valid)
+        scale = np.abs(want).max() + 1e-6
+        assert np.abs(np.asarray(res.sum) - want).max() / scale < 2e-2
+        assert (np.asarray(res.count) == n).all()  # counts never compressed
+
+    def test_bf16_butterfly_close(self, grid24):
+        xs = rand(8, 200)
+        res = threshold_allreduce(
+            grid24, xs, schedule="butterfly", compress="bf16"
+        )
+        want = xs.sum(0)
+        scale = np.abs(want).max() + 1e-6
+        assert np.abs(np.asarray(res.sum) - want).max() / scale < 2e-2
+
+    @pytest.mark.parametrize("mode,tol", [("bf16", 2e-2), ("int8", 8e-2)])
+    def test_compressed_ring_close_and_replicated(self, line8, mode, tol):
+        xs = rand(8, 300, seed=3)
+        valid = np.array([1, 0, 1, 1, 1, 1, 1, 1], dtype=np.float32)
+        res = threshold_allreduce(
+            line8, xs, valid, schedule="ring", compress=mode
+        )
+        want, n = self._oracle(xs, valid)
+        scale = np.abs(want).max() + 1e-6
+        assert np.abs(np.asarray(res.sum) - want).max() / scale < tol
+        assert (np.asarray(res.count) == n).all()
+
+    def test_compressed_ring_bucketed_masks(self, line8):
+        xs = rand(8, 96, seed=5)
+        valid = np.ones((8, 3), dtype=np.float32)
+        valid[2, :] = 0.0  # device 2 contributes nothing
+        valid[4, 1] = 0.0  # device 4 misses bucket 1
+        res = threshold_allreduce(
+            line8, xs, valid, bucket_size=32, schedule="ring", compress="bf16"
+        )
+        mask = np.repeat(valid, 32, axis=1)
+        want = (xs * mask).sum(0)
+        scale = np.abs(want).max() + 1e-6
+        assert np.abs(np.asarray(res.sum) - want).max() / scale < 2e-2
+        np.testing.assert_array_equal(
+            np.asarray(res.count), mask.sum(0)
+        )
+
+    def test_int8_all_zero_segment_is_safe(self, line8):
+        xs = np.zeros((8, 64), np.float32)
+        res = threshold_allreduce(line8, xs, schedule="ring", compress="int8")
+        assert np.isfinite(np.asarray(res.sum)).all()
+        np.testing.assert_array_equal(np.asarray(res.sum), 0.0)
+
+    def test_int8_requires_ring(self, line8):
+        with pytest.raises(ValueError, match="int8"):
+            threshold_allreduce(line8, rand(8, 16), compress="int8")
+
+    def test_unknown_mode_rejected(self, line8):
+        with pytest.raises(ValueError, match="compress"):
+            threshold_allreduce(line8, rand(8, 16), compress="fp4")

@@ -219,3 +219,53 @@ class TestResNet:
         ds = data.SyntheticClassification((32, 32, 3), 10, seed=2)
         hist = t.train(ds.batches(16, 2))
         assert len(hist) == 2 and np.isfinite(hist[-1].loss)
+
+
+class TestCompressedGradSync:
+    """bf16 gradient sync: collective payload halves on the wire, params stay
+    close to the f32 run, training still converges."""
+
+    def _trainer(self, mesh, seed=0, bucket=None, compress=None):
+        return DPTrainer(
+            MLP(hidden=(32,), classes=10),
+            mesh,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            learning_rate=0.1,
+            bucket_size=bucket,
+            seed=seed,
+            compress=compress,
+        )
+
+    def test_bf16_close_to_f32_and_converges(self, line8):
+        tc = self._trainer(line8, seed=2, compress="bf16")
+        tf = self._trainer(line8, seed=2)
+        ds = data.mnist_like()
+        batches = list(ds.batches(64, 10))
+        hc = tc.train(iter(batches))
+        tf.train(iter(batches))
+        # per-step grads agree to bf16 precision; after 10 steps params stay close
+        a, b = tc.get_flat_params(), tf.get_flat_params()
+        scale = np.abs(b).max()
+        assert np.abs(a - b).max() / scale < 5e-2
+        assert hc[-1].loss < hc[0].loss
+        assert hc[0].contributors == 8.0
+
+    def test_bf16_with_buckets_and_mask(self, line8):
+        t = self._trainer(line8, seed=4, bucket=1000, compress="bf16")
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(16, 1)))
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 7.0 and np.isfinite(m.loss)
+
+    def test_bf16_accum_path(self, line8):
+        t = self._trainer(line8, seed=6, compress="bf16")
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(32, 1)))
+        m = t.train_step_accum(x, y, accum_steps=2)
+        assert m.contributors == 8.0 and np.isfinite(m.loss)
+
+    def test_rejects_unknown_mode(self, line8):
+        with pytest.raises(ValueError, match="compress"):
+            self._trainer(line8, compress="int8")
