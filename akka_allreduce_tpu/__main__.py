@@ -363,6 +363,13 @@ def _cmd_train_lm(argv: list[str]) -> int:
     p.add_argument("--seq-len", type=int, default=256, help="GLOBAL sequence length")
     p.add_argument("--dp", type=int, default=None, help="data-parallel rows")
     p.add_argument("--sp", type=int, default=None, help="sequence shards")
+    p.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor-parallel shards (Megatron-style heads/hidden split "
+        "over a third mesh axis; needs --dp and --sp too)",
+    )
     p.add_argument("--impl", choices=("ring", "ulysses"), default="ring")
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
@@ -387,10 +394,15 @@ def _cmd_train_lm(argv: list[str]) -> int:
     import jax.numpy as jnp
 
     from akka_allreduce_tpu.models import data
-    from akka_allreduce_tpu.parallel import data_seq_mesh
+    from akka_allreduce_tpu.parallel import data_seq_mesh, data_seq_model_mesh
     from akka_allreduce_tpu.train import LongContextTrainer
 
-    mesh = data_seq_mesh(args.dp, args.sp)
+    if args.tp > 1:
+        if not (args.dp and args.sp):
+            p.error("--tp requires explicit --dp and --sp")
+        mesh = data_seq_model_mesh(args.dp, args.sp, args.tp)
+    else:
+        mesh = data_seq_mesh(args.dp, args.sp)
     trainer = LongContextTrainer(
         mesh,
         vocab=args.vocab,
@@ -404,7 +416,8 @@ def _cmd_train_lm(argv: list[str]) -> int:
     )
     print(
         f"LM params: {trainer.param_count / 1e6:.2f}M, mesh "
-        f"dp={trainer.dp} x sp={trainer.sp}, seq_len={args.seq_len} ({args.impl})"
+        f"dp={trainer.dp} x sp={trainer.sp} x tp={trainer.tp}, "
+        f"seq_len={args.seq_len} ({args.impl})"
     )
     ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
     # --device-data is handled inside _run_training via _run_training_chain

@@ -54,21 +54,33 @@ def rope(x: jax.Array, offset: jax.Array | int, *, base: float = 10000.0):
 
 
 class Attention(nn.Module):
-    """Causal multi-head self-attention with RoPE and SP dispatch."""
+    """Causal multi-head self-attention with RoPE, SP and TP dispatch.
+
+    Tensor parallelism (``model_axis``/``tp_size``): each shard projects and
+    attends ``n_heads / tp_size`` heads (the kernels' head dims are the
+    sharded dims), the out-projection produces a partial sum, and ONE psum
+    over ``model_axis`` completes it — Megatron-style column/row split, with
+    the output bias added AFTER the psum so it is applied exactly once.
+    """
 
     n_heads: int
     seq_axis: str | None = None
     seq_impl: str = "ring"  # "ring" | "ulysses"
     compute_dtype: jnp.dtype = jnp.float32
+    model_axis: str | None = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
         if d_model % self.n_heads:
             raise ValueError(f"{d_model=} not divisible by {self.n_heads=}")
+        if self.n_heads % self.tp_size:
+            raise ValueError(f"{self.n_heads=} not divisible by {self.tp_size=}")
         head = d_model // self.n_heads
+        heads_local = self.n_heads // self.tp_size
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.n_heads, head),
+            (heads_local, head),
             dtype=self.compute_dtype,
             name=name,
         )
@@ -92,9 +104,17 @@ class Attention(nn.Module):
             out = ulysses_attention(q, k, v, self.seq_axis, causal=True)
         else:
             raise ValueError(f"unknown seq_impl {self.seq_impl!r}")
-        return nn.DenseGeneral(
-            d_model, axis=(-2, -1), dtype=self.compute_dtype, name="out"
+        y = nn.DenseGeneral(
+            d_model,
+            axis=(-2, -1),
+            dtype=self.compute_dtype,
+            name="out",
+            use_bias=False,  # partial sum under TP; bias goes after the psum
         )(out)
+        if self.model_axis is not None:
+            y = lax.psum(y, self.model_axis)
+        bias = self.param("out_bias", nn.initializers.zeros, (d_model,))
+        return y + bias.astype(y.dtype)
 
 
 class Block(nn.Module):
@@ -103,21 +123,41 @@ class Block(nn.Module):
     seq_axis: str | None = None
     seq_impl: str = "ring"
     compute_dtype: jnp.dtype = jnp.float32
+    model_axis: str | None = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
+        hidden = self.mlp_ratio * d_model
+        if hidden % self.tp_size:
+            raise ValueError(
+                f"mlp hidden {hidden} not divisible by {self.tp_size=}"
+            )
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
         x = x + Attention(
             self.n_heads,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
             compute_dtype=self.compute_dtype,
+            model_axis=self.model_axis,
+            tp_size=self.tp_size,
         )(h)
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        h = nn.Dense(self.mlp_ratio * d_model, dtype=self.compute_dtype)(h)
+        # TP: hidden dim column-split on the up projection, row-split on the
+        # down projection; one psum completes the partial products, and the
+        # down bias lands after it (applied once)
+        h = nn.Dense(
+            hidden // self.tp_size, dtype=self.compute_dtype, name="mlp_up"
+        )(h)
         h = nn.gelu(h)
-        return x + nn.Dense(d_model, dtype=self.compute_dtype)(h)
+        y = nn.Dense(
+            d_model, dtype=self.compute_dtype, name="mlp_down", use_bias=False
+        )(h)
+        if self.model_axis is not None:
+            y = lax.psum(y, self.model_axis)
+        bias = self.param("mlp_bias", nn.initializers.zeros, (d_model,))
+        return x + y + bias.astype(y.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -131,6 +171,8 @@ class TransformerLM(nn.Module):
     seq_axis: str | None = None
     seq_impl: str = "ring"
     compute_dtype: jnp.dtype = jnp.float32
+    model_axis: str | None = None  # tensor-parallel mesh axis (None = no TP)
+    tp_size: int = 1  # shards per TP group; kernels declare LOCAL head/hidden
 
     @nn.compact
     def __call__(self, tokens):
@@ -142,7 +184,49 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 seq_impl=self.seq_impl,
                 compute_dtype=self.compute_dtype,
+                model_axis=self.model_axis,
+                tp_size=self.tp_size,
             )(x)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         logits = nn.Dense(self.vocab, dtype=self.compute_dtype)(x)
         return logits.astype(jnp.float32)
+
+
+def tp_param_specs(tree, model_axis: str):
+    """PartitionSpec pytree for Megatron-style TP over ``model_axis``.
+
+    Matches the layout the modules above declare: q/k/v kernels and biases
+    shard on the HEAD dim, the out-projection kernel on its head input dim,
+    the MLP up projection on the hidden (output) dim and the down projection
+    on the hidden (input) dim. Everything else — embeddings, norms, the
+    post-psum biases, the LM head — replicates. Apply to FULL-shape params
+    (``tp_size=1`` geometry); ``shard_map`` in_specs then deliver each shard
+    its local slice, matching the ``tp_size>1`` module's declared shapes.
+
+    Works on any tree whose leaf PATHS embed the param names — the params
+    themselves, or an optax state (adam's mu/nu mirror the param tree, so
+    the same path rules shard the optimizer moments identically; scalars
+    like adam's step count match no rule and replicate).
+    """
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        joined = "/".join(str(n) for n in names)
+        if "/q/" in joined or "/k/" in joined or "/v/" in joined:
+            if joined.endswith("kernel"):
+                return P(None, model_axis, None)
+            return P(model_axis, None)  # bias (heads, head_dim)
+        if joined.endswith("out/kernel"):
+            return P(model_axis, None, None)
+        if joined.endswith("mlp_up/kernel"):
+            return P(None, model_axis)
+        if joined.endswith("mlp_up/bias"):
+            return P(model_axis)
+        if joined.endswith("mlp_down/kernel"):
+            return P(model_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)

@@ -8,9 +8,11 @@ line of workers, a 2D ``rows``×``cols`` mesh is the butterfly grid.
 
 from akka_allreduce_tpu.parallel.mesh import (  # noqa: F401
     DATA_SEQ_AXES,
+    DATA_SEQ_MODEL_AXES,
     LINE_AXIS,
     GRID_AXES,
     data_seq_mesh,
+    data_seq_model_mesh,
     grid_factors,
     grid_mesh,
     line_mesh,

@@ -106,3 +106,22 @@ def data_seq_mesh(
     (sequence parallelism is the scarcer resource). Same shape logic as
     :func:`grid_mesh`, only the axis roles differ."""
     return grid_mesh(dp, sp, devices=devices, axes=axes)
+
+
+DATA_SEQ_MODEL_AXES = ("data", "seq", "model")
+
+
+def data_seq_model_mesh(
+    dp: int,
+    sp: int,
+    tp: int,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axes: tuple[str, str, str] = DATA_SEQ_MODEL_AXES,
+) -> Mesh:
+    """A 3D (data, seq, model) mesh: DP replicas x sequence shards x
+    Megatron-style tensor-parallel groups. ``model`` is the innermost axis —
+    TP's per-layer psums are the most latency-sensitive collectives, so its
+    groups should map to directly-wired neighbor chips."""
+    devs = _resolve_devices(dp * sp * tp, devices)
+    return jax.make_mesh((dp, sp, tp), axes, devices=devs)

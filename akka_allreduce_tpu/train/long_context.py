@@ -45,12 +45,19 @@ class LongContextStepMetrics:
 
 
 class LongContextTrainer:
-    """DP+SP trainer for a :class:`~akka_allreduce_tpu.models.TransformerLM`.
+    """DP x SP (x TP) trainer for a :class:`~akka_allreduce_tpu.models.TransformerLM`.
 
     Args:
       model_cls: the TransformerLM class (or compatible); instantiated here so
         ``seq_axis`` always matches the mesh.
-      mesh: a 2-axis (data, seq) mesh from ``parallel.data_seq_mesh``.
+      mesh: a 2-axis (data, seq) mesh from ``parallel.data_seq_mesh``, or a
+        3-axis (data, seq, model) mesh from ``parallel.data_seq_model_mesh``
+        — the third axis adds Megatron-style tensor parallelism: attention
+        heads and MLP hidden shard over it (``models.transformer.tp_param_specs``),
+        one psum per projection pair completes the partials, and gradients
+        for sharded leaves stay shard-local (shard_map's autodiff psums them
+        over data/seq only, because those leaves enter device-varying on
+        ``model``).
       seq_len: GLOBAL sequence length (divisible by the seq axis size).
       seq_impl: "ring" or "ulysses".
     """
@@ -71,17 +78,24 @@ class LongContextTrainer:
         seed: int = 0,
         compute_dtype=jnp.float32,
     ) -> None:
-        from akka_allreduce_tpu.models.transformer import TransformerLM
+        from akka_allreduce_tpu.models.transformer import (
+            TransformerLM,
+            tp_param_specs,
+        )
 
-        if len(mesh.axis_names) != 2:
+        if len(mesh.axis_names) not in (2, 3):
             raise ValueError(
-                f"need a (data, seq) mesh, got axes {mesh.axis_names}"
+                f"need a (data, seq[, model]) mesh, got axes {mesh.axis_names}"
             )
         self.mesh = mesh
-        self.data_axis, self.seq_axis = mesh.axis_names
+        self.data_axis, self.seq_axis = mesh.axis_names[:2]
+        self.model_axis = mesh.axis_names[2] if len(mesh.axis_names) == 3 else None
         self.dp = int(mesh.shape[self.data_axis])
         self.sp = int(mesh.shape[self.seq_axis])
-        self.n_devices = self.dp * self.sp
+        self.tp = (
+            int(mesh.shape[self.model_axis]) if self.model_axis else 1
+        )
+        self.n_devices = self.dp * self.sp * self.tp
         self.data_shards = self.dp  # train_chain streams: one per replica row
         if seq_len % self.sp:
             raise ValueError(f"{seq_len=} not divisible by seq shards {self.sp}")
@@ -96,11 +110,14 @@ class LongContextTrainer:
             seq_axis=self.seq_axis,
             seq_impl=seq_impl,
             compute_dtype=compute_dtype,
+            model_axis=self.model_axis if self.tp > 1 else None,
+            tp_size=self.tp,
         )
         self.tx = optimizer or optax.adam(learning_rate)
 
-        # init runs the module in single-device (dense) form: same params, the
-        # seq dispatch only changes the attention schedule, not the weights
+        # init runs the module in single-device (dense, tp=1) form: FULL param
+        # shapes. Under TP the shard_map in_specs below slice each leaf to the
+        # local geometry the tp_size>1 module declares.
         init_model = cls(
             vocab=vocab,
             d_model=d_model,
@@ -111,6 +128,30 @@ class LongContextTrainer:
         tokens0 = jnp.zeros((1, seq_len // self.sp), jnp.int32)
         self.params = init_model.init(jax.random.PRNGKey(seed), tokens0)
         self.opt_state = self.tx.init(self.params)
+        if self.tp > 1:
+            assert self.model_axis is not None
+            self._param_specs = tp_param_specs(self.params, self.model_axis)
+            self._opt_specs = tp_param_specs(self.opt_state, self.model_axis)
+        else:
+            self._param_specs = jax.tree.map(lambda _: P(), self.params)
+            self._opt_specs = jax.tree.map(lambda _: P(), self.opt_state)
+        # place state on its shardings NOW: every step can then donate the
+        # buffers in place instead of resharding (and warning) on first use
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        self.params = jax.device_put(
+            self.params,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._param_specs,
+                is_leaf=is_spec,
+            ),
+        )
+        self.opt_state = jax.device_put(
+            self.opt_state,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._opt_specs,
+                is_leaf=is_spec,
+            ),
+        )
         self.param_count = int(
             sum(np.prod(p.shape) for p in jax.tree.leaves(self.params))
         )
@@ -122,16 +163,23 @@ class LongContextTrainer:
         axis_names = tuple(mesh.axis_names)
         data_axis = self.data_axis
         seq_axis = self.seq_axis
+        vary_axes = tuple(n for n in axis_names if n != data_axis)
         model_apply = self.model.apply
         tx = self.tx
 
         def step(params, opt_state, x, y, valid):
-            # the mask arrives sharded on `data` only; mark it varying on
-            # `seq` too so the both-axes psums below are well-typed (the
+            # The mask arrives sharded on `data` only; mark it varying on the
+            # other axes too so the all-axes psums below are well-typed (the
             # contributor count keeps the data-only form so its psum over
-            # `data` is provably replicated)
+            # `data` is provably replicated). Under TP every model shard of a
+            # (data, seq) coordinate computes the identical loss term, so the
+            # all-axes denominator carries the same tp-fold factor as the
+            # all-axes loss/grad sums — the ratio (and the per-leaf psum
+            # transposes) come out exactly right at any tp.
             v0 = valid.reshape(())
-            v = lax.pcast(v0, seq_axis, to="varying")
+            v = v0
+            for ax in vary_axes:
+                v = lax.pcast(v, ax, to="varying")
             tokens_local = jnp.float32(x.shape[0] * x.shape[1])
             denom = jnp.maximum(
                 lax.psum(v * tokens_local, axis_names), 1.0
@@ -167,8 +215,14 @@ class LongContextTrainer:
         mapped = jax.shard_map(
             step,
             mesh=mesh,
-            in_specs=(P(), P(), data_spec, data_spec, P(self.data_axis)),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(
+                self._param_specs,
+                self._opt_specs,
+                data_spec,
+                data_spec,
+                P(self.data_axis),
+            ),
+            out_specs=(self._param_specs, self._opt_specs, P(), P()),
             check_vma=self._check_vma,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
@@ -254,8 +308,8 @@ class LongContextTrainer:
         mapped = jax.shard_map(
             chain,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(data_axis)),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(self._param_specs, self._opt_specs, P(), P(data_axis)),
+            out_specs=(self._param_specs, self._opt_specs, P(), P()),
             check_vma=self._check_vma,  # flash outputs carry no vma (see step)
         )
         return jax.jit(mapped, donate_argnums=(0, 1))

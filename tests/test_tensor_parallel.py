@@ -1,0 +1,98 @@
+"""Tensor parallelism (DP x SP x TP) on the 8-device virtual CPU mesh.
+
+The reference has no model parallelism at all (SURVEY.md §3: DP is its entire
+point); TP is a beyond-parity capability of the TPU rebuild. Oracle: the same
+TransformerLM trained WITHOUT TP — Megatron-style sharding is exact arithmetic
+up to float reassociation, so losses/params must match tightly, not just
+statistically.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models import data
+from akka_allreduce_tpu.parallel import data_seq_mesh, data_seq_model_mesh
+from akka_allreduce_tpu.train import LongContextTrainer
+
+KW = dict(
+    vocab=16, d_model=32, n_heads=4, n_layers=2, seq_len=32,
+    learning_rate=1e-2, seed=0,
+)
+
+
+def flat(params):
+    return np.concatenate([np.ravel(l) for l in jax.tree.leaves(params)])
+
+
+@pytest.fixture(scope="module")
+def batches():
+    ds = data.lm_copy_task(32, vocab=16)
+    return [next(ds.batches(4, 1, seed_offset=i)) for i in range(3)]
+
+
+class TestTensorParallel:
+    def test_tp_matches_non_tp(self, batches):
+        t_tp = LongContextTrainer(data_seq_model_mesh(2, 2, 2), **KW)
+        t_ref = LongContextTrainer(data_seq_mesh(2, 2), **KW)
+        assert t_tp.tp == 2 and t_ref.tp == 1
+        for x, y in batches:
+            m1 = t_tp.train_step(x, y)
+            m2 = t_ref.train_step(x, y)
+            assert abs(m1.loss - m2.loss) < 1e-4, (m1.loss, m2.loss)
+            assert m1.contributors == m2.contributors
+        d = np.abs(flat(t_tp.params) - flat(t_ref.params)).max()
+        assert d < 1e-3, d
+
+    def test_param_leaves_are_sharded_on_model_axis(self):
+        t = LongContextTrainer(data_seq_model_mesh(1, 2, 4), **KW)
+        p = t.params["params"]["Block_0"]
+        q_kernel = p["Attention_0"]["q"]["kernel"]
+        # global shape is full (4 heads); each device holds 1 head's slice
+        assert q_kernel.shape == (32, 4, 8)
+        shard = q_kernel.addressable_shards[0].data
+        assert shard.shape == (32, 1, 8)
+        up = p["mlp_up"]["kernel"]
+        assert up.shape == (32, 128)
+        assert up.addressable_shards[0].data.shape == (32, 32)
+
+    def test_tp_with_ulysses(self, batches):
+        # heads_local (4/2=2) must divide by sp (2): exactly at the boundary
+        t = LongContextTrainer(
+            data_seq_model_mesh(2, 2, 2), seq_impl="ulysses", **KW
+        )
+        m = t.train_step(*batches[0])
+        assert np.isfinite(m.loss) and m.contributors == 2.0
+
+    def test_tp_masked_replica_row(self, batches):
+        t = LongContextTrainer(data_seq_model_mesh(2, 2, 2), **KW)
+        m = t.train_step(*batches[0], valid=[1.0, 0.0])
+        assert m.contributors == 1.0 and np.isfinite(m.loss)
+
+    def test_tp_train_chain_on_device(self):
+        t = LongContextTrainer(data_seq_model_mesh(2, 2, 2), **KW)
+        sampler = data.lm_copy_task(32, vocab=16).device_sampler()
+        hist = t.train_chain(sampler, steps=4, rows_per_replica=2)
+        assert len(hist) == 4
+        assert all(np.isfinite(h.loss) for h in hist)
+        assert hist[-1].loss < hist[0].loss * 1.1  # moving, not diverging
+
+    def test_tp_convergence_copy_task(self):
+        # exactness vs the non-TP run is covered above; here: training under
+        # TP actually descends (the induction jump itself needs far more
+        # steps than a unit test should spend)
+        t = LongContextTrainer(data_seq_model_mesh(1, 2, 4), **KW)
+        ds = data.lm_copy_task(32, vocab=16)
+        losses = [t.train_step(x, y).loss for x, y in ds.batches(8, 40)]
+        assert np.mean(losses[-5:]) < losses[0] - 0.3
+
+    def test_rejects_indivisible_heads(self):
+        # surfaces either as the module's "not divisible" check or as JAX's
+        # sharding "does not evenly divide" (whichever trips first)
+        with pytest.raises(ValueError, match="divi"):
+            LongContextTrainer(
+                data_seq_model_mesh(2, 1, 4),
+                vocab=16, d_model=36, n_heads=6, n_layers=1, seq_len=16,
+            ).train_step(
+                np.zeros((2, 16), np.int32), np.zeros((2, 16), np.int32)
+            )
