@@ -720,6 +720,72 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
     return 0
 
 
+def _cmd_train_moe(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "train-moe",
+        description="Switch-MoE LM with expert parallelism: DP x EP over a "
+        "(data, expert) mesh (no analog in the reference — SURVEY.md §3)",
+    )
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--dp", type=int, default=None, help="data-parallel rows")
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel shards")
+    p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from akka_allreduce_tpu.models import data
+    from akka_allreduce_tpu.train import MoETrainer
+
+    devs = jax.devices()
+    dp = args.dp or (len(devs) // args.ep)
+    mesh = jax.make_mesh(
+        (dp, args.ep), ("data", "expert"), devices=devs[: dp * args.ep]
+    ) if args.ep > 1 else jax.make_mesh(
+        (dp,), ("data",), devices=devs[:dp]
+    )
+    trainer = MoETrainer(
+        mesh,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.heads,
+        n_layers=args.layers,
+        n_experts=args.experts,
+        seq_len=args.seq_len,
+        capacity_factor=args.capacity_factor,
+        learning_rate=args.lr,
+    )
+    print(
+        f"MoE params: {trainer.param_count / 1e6:.2f}M "
+        f"({args.experts} experts), mesh dp={trainer.dp} x ep={trainer.ep}"
+    )
+    if args.steps <= 0:
+        return 0
+    ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
+    import time
+
+    t0 = time.perf_counter()
+    hist = [
+        trainer.train_step(x, y) for x, y in ds.batches(args.batch, args.steps)
+    ]
+    dt = time.perf_counter() - t0
+    print(
+        f"moe: {args.steps} steps on {trainer.n_devices} devices in {dt:.2f}s "
+        f"({dt / args.steps * 1e3:.1f} ms/step); loss {hist[0].loss:.4f} -> "
+        f"{hist[-1].loss:.4f} (aux {hist[-1].aux_loss:.3f}, "
+        f"dropped {hist[-1].dropped:.1%})"
+    )
+    return 0
+
+
 COMMANDS = {
     "local-demo": _cmd_local_demo,
     "cluster-master": _cmd_cluster_master,
@@ -731,6 +797,7 @@ COMMANDS = {
     "train-mlp": _cmd_train_mlp,
     "train-resnet": _cmd_train_resnet,
     "train-lm": _cmd_train_lm,
+    "train-moe": _cmd_train_moe,
     "elastic-demo": _cmd_elastic_demo,
 }
 

@@ -192,6 +192,127 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+class MoEBlock(nn.Module):
+    """Transformer block whose MLP is a Switch-routed mixture of experts.
+
+    With ``expert_axis``/``ep_size`` set, each device owns
+    ``n_experts / ep_size`` experts (the w1/b1/w2 leading dims are the
+    sharded dims — see :func:`ep_param_specs`) and tokens reach their expert
+    through the all_to_all pair in ``ops.moe``. The router is replicated:
+    every device routes its own tokens over the FULL expert set.
+    Returns ``(x, aux, dropped)`` — the Switch load-balancing loss and the
+    fraction of tokens dropped past capacity ride alongside (the drop
+    fraction is the signal for tuning ``capacity_factor``).
+    """
+
+    n_heads: int
+    n_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.float32
+    expert_axis: str | None = None
+    ep_size: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        from akka_allreduce_tpu.ops.moe import moe_dispatch_compute
+
+        d_model = x.shape[-1]
+        hidden = self.mlp_ratio * d_model
+        if self.n_experts % self.ep_size:
+            raise ValueError(
+                f"{self.n_experts=} not divisible by {self.ep_size=}"
+            )
+        e_local = self.n_experts // self.ep_size
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        x = x + Attention(self.n_heads, compute_dtype=self.compute_dtype)(h)
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d_model, self.n_experts)
+        )
+        w1 = self.param(
+            "moe_w1", nn.initializers.lecun_normal(), (e_local, d_model, hidden)
+        )
+        b1 = self.param("moe_b1", nn.initializers.zeros, (e_local, hidden))
+        w2 = self.param(
+            "moe_w2", nn.initializers.lecun_normal(), (e_local, hidden, d_model)
+        )
+        flat = h.reshape(-1, d_model)
+        y, aux, dropped = moe_dispatch_compute(
+            flat,
+            router,
+            w1,
+            b1,
+            w2,
+            n_experts=self.n_experts,
+            capacity_factor=self.capacity_factor,
+            expert_axis=self.expert_axis if self.ep_size > 1 else None,
+        )
+        return x + y.reshape(x.shape), aux, dropped
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with Switch-MoE MLPs:
+    tokens -> (logits, aux_loss, dropped_fraction)."""
+
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    n_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.float32
+    expert_axis: str | None = None
+    ep_size: int = 1
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab, self.d_model, dtype=self.compute_dtype)(tokens)
+        aux_total = jnp.float32(0.0)
+        dropped_total = jnp.float32(0.0)
+        for _ in range(self.n_layers):
+            x, aux, dropped = MoEBlock(
+                self.n_heads,
+                n_experts=self.n_experts,
+                mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.capacity_factor,
+                compute_dtype=self.compute_dtype,
+                expert_axis=self.expert_axis,
+                ep_size=self.ep_size,
+            )(x)
+            aux_total = aux_total + aux
+            dropped_total = dropped_total + dropped
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        logits = nn.Dense(self.vocab, dtype=self.compute_dtype)(x)
+        return (
+            logits.astype(jnp.float32),
+            aux_total / self.n_layers,
+            dropped_total / self.n_layers,
+        )
+
+
+def ep_param_specs(tree, expert_axis: str):
+    """PartitionSpec pytree for expert parallelism: the moe_w1/b1/w2 leaves
+    shard their leading (expert) dim over ``expert_axis``; the router and
+    everything else replicate. Same path-rule mechanism as
+    :func:`tp_param_specs`, so it also shards optax moment trees."""
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        joined = "/".join(str(n) for n in names)
+        if joined.endswith("moe_w1") or joined.endswith("moe_w2"):
+            return P(expert_axis, None, None)
+        if joined.endswith("moe_b1"):
+            return P(expert_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
 def tp_param_specs(tree, model_axis: str):
     """PartitionSpec pytree for Megatron-style TP over ``model_axis``.
 

@@ -1,0 +1,126 @@
+"""Mixture-of-experts dispatch: Switch-style top-1 routing + expert-parallel
+all-to-all.
+
+The reference has no model parallelism of any kind (SURVEY.md §3 — DP is its
+entire point); MoE/EP is a beyond-parity capability of the TPU rebuild, built
+the TPU way:
+
+- **static shapes**: routing uses a fixed per-(device, expert) capacity
+  ``C = ceil(T_local * capacity_factor / n_experts)``; overflow tokens are
+  dropped (their residual path passes through untouched) — the Switch
+  Transformer discipline, which keeps every einsum MXU-shaped and lets XLA
+  compile one program regardless of routing decisions;
+- **dispatch is matmul**: tokens move into expert slots via one-hot
+  einsums, not gathers — exactly what the MXU is good at;
+- **EP = all_to_all over a mesh axis**: with experts sharded over
+  ``expert_axis`` (ep devices x E/ep experts each), one ``lax.all_to_all``
+  carries every device's per-expert slot block to the expert's owner and a
+  second one brings outputs back — the standard a2a pair riding ICI.
+
+All functions are pure and shard_map-compatible; the dense (no-EP) path is
+the oracle the EP path is tested against.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class RouteResult(NamedTuple):
+    dispatch: jax.Array  # (T, E, C) one-hot token->slot assignment
+    combine: jax.Array  # (T, E, C) dispatch scaled by the router gate
+    aux_loss: jax.Array  # scalar Switch load-balancing loss
+    dropped: jax.Array  # scalar fraction of tokens past capacity
+
+
+def switch_route(
+    logits: jax.Array, capacity: int
+) -> RouteResult:
+    """Top-1 (Switch) routing with static capacity.
+
+    ``logits``: (T, E) router scores for T tokens over E experts.
+    ``capacity``: max tokens per expert (this device's contribution).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate = probs.max(axis=-1)  # (T,)
+    idx = probs.argmax(axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's queue (0-based)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (T, E)
+    pos_t = pos.sum(axis=-1)  # (T,)
+    keep = (pos_t < capacity).astype(jnp.float32)
+    slot = jnp.minimum(pos_t, capacity - 1).astype(jnp.int32)
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(slot, capacity)[:, None, :]
+        * keep[:, None, None]
+    )  # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * sum_e f_e * P_e  (f = fraction routed, P = mean prob)
+    f = onehot.mean(axis=0)
+    p = probs.mean(axis=0)
+    aux = e * jnp.sum(f * p)
+    dropped = 1.0 - keep.mean()
+    return RouteResult(dispatch, combine, aux, dropped)
+
+
+def expert_ffn(xs: jax.Array, w1, b1, w2) -> jax.Array:
+    """Batched per-expert 2-layer MLP: (E_local, N, d) -> (E_local, N, d)."""
+    h = jnp.einsum("end,edh->enh", xs, w1) + b1[:, None, :]
+    h = jax.nn.gelu(h)
+    return jnp.einsum("enh,ehd->end", h, w2)
+
+
+def moe_dispatch_compute(
+    x: jax.Array,
+    router_w: jax.Array,
+    w1: jax.Array,
+    b1: jax.Array,
+    w2: jax.Array,
+    *,
+    n_experts: int,
+    capacity_factor: float = 1.25,
+    expert_axis: str | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Route ``x`` (T, d) through the expert MLPs; returns (y, aux, dropped).
+
+    Expert weights are LOCAL shards: ``w1`` is (E/ep, d, hidden) when
+    ``expert_axis`` names an ep-sized mesh axis (run inside shard_map), or the
+    full (E, d, hidden) dense form when ``expert_axis`` is None.
+    """
+    t = x.shape[0]
+    capacity = max(1, -(-int(t * capacity_factor) // n_experts))
+    # routing numerics (softmax/cumsum) stay float32; the heavy einsums below
+    # run in x's dtype so bf16 compute flows through the expert path
+    logits = x.astype(jnp.float32) @ router_w  # (T, E) — router always full E
+    route = switch_route(logits, capacity)
+    w1, b1, w2 = (w.astype(x.dtype) for w in (w1, b1, w2))
+    # tokens -> per-expert slots: (E, C, d)
+    slots = jnp.einsum("tec,td->ecd", route.dispatch.astype(x.dtype), x)
+    if expert_axis is None:
+        ys = expert_ffn(slots, w1, b1, w2)  # dense: all experts local
+    else:
+        ep = lax.psum(1, expert_axis)
+        e_local = n_experts // ep
+        c = slots.shape[1]
+        d = slots.shape[2]
+        # (E, C, d) -> exchange so each device holds ITS experts' slots from
+        # every peer: tiled a2a splits dim 0 into ep blocks of e_local
+        inbound = lax.all_to_all(
+            slots, expert_axis, split_axis=0, concat_axis=0, tiled=True
+        )  # (ep * e_local, C, d): block p = peer p's slots for my experts
+        inbound = inbound.reshape(ep, e_local, c, d).transpose(1, 0, 2, 3)
+        inbound = inbound.reshape(e_local, ep * c, d)
+        outbound = expert_ffn(inbound, w1, b1, w2)
+        outbound = outbound.reshape(e_local, ep, c, d).transpose(1, 0, 2, 3)
+        outbound = outbound.reshape(ep * e_local, c, d)
+        ys = lax.all_to_all(
+            outbound, expert_axis, split_axis=0, concat_axis=0, tiled=True
+        )  # back at the source device, (E, C, d)
+    y = jnp.einsum("tec,ecd->td", route.combine.astype(x.dtype), ys)
+    return y, route.aux_loss, route.dropped

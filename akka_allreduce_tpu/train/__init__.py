@@ -12,3 +12,7 @@ from akka_allreduce_tpu.train.long_context import (  # noqa: F401
     LongContextStepMetrics,
     LongContextTrainer,
 )
+from akka_allreduce_tpu.train.moe import (  # noqa: F401
+    MoEStepMetrics,
+    MoETrainer,
+)

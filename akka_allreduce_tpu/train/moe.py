@@ -1,0 +1,254 @@
+"""Expert-parallel MoE trainer: DP x EP over a (data, expert) mesh.
+
+Beyond-parity capability (the reference is DP-only, SURVEY.md §3). The dense
+non-MoE parts treat BOTH mesh axes as data parallelism — the global batch is
+sharded over data x expert jointly — while each MoE layer's all_to_all pair
+(ops/moe.py) rides the ``expert`` axis. Gradient plumbing reuses the
+framework's one mechanism: expert weights enter shard_map device-varying on
+``expert`` (ep_param_specs), so shard_map autodiff psums their grads over
+``data`` only; replicated leaves psum over both axes — the threshold-masked
+allreduce with the same contributor-mask semantics as every other trainer
+(mask per DP replica row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class MoEStepMetrics:
+    step: int
+    loss: float  # masked per-token cross-entropy (aux not included)
+    aux_loss: float  # Switch load-balancing loss (global weighted mean)
+    dropped: float  # fraction of tokens past expert capacity (capacity knob)
+    contributors: float  # contributing DP replica rows
+
+
+class MoETrainer:
+    """DP (x EP) trainer for :class:`~akka_allreduce_tpu.models.MoETransformerLM`.
+
+    Args:
+      mesh: a 1-axis (data,) mesh for dense MoE, or a 2-axis (data, expert)
+        mesh for expert parallelism (``parallel.grid_mesh`` with those axis
+        names, or any mesh whose second axis size divides ``n_experts``).
+      seq_len: per-sample sequence length (not sharded — compose with
+        LongContextTrainer's seq axis is future work).
+      aux_coef: weight of the Switch load-balancing loss.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        vocab: int = 64,
+        d_model: int = 64,
+        n_heads: int = 4,
+        n_layers: int = 2,
+        n_experts: int = 4,
+        seq_len: int = 64,
+        capacity_factor: float = 1.25,
+        aux_coef: float = 0.01,
+        optimizer: optax.GradientTransformation | None = None,
+        learning_rate: float = 1e-2,
+        seed: int = 0,
+        compute_dtype=jnp.float32,
+    ) -> None:
+        from akka_allreduce_tpu.models.transformer import (
+            MoETransformerLM,
+            ep_param_specs,
+        )
+
+        if len(mesh.axis_names) not in (1, 2):
+            raise ValueError(
+                f"need a (data[, expert]) mesh, got axes {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.data_axis = mesh.axis_names[0]
+        self.expert_axis = (
+            mesh.axis_names[1] if len(mesh.axis_names) == 2 else None
+        )
+        self.dp = int(mesh.shape[self.data_axis])
+        self.ep = int(mesh.shape[self.expert_axis]) if self.expert_axis else 1
+        if n_experts % self.ep:
+            raise ValueError(f"{n_experts=} not divisible by ep={self.ep}")
+        self.n_devices = self.dp * self.ep
+        self.data_shards = self.dp
+        self.seq_len = seq_len
+        self.vocab = vocab
+        self.aux_coef = aux_coef
+        self.model = MoETransformerLM(
+            vocab=vocab,
+            d_model=d_model,
+            n_heads=n_heads,
+            n_layers=n_layers,
+            n_experts=n_experts,
+            capacity_factor=capacity_factor,
+            compute_dtype=compute_dtype,
+            expert_axis=self.expert_axis if self.ep > 1 else None,
+            ep_size=self.ep,
+        )
+        self.tx = optimizer or optax.adam(learning_rate)
+
+        # full-shape init (ep=1 twin); shard_map in_specs slice expert leaves
+        init_model = MoETransformerLM(
+            vocab=vocab,
+            d_model=d_model,
+            n_heads=n_heads,
+            n_layers=n_layers,
+            n_experts=n_experts,
+            capacity_factor=capacity_factor,
+            compute_dtype=compute_dtype,
+        )
+        tokens0 = jnp.zeros((1, seq_len), jnp.int32)
+        self.params = init_model.init(jax.random.PRNGKey(seed), tokens0)
+        self.opt_state = self.tx.init(self.params)
+        self.param_count = int(
+            sum(np.prod(p.shape) for p in jax.tree.leaves(self.params))
+        )
+        self.step_num = 0
+
+        if self.ep > 1:
+            assert self.expert_axis is not None
+            self._param_specs = ep_param_specs(self.params, self.expert_axis)
+            self._opt_specs = ep_param_specs(self.opt_state, self.expert_axis)
+        else:
+            self._param_specs = jax.tree.map(lambda _: P(), self.params)
+            self._opt_specs = jax.tree.map(lambda _: P(), self.opt_state)
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        self.params = jax.device_put(
+            self.params,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._param_specs,
+                is_leaf=is_spec,
+            ),
+        )
+        self.opt_state = jax.device_put(
+            self.opt_state,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._opt_specs,
+                is_leaf=is_spec,
+            ),
+        )
+
+        axis_names = tuple(mesh.axis_names)
+        batch_spec = P(
+            axis_names if len(axis_names) > 1 else axis_names[0]
+        )
+        self._data_sharding = NamedSharding(mesh, batch_spec)
+        self._valid_sharding = NamedSharding(mesh, P(self.data_axis))
+        data_axis = self.data_axis
+        expert_axis = self.expert_axis
+        model_apply = self.model.apply
+        tx = self.tx
+        aux_coef = self.aux_coef
+
+        def step(params, opt_state, x, y, valid):
+            v0 = valid.reshape(())
+            v = (
+                lax.pcast(v0, expert_axis, to="varying")
+                if expert_axis is not None
+                else v0
+            )
+            tokens_local = jnp.float32(x.shape[0] * x.shape[1])
+            denom = jnp.maximum(lax.psum(v * tokens_local, axis_names), 1.0)
+
+            def masked_loss(p):
+                logits, aux, dropped = model_apply(p, x)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).sum()
+                # aux is a per-device mean: weight by local tokens so the
+                # global sum / denom is its masked token-weighted mean
+                total = (ce + aux_coef * aux * tokens_local) * v / denom
+                return total, (ce, aux, dropped)
+
+            (_, (ce, aux, dropped)), gavg = jax.value_and_grad(
+                masked_loss, has_aux=True
+            )(params)
+            loss_avg = lax.psum(ce * v / denom, axis_names)
+            aux_avg = lax.psum(aux * tokens_local * v / denom, axis_names)
+            dropped_avg = lax.psum(
+                dropped * tokens_local * v / denom, axis_names
+            )
+            contributors = lax.psum(v0, data_axis)
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return (
+                new_params, new_opt, loss_avg, aux_avg, dropped_avg,
+                contributors,
+            )
+
+        mapped = jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                self._param_specs,
+                self._opt_specs,
+                batch_spec,
+                batch_spec,
+                P(self.data_axis),
+            ),
+            out_specs=(self._param_specs, self._opt_specs, P(), P(), P(), P()),
+        )
+        self._step = jax.jit(mapped, donate_argnums=(0, 1))
+
+    # -- stepping ------------------------------------------------------------
+
+    def train_step(
+        self,
+        tokens: np.ndarray,
+        labels: np.ndarray,
+        valid: Sequence[float] | None = None,
+    ) -> MoEStepMetrics:
+        """One step on a GLOBAL (batch, seq_len) token array; batch divisible
+        by dp * ep. ``valid``: per-DP-replica-row mask of shape (dp,)."""
+        if tokens.shape[0] % self.n_devices:
+            raise ValueError(
+                f"global batch {tokens.shape[0]} not divisible by "
+                f"{self.n_devices} devices"
+            )
+        if tokens.shape[1] != self.seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} != {self.seq_len}"
+            )
+        if valid is None:
+            valid_arr = np.ones((self.dp,), np.float32)
+        else:
+            valid_arr = np.asarray(valid, np.float32)
+            if valid_arr.shape != (self.dp,):
+                raise ValueError(
+                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
+                )
+        xd = jax.device_put(np.asarray(tokens, np.int32), self._data_sharding)
+        yd = jax.device_put(np.asarray(labels, np.int32), self._data_sharding)
+        vd = jax.device_put(valid_arr, self._valid_sharding)
+        self.params, self.opt_state, loss, aux, dropped, cnt = self._step(
+            self.params, self.opt_state, xd, yd, vd
+        )
+        self.step_num += 1
+        return MoEStepMetrics(
+            step=self.step_num,
+            loss=float(loss),
+            aux_loss=float(aux),
+            dropped=float(dropped),
+            contributors=float(cnt),
+        )
+
+    def train(self, batches: Iterable) -> list[MoEStepMetrics]:
+        return [self.train_step(x, y) for x, y in batches]
+
+    def get_flat_params(self) -> np.ndarray:
+        from jax.flatten_util import ravel_pytree
+
+        flat, _ = ravel_pytree(jax.device_get(self.params))
+        return np.asarray(flat, np.float32)
