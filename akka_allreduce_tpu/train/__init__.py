@@ -16,3 +16,7 @@ from akka_allreduce_tpu.train.moe import (  # noqa: F401
     MoEStepMetrics,
     MoETrainer,
 )
+from akka_allreduce_tpu.train.pipeline import (  # noqa: F401
+    PipelineLMTrainer,
+    PipelineStepMetrics,
+)

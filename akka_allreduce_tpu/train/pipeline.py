@@ -1,0 +1,310 @@
+"""Pipeline-parallel Transformer training: DP x PP over a (data, pipe) mesh.
+
+Beyond-parity capability (the reference is DP-only, SURVEY.md §3) and the
+last of the classic strategies (DP/SP/TP/EP/ZeRO elsewhere in train/). Built
+the TPU way — no host scheduler, no per-stage processes: the WHOLE pipeline
+is one jitted SPMD program.
+
+- The transformer trunk's L layers stack into one params tree with a leading
+  layer dim, sharded ``P('pipe')``: each of the S stages holds L/S layers and
+  runs them with a local ``lax.scan``.
+- GPipe-style execution is a second ``lax.scan`` over ``M + S - 1`` ticks:
+  every tick each stage applies its layers and hands its activation to the
+  next stage with ONE ``ppermute`` hop over the ``pipe`` axis (neighbor
+  traffic on the ICI torus). Stage 0 injects a fresh microbatch per tick;
+  the last stage peels off finished microbatches and accumulates the loss.
+  The (S-1)/(M+S-1) bubble is the standard GPipe trade.
+- Autodiff differentiates straight through both scans: the reverse pass IS
+  backward pipelining (cotangents ride the reverse ppermute), trunk
+  gradients stay stage-local (the leaves enter shard_map device-varying on
+  ``pipe``), and the replicated embed/head gradients are completed by the
+  same transpose-psum mechanism as every other trainer here.
+- Threshold masking: the contributor mask is per DP replica row, exactly as
+  in DPTrainer/LongContextTrainer — a dropped row zeroes its contribution
+  while the collective completes.
+
+Numerics are EXACT vs the unpipelined model (microbatching only reorders the
+same sums), which is what the tests assert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class PipelineStepMetrics:
+    step: int
+    loss: float  # masked per-token cross-entropy
+    contributors: float  # contributing DP replica rows
+
+
+class _LMHead(nn.Module):
+    vocab: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        return nn.Dense(self.vocab, dtype=self.compute_dtype)(x).astype(
+            jnp.float32
+        )
+
+
+class PipelineLMTrainer:
+    """DP x PP trainer for a decoder-only Transformer LM.
+
+    Args:
+      mesh: a (data, pipe) 2-axis mesh (``pipe`` may be 1 = no pipelining,
+        which is also the oracle the tests compare against).
+      layers_per_stage: trunk depth per pipeline stage (total layers =
+        layers_per_stage * pipe).
+      microbatches: GPipe microbatches per step; the per-device batch must
+        divide by it. More microbatches = smaller bubble, smaller matmuls.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        vocab: int = 64,
+        d_model: int = 64,
+        n_heads: int = 4,
+        layers_per_stage: int = 1,
+        microbatches: int = 2,
+        seq_len: int = 64,
+        optimizer: optax.GradientTransformation | None = None,
+        learning_rate: float = 1e-2,
+        seed: int = 0,
+        compute_dtype=jnp.float32,
+    ) -> None:
+        from akka_allreduce_tpu.models.transformer import Block
+
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                f"need a (data, pipe) mesh, got axes {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.data_axis, self.pipe_axis = mesh.axis_names
+        self.dp = int(mesh.shape[self.data_axis])
+        self.stages = int(mesh.shape[self.pipe_axis])
+        self.n_devices = self.dp * self.stages
+        self.microbatches = microbatches
+        self.seq_len = seq_len
+        self.vocab = vocab
+        self.n_layers = layers_per_stage * self.stages
+        self.tx = optimizer or optax.adam(learning_rate)
+
+        block = Block(n_heads=n_heads, compute_dtype=compute_dtype)
+        embed = nn.Embed(vocab, d_model, dtype=compute_dtype)
+        head = _LMHead(vocab, compute_dtype=compute_dtype)
+        rng = jax.random.PRNGKey(seed)
+        x0 = jnp.zeros((1, seq_len, d_model), jnp.float32)
+        tok0 = jnp.zeros((1, seq_len), jnp.int32)
+        layer_ps = [
+            block.init(jax.random.fold_in(rng, 1000 + i), x0)["params"]
+            for i in range(self.n_layers)
+        ]
+        # stack to (L, ...) leaves: ONE trunk tree, layer dim sharded on pipe
+        trunk = jax.tree.map(lambda *ls: jnp.stack(ls), *layer_ps)
+        self.params = {
+            "embed": embed.init(jax.random.fold_in(rng, 1), tok0)["params"],
+            "trunk": trunk,
+            "head": head.init(jax.random.fold_in(rng, 2), x0)["params"],
+        }
+        self.opt_state = self.tx.init(self.params)
+        self.param_count = int(
+            sum(np.prod(p.shape) for p in jax.tree.leaves(self.params))
+        )
+        self.step_num = 0
+
+        # one rule for params AND optax moments: any leaf whose path passes
+        # through 'trunk' shards its leading (layer) dim on the pipe axis
+        def stage_spec(path, leaf):
+            names = [
+                str(getattr(k, "key", getattr(k, "name", k))) for k in path
+            ]
+            if "trunk" in names:
+                return P(*([self.pipe_axis] + [None] * (leaf.ndim - 1)))
+            return P()
+
+        self._param_specs = jax.tree_util.tree_map_with_path(
+            stage_spec, self.params
+        )
+        self._opt_specs = jax.tree_util.tree_map_with_path(
+            stage_spec, self.opt_state
+        )
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        self.params = jax.device_put(
+            self.params,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._param_specs,
+                is_leaf=is_spec,
+            ),
+        )
+        self.opt_state = jax.device_put(
+            self.opt_state,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._opt_specs,
+                is_leaf=is_spec,
+            ),
+        )
+
+        axis_names = tuple(mesh.axis_names)
+        data_axis, pipe_axis = self.data_axis, self.pipe_axis
+        s_count = self.stages
+        m_count = microbatches
+        tx = self.tx
+        block_apply = block.apply
+        embed_apply = embed.apply
+        head_apply = head.apply
+
+        def run_stage(trunk_local, h):
+            """Apply this stage's layers_per_stage blocks sequentially."""
+
+            def body(carry, layer_p):
+                return block_apply({"params": layer_p}, carry), None
+
+            out, _ = lax.scan(body, h, trunk_local)
+            return out
+
+        fwd = [(i, (i + 1) % s_count) for i in range(s_count)]
+
+        def step(params, opt_state, x, y, valid):
+            s = lax.axis_index(pipe_axis)
+            v0 = valid.reshape(())
+            v = lax.pcast(v0, pipe_axis, to="varying")
+            b_local, t_len = x.shape
+            if b_local % m_count:
+                raise ValueError(
+                    f"per-device batch {b_local} not divisible by "
+                    f"{m_count} microbatches"
+                )
+            mb = b_local // m_count
+            tokens_local = jnp.float32(b_local * t_len)
+            is_last = (s == s_count - 1).astype(jnp.float32)
+            # only the last stage carries loss tokens; no double counting
+            denom = jnp.maximum(
+                lax.psum(v * tokens_local * is_last, axis_names), 1.0
+            )
+
+            def masked_loss(p):
+                xe = embed_apply({"params": p["embed"]}, x)
+                micro = xe.reshape(m_count, mb, t_len, -1)
+                labels = y.reshape(m_count, mb, t_len)
+
+                def tick(carry, t):
+                    received = carry
+                    # stage 0 injects microbatch t (clamped; ticks past M
+                    # feed garbage that exits after the loop ends)
+                    inj = lax.dynamic_index_in_dim(
+                        micro, jnp.clip(t, 0, m_count - 1), 0, keepdims=False
+                    )
+                    inp = jnp.where(s == 0, inj, received)
+                    out = run_stage(p["trunk"], inp)
+                    nxt = lax.ppermute(out, pipe_axis, fwd)
+                    # last stage peels microbatch m = t - (S-1) when it is real
+                    m = t - (s_count - 1)
+                    logits = head_apply({"params": p["head"]}, out)
+                    lbl = lax.dynamic_index_in_dim(
+                        labels, jnp.clip(m, 0, m_count - 1), 0, keepdims=False
+                    )
+                    ce = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, lbl
+                    ).sum()
+                    take = ((s == s_count - 1) & (m >= 0)).astype(jnp.float32)
+                    return nxt, ce * take
+
+                zero = jnp.zeros((mb, t_len, xe.shape[-1]), xe.dtype)
+                # the carry becomes device-varying after its first ppermute
+                # hop; the initial value must carry the same vma type
+                zero = lax.pcast(zero, axis_names, to="varying")
+                _, ces = lax.scan(
+                    tick, zero, jnp.arange(m_count + s_count - 1)
+                )
+                ce_total = ces.sum()
+                return ce_total * v / denom, ce_total
+
+            (_, ce_total), gavg = jax.value_and_grad(
+                masked_loss, has_aux=True
+            )(params)
+            loss_avg = lax.psum(ce_total * v * is_last / denom, axis_names)
+            contributors = lax.psum(v0, data_axis)
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, loss_avg, contributors
+
+        batch_spec = P(self.data_axis)
+        self._data_sharding = NamedSharding(mesh, batch_spec)
+        self._valid_sharding = NamedSharding(mesh, P(self.data_axis))
+        mapped = jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                self._param_specs,
+                self._opt_specs,
+                batch_spec,
+                batch_spec,
+                P(self.data_axis),
+            ),
+            out_specs=(self._param_specs, self._opt_specs, P(), P()),
+        )
+        self._step = jax.jit(mapped, donate_argnums=(0, 1))
+
+    # -- stepping ------------------------------------------------------------
+
+    def train_step(
+        self,
+        tokens: np.ndarray,
+        labels: np.ndarray,
+        valid: Sequence[float] | None = None,
+    ) -> PipelineStepMetrics:
+        """One step on a GLOBAL (batch, seq_len) token array; batch divisible
+        by dp * microbatches."""
+        per_step = self.dp * self.microbatches
+        if tokens.shape[0] % per_step:
+            raise ValueError(
+                f"global batch {tokens.shape[0]} not divisible by "
+                f"dp*microbatches={per_step}"
+            )
+        if tokens.shape[1] != self.seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} != {self.seq_len}"
+            )
+        if valid is None:
+            valid_arr = np.ones((self.dp,), np.float32)
+        else:
+            valid_arr = np.asarray(valid, np.float32)
+            if valid_arr.shape != (self.dp,):
+                raise ValueError(
+                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
+                )
+        xd = jax.device_put(np.asarray(tokens, np.int32), self._data_sharding)
+        yd = jax.device_put(np.asarray(labels, np.int32), self._data_sharding)
+        vd = jax.device_put(valid_arr, self._valid_sharding)
+        self.params, self.opt_state, loss, cnt = self._step(
+            self.params, self.opt_state, xd, yd, vd
+        )
+        self.step_num += 1
+        return PipelineStepMetrics(
+            step=self.step_num, loss=float(loss), contributors=float(cnt)
+        )
+
+    def train(self, batches) -> list[PipelineStepMetrics]:
+        return [self.train_step(x, y) for x, y in batches]
+
+    def get_flat_params(self) -> np.ndarray:
+        from jax.flatten_util import ravel_pytree
+
+        flat, _ = ravel_pytree(jax.device_get(self.params))
+        return np.asarray(flat, np.float32)
