@@ -387,9 +387,9 @@ def _cmd_train_lm(argv: list[str]) -> int:
         help="bfloat16 activations/matmuls (params and logits stay fp32) — "
         "the MXU-native dtype",
     )
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
     args = p.parse_args(argv)
-    args.checkpoint_dir = None
-    args.checkpoint_every = 0
 
     import jax.numpy as jnp
 

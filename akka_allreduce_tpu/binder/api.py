@@ -42,8 +42,11 @@ def flatten_pytree(tree) -> tuple[np.ndarray, Callable]:
     grad-sync path this host hop never happens (psum in-step); the flat form is
     for the host engine / elastic mode / checkpoints.
     """
-    flat, unravel = ravel_pytree(tree)
-    host = np.asarray(jax.device_get(flat), dtype=np.float32)
+    # fetch BEFORE raveling: raveling on device would reshape across sharded
+    # dims, which explicit-sharding meshes (TP/EP/PP param trees) reject
+    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    flat, unravel = ravel_pytree(host_tree)
+    host = np.asarray(flat, dtype=np.float32)
 
     def unflatten(vec: np.ndarray):
         return unravel(vec.astype(np.float32))

@@ -18,6 +18,59 @@ from typing import Any
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def _state_shardings(trainer) -> tuple[Any, Any]:
+    """(param_shardings, opt_shardings) for placing restored state.
+
+    Sharded trainers (TP / EP / PP — anything exposing ``_param_specs`` /
+    ``_opt_specs`` PartitionSpec trees) get per-leaf NamedShardings over
+    their CURRENT mesh; plain DP trainers fall back to the replicated
+    sharding. Either way, restore works across a re-mesh: leaves are placed
+    fresh onto whatever mesh the trainer has now.
+    """
+    mesh = getattr(trainer, "mesh", None)
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+
+    def tree_of(specs):
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs, is_leaf=is_spec
+        )
+
+    pspecs = getattr(trainer, "_param_specs", None)
+    ospecs = getattr(trainer, "_opt_specs", None)
+    p_sh = (
+        tree_of(pspecs)
+        if mesh is not None and pspecs is not None
+        else trainer._replicated
+    )
+    o_sh = (
+        tree_of(ospecs)
+        if mesh is not None and ospecs is not None
+        else trainer._replicated
+    )
+    return p_sh, o_sh
+
+
+def _place(tree, sharding) -> Any:
+    """Device-put every array leaf of ``tree`` onto ``sharding`` (a single
+    sharding for all leaves, or a matching tree of per-leaf shardings).
+
+    jax.Array leaves reshard on device (a no-op when already placed — the
+    Orbax restore target usually carries the right sharding, so no
+    full-model host round trip); numpy leaves (Snapshot data) upload.
+    """
+
+    def put(x, s):
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return jax.device_put(x, s)
+        return x
+
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree.map(lambda x: put(x, sharding), tree)
+    return jax.tree.map(put, tree, sharding)
 
 
 @dataclasses.dataclass
@@ -43,12 +96,11 @@ class Snapshot:
         )
 
     def restore_into(self, trainer) -> None:
-        """Place this snapshot into ``trainer`` (replicated over its mesh)."""
-        put = lambda t: jax.tree.map(
-            lambda x: jax.device_put(x, trainer._replicated), t
-        )
-        trainer.params = put(self.params)
-        trainer.opt_state = put(self.opt_state)
+        """Place this snapshot into ``trainer``, honoring its sharding layout
+        (replicated for plain DP; per-leaf specs for TP/EP/PP trainers)."""
+        p_sh, o_sh = _state_shardings(trainer)
+        trainer.params = _place(self.params, p_sh)
+        trainer.opt_state = _place(self.opt_state, o_sh)
         trainer.step_num = self.step
 
 
@@ -103,17 +155,13 @@ class TrainerCheckpointer:
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(target)
         )
-        # Orbax may hand back single-device arrays; re-replicate over the
-        # trainer's current mesh (this is also what makes restore-into-a-
-        # different-mesh work after an elastic re-mesh).
-        put = lambda t: jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x), trainer._replicated)
-            if isinstance(x, (jax.Array, np.ndarray))
-            else x,
-            t,
-        )
-        trainer.params = put(restored["params"])
-        trainer.opt_state = put(restored["opt_state"])
+        # Orbax may hand back single-device arrays; re-place onto the
+        # trainer's CURRENT layout — replicated for plain DP, per-leaf
+        # shardings for TP/EP/PP trainers (this is also what makes
+        # restore-into-a-different-mesh work after an elastic re-mesh).
+        p_sh, o_sh = _state_shardings(trainer)
+        trainer.params = _place(restored["params"], p_sh)
+        trainer.opt_state = _place(restored["opt_state"], o_sh)
         trainer.step_num = int(restored["step"])
         return trainer.step_num
 

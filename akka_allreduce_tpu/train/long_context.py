@@ -277,6 +277,11 @@ class LongContextTrainer:
     def train(self, batches: Iterable) -> list[LongContextStepMetrics]:
         return [self.train_step(x, y) for x, y in batches]
 
+    def get_flat_params(self) -> np.ndarray:
+        from akka_allreduce_tpu.binder.api import flatten_pytree
+
+        return flatten_pytree(self.params)[0]
+
     # -- on-device training chain (data-loader path, no host I/O per step) ---
 
     def _build_chain(self, sampler, steps: int, rows_per_replica: int):

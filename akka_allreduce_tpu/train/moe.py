@@ -248,7 +248,6 @@ class MoETrainer:
         return [self.train_step(x, y) for x, y in batches]
 
     def get_flat_params(self) -> np.ndarray:
-        from jax.flatten_util import ravel_pytree
+        from akka_allreduce_tpu.binder.api import flatten_pytree
 
-        flat, _ = ravel_pytree(jax.device_get(self.params))
-        return np.asarray(flat, np.float32)
+        return flatten_pytree(self.params)[0]
