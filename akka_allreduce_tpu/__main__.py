@@ -387,6 +387,13 @@ def _cmd_train_lm(argv: list[str]) -> int:
         help="bfloat16 activations/matmuls (params and logits stay fp32) — "
         "the MXU-native dtype",
     )
+    p.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialize each block on backward (jax.checkpoint): "
+        "O(layers) activation memory for one extra forward of FLOPs — "
+        "the long-sequence memory knob",
+    )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     args = p.parse_args(argv)
@@ -413,6 +420,7 @@ def _cmd_train_lm(argv: list[str]) -> int:
         seq_impl=args.impl,
         learning_rate=args.lr,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        remat=args.remat,
     )
     print(
         f"LM params: {trainer.param_count / 1e6:.2f}M, mesh "

@@ -173,12 +173,20 @@ class TransformerLM(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     model_axis: str | None = None  # tensor-parallel mesh axis (None = no TP)
     tp_size: int = 1  # shards per TP group; kernels declare LOCAL head/hidden
+    # rematerialize each block on the backward pass (jax.checkpoint): trades
+    # one extra forward of FLOPs for O(layers) activation memory — the knob
+    # that lets long sequences fit in HBM
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
         x = nn.Embed(self.vocab, self.d_model, dtype=self.compute_dtype)(tokens)
-        for _ in range(self.n_layers):
-            x = Block(
+        block_cls = nn.remat(Block) if self.remat else Block
+        for i in range(self.n_layers):
+            # explicit names: nn.remat would otherwise rename the scope to
+            # CheckpointBlock_i, forking the param tree from the non-remat
+            # (and init-twin) layout — remat must change memory, not params
+            x = block_cls(
                 self.n_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis,
@@ -186,6 +194,7 @@ class TransformerLM(nn.Module):
                 compute_dtype=self.compute_dtype,
                 model_axis=self.model_axis,
                 tp_size=self.tp_size,
+                name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         logits = nn.Dense(self.vocab, dtype=self.compute_dtype)(x)

@@ -77,6 +77,7 @@ class LongContextTrainer:
         learning_rate: float = 0.1,
         seed: int = 0,
         compute_dtype=jnp.float32,
+        remat: bool = False,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import (
             TransformerLM,
@@ -112,6 +113,7 @@ class LongContextTrainer:
             compute_dtype=compute_dtype,
             model_axis=self.model_axis if self.tp > 1 else None,
             tp_size=self.tp,
+            remat=remat,
         )
         self.tx = optimizer or optax.adam(learning_rate)
 
