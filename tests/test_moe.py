@@ -94,3 +94,16 @@ class TestExpertParallel:
                 vocab=16, d_model=32, n_heads=4, n_layers=1, n_experts=6,
                 seq_len=16,
             )
+
+
+class TestMoEDtypes:
+    def test_bf16_compute_flows_through_expert_path(self):
+        import jax.numpy as jnp
+
+        t = MoETrainer(
+            mesh((2, 4), ("data", "expert")), compute_dtype=jnp.bfloat16, **KW
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(8, 1))
+        m = t.train_step(x, y)
+        assert np.isfinite(m.loss) and m.contributors == 2.0

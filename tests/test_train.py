@@ -269,3 +269,22 @@ class TestCompressedGradSync:
     def test_rejects_unknown_mode(self, line8):
         with pytest.raises(ValueError, match="compress"):
             self._trainer(line8, compress="int8")
+
+
+def test_compress_bucketed_accum_masked_combo(line8):
+    """bf16 wire x bucketed grads x gradient accumulation x dropped replica —
+    the full stack of DPTrainer options in one step."""
+    t = DPTrainer(
+        MLP(hidden=(32,), classes=10),
+        line8,
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        learning_rate=0.1,
+        bucket_size=1000,
+        compress="bf16",
+    )
+    ds = data.mnist_like()
+    x, y = next(iter(ds.batches(32, 1)))
+    valid = np.ones(8, np.float32)
+    valid[5] = 0.0
+    m = t.train_step_accum(x, y, accum_steps=2, valid=valid)
+    assert m.contributors == 7.0 and np.isfinite(m.loss)
