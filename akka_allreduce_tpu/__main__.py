@@ -587,25 +587,73 @@ def _mlp_trainer(hidden, lr, seed=0):
     )
 
 
+def _cluster_model_flags(p) -> None:
+    """Model-selection flags shared by the train-cluster master and nodes —
+    every process must be started with the SAME model flags (the master
+    derives the cluster's data_size from them)."""
+    p.add_argument(
+        "--model", choices=("mlp", "lm"), default="mlp",
+        help="mlp = MLP/MNIST (reference workload); lm = Transformer LM",
+    )
+    p.add_argument("--hidden", type=int, nargs="+", default=[32])
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+
+
+def _cluster_trainer(args, lr: float, seed: int = 17):
+    """The node-local learner for the distributed cluster, per --model."""
+    if args.model == "lm":
+        import jax
+
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+        from akka_allreduce_tpu.train import LongContextTrainer
+
+        return LongContextTrainer(
+            data_seq_mesh(1, 1, devices=jax.devices()[:1]),
+            vocab=args.vocab,
+            d_model=args.d_model,
+            n_heads=args.heads,
+            n_layers=args.layers,
+            seq_len=args.seq_len,
+            learning_rate=lr,
+            seed=seed,
+        )
+    return _mlp_trainer(args.hidden, lr, seed=seed)
+
+
+def _cluster_batches(args, data_seed: int):
+    from akka_allreduce_tpu.models import data
+
+    if args.model == "lm":
+        ds = data.lm_copy_task(args.seq_len, vocab=args.vocab, seed=data_seed)
+        return iter(ds.batches(args.batch, args.steps))
+    return iter(
+        data.mnist_like(seed=data_seed).batches(args.batch, args.steps)
+    )
+
+
 def _cmd_train_cluster_master(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         "train-cluster-master",
-        description="master for distributed elastic-averaging MLP training "
+        description="master for distributed elastic-averaging training "
         "(the reference's multi-JVM training deployment, SURVEY.md §4.4); "
         "data_size is derived from the model so start nodes with the SAME "
-        "--hidden flags",
+        "model flags",
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--nodes", type=int, default=2)
-    p.add_argument("--hidden", type=int, nargs="+", default=[32])
+    _cluster_model_flags(p)
     p.add_argument("--rounds", type=int, default=30, help="-1 = run forever")
     p.add_argument("--chunk", type=int, default=65536)
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=0.5, help="interval (s)")
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
     args = p.parse_args(argv)
-    args.size = _mlp_trainer(args.hidden, 0.1).param_count
+    args.size = _cluster_trainer(args, 0.1).param_count
     print(f"model: {args.size} params -> data_size {args.size}", flush=True)
     args.dims = 1
     return _run_cluster_master(args)
@@ -614,14 +662,14 @@ def _cmd_train_cluster_master(argv: list[str]) -> int:
 def _cmd_train_cluster_node(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         "train-cluster-node",
-        description="training node: local MLP SGD on its own data shard + "
+        description="training node: local SGD on its own data shard + "
         "asynchronous elastic-averaging weight sync over the cluster",
     )
     p.add_argument("--seed", required=True, help="master host:port")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--node-id", type=int, default=-1, help="-1 = master assigns")
-    p.add_argument("--hidden", type=int, nargs="+", default=[32])
+    _cluster_model_flags(p)
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--lr", type=float, default=0.1)
@@ -633,18 +681,16 @@ def _cmd_train_cluster_node(argv: list[str]) -> int:
     import asyncio
 
     from akka_allreduce_tpu.control.cluster import Endpoint
-    from akka_allreduce_tpu.models import data
     from akka_allreduce_tpu.train import ElasticClusterNode
 
     async def run() -> int:
-        trainer = _mlp_trainer(args.hidden, args.lr, seed=17)
-        ds = data.mnist_like(
-            seed=args.data_seed if args.data_seed is not None else 0
-        )
+        trainer = _cluster_trainer(args, args.lr, seed=17)
         node = ElasticClusterNode(
             Endpoint.parse(args.seed),
             trainer,
-            iter(ds.batches(args.batch, args.steps)),
+            _cluster_batches(
+                args, args.data_seed if args.data_seed is not None else 0
+            ),
             elastic_rate=args.elastic_rate,
             host=args.host,
             port=args.port,

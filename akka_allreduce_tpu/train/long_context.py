@@ -284,6 +284,24 @@ class LongContextTrainer:
 
         return flatten_pytree(self.params)[0]
 
+    def set_flat_params(self, vec: np.ndarray) -> None:
+        """Replace params from a flat float32 vector (binder/cluster seam),
+        honoring the trainer's sharding layout (replicated or TP specs)."""
+        from akka_allreduce_tpu.binder.api import flatten_pytree
+        from akka_allreduce_tpu.train.checkpoint import (
+            _place,
+            _state_shardings,
+        )
+
+        # the tree structure never changes after __init__: build the
+        # unflattener once, not one full device_get per sync round
+        if getattr(self, "_unflatten", None) is None:
+            _, self._unflatten = flatten_pytree(self.params)
+        p_sh, _ = _state_shardings(self)
+        self.params = _place(
+            self._unflatten(np.asarray(vec, np.float32)), p_sh
+        )
+
     # -- on-device training chain (data-loader path, no host I/O per step) ---
 
     def _build_chain(self, sampler, steps: int, rows_per_replica: int):

@@ -168,3 +168,64 @@ def test_elastic_cluster_node_rejects_size_mismatch():
             await master.stop()
 
     asyncio.run(run())
+
+
+def test_elastic_cluster_trains_transformer_lm():
+    """The distributed deployment is model-agnostic: two LongContextTrainer
+    learners (Transformer LM) sync weights through the same elastic binder
+    over real loopback TCP."""
+    from akka_allreduce_tpu.parallel import data_seq_mesh
+    from akka_allreduce_tpu.train import LongContextTrainer
+
+    def lm_trainer(seed):
+        import jax
+
+        return LongContextTrainer(
+            data_seq_mesh(1, 1, devices=jax.devices()[:1]),
+            vocab=16, d_model=32, n_heads=4, n_layers=1, seq_len=32,
+            learning_rate=1e-2, seed=seed,
+        )
+
+    async def run():
+        t0, t1 = lm_trainer(1), lm_trainer(2)
+        gap_before = float(
+            np.linalg.norm(t0.get_flat_params() - t1.get_flat_params())
+        )
+        cfg = AllreduceConfig(
+            threshold=ThresholdConfig(1.0, 1.0, 1.0),
+            metadata=MetaDataConfig(
+                data_size=t0.param_count, max_chunk_size=4096
+            ),
+            line_master=LineMasterConfig(round_window=2, max_rounds=60),
+            master=MasterConfig(
+                node_num=2, dimensions=1, heartbeat_interval_s=0.05
+            ),
+        )
+        master = MasterProcess(cfg, port=0)
+        seed_ep = await master.start()
+        nodes = [
+            ElasticClusterNode(
+                seed_ep,
+                trainer,
+                iter(data.lm_copy_task(32, vocab=16, seed=i).batches(8, 15)),
+                elastic_rate=0.5,
+                preferred_node_id=i,
+            )
+            for i, trainer in enumerate([t0, t1])
+        ]
+        try:
+            steps = await asyncio.wait_for(
+                asyncio.gather(*(n.run(15) for n in nodes)), timeout=120.0
+            )
+        finally:
+            await master.stop()
+        assert steps == [15, 15]
+        for n in nodes:
+            assert n.rounds_applied >= 3, n.rounds_applied
+            assert np.mean(n.losses[-3:]) < n.losses[0]
+        gap_after = float(
+            np.linalg.norm(t0.get_flat_params() - t1.get_flat_params())
+        )
+        assert gap_after < gap_before, (gap_before, gap_after)
+
+    asyncio.run(run())
