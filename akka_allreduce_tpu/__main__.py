@@ -792,6 +792,12 @@ def _cmd_train_moe(argv: list[str]) -> int:
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--layers", type=int, default=2)
+    p.add_argument(
+        "--device-data",
+        action="store_true",
+        help="sample batches ON DEVICE inside one jitted chain (no host "
+        "I/O per step)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -827,15 +833,29 @@ def _cmd_train_moe(argv: list[str]) -> int:
     import time
 
     t0 = time.perf_counter()
-    hist = [
-        trainer.train_step(x, y) for x, y in ds.batches(args.batch, args.steps)
-    ]
+    if args.device_data:
+        rows = max(1, args.batch // trainer.n_devices)
+        eff_batch = rows * trainer.n_devices
+        if eff_batch != args.batch:
+            print(
+                f"--device-data: global batch rounded {args.batch} -> "
+                f"{eff_batch} ({rows} rows/device)"
+            )
+        hist = trainer.train_chain(
+            ds.device_sampler(), args.steps, rows_per_device=rows
+        )
+    else:
+        hist = [
+            trainer.train_step(x, y)
+            for x, y in ds.batches(args.batch, args.steps)
+        ]
     dt = time.perf_counter() - t0
+    mode = "on-device " if args.device_data else ""
     print(
-        f"moe: {args.steps} steps on {trainer.n_devices} devices in {dt:.2f}s "
-        f"({dt / args.steps * 1e3:.1f} ms/step); loss {hist[0].loss:.4f} -> "
-        f"{hist[-1].loss:.4f} (aux {hist[-1].aux_loss:.3f}, "
-        f"dropped {hist[-1].dropped:.1%})"
+        f"moe: {args.steps} {mode}steps on {trainer.n_devices} devices in "
+        f"{dt:.2f}s ({dt / args.steps * 1e3:.1f} ms/step); "
+        f"loss {hist[0].loss:.4f} -> {hist[-1].loss:.4f} "
+        f"(aux {hist[-1].aux_loss:.3f}, dropped {hist[-1].dropped:.1%})"
     )
     return 0
 
@@ -858,6 +878,12 @@ def _cmd_train_pp(argv: list[str]) -> int:
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument(
+        "--device-data",
+        action="store_true",
+        help="sample batches ON DEVICE inside one jitted chain (no host "
+        "I/O per step)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -891,14 +917,30 @@ def _cmd_train_pp(argv: list[str]) -> int:
     import time
 
     t0 = time.perf_counter()
-    hist = [
-        trainer.train_step(x, y) for x, y in ds.batches(args.batch, args.steps)
-    ]
+    if args.device_data:
+        # round rows per replica UP to a whole number of microbatches
+        rows = max(1, args.batch // trainer.dp)
+        rows = -(-rows // args.microbatches) * args.microbatches
+        eff_batch = rows * trainer.dp
+        if eff_batch != args.batch:
+            print(
+                f"--device-data: global batch rounded {args.batch} -> "
+                f"{eff_batch} ({rows} rows/replica, whole microbatches)"
+            )
+        hist = trainer.train_chain(
+            ds.device_sampler(), args.steps, rows_per_replica=rows
+        )
+    else:
+        hist = [
+            trainer.train_step(x, y)
+            for x, y in ds.batches(args.batch, args.steps)
+        ]
     dt = time.perf_counter() - t0
+    mode = "on-device " if args.device_data else ""
     print(
-        f"pp: {args.steps} steps on {trainer.n_devices} devices in {dt:.2f}s "
-        f"({dt / args.steps * 1e3:.1f} ms/step); loss {hist[0].loss:.4f} -> "
-        f"{hist[-1].loss:.4f}"
+        f"pp: {args.steps} {mode}steps on {trainer.n_devices} devices in "
+        f"{dt:.2f}s ({dt / args.steps * 1e3:.1f} ms/step); "
+        f"loss {hist[0].loss:.4f} -> {hist[-1].loss:.4f}"
     )
     return 0
 

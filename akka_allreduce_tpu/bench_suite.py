@@ -32,9 +32,11 @@ def _record(config: int, name: str, **fields: Any) -> dict:
 # -- config 1: single-round fp32 allreduce, 1M floats, 4 local workers --------
 
 
-def config1_local_engine(size: int = 1_000_000, rounds: int = 10) -> dict:
+def config1_local_engine(size: int = 1_000_000, rounds: int = 30) -> dict:
     """The reference's local N-worker fixture on the host engine
-    (BASELINE.json:6): master + 4 workers in one process, full protocol."""
+    (BASELINE.json:6): master + 4 workers in one process, full protocol.
+    30 rounds so per-run setup (buffer allocation, first-touch page faults)
+    amortizes to a steady-state throughput number."""
     from akka_allreduce_tpu.config import (
         AllreduceConfig,
         LineMasterConfig,

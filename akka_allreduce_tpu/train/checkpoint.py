@@ -22,7 +22,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 
-def _state_shardings(trainer) -> tuple[Any, Any]:
+def state_shardings(trainer) -> tuple[Any, Any]:
     """(param_shardings, opt_shardings) for placing restored state.
 
     Sharded trainers (TP / EP / PP — anything exposing ``_param_specs`` /
@@ -54,7 +54,7 @@ def _state_shardings(trainer) -> tuple[Any, Any]:
     return p_sh, o_sh
 
 
-def _place(tree, sharding) -> Any:
+def place_on(tree, sharding) -> Any:
     """Device-put every array leaf of ``tree`` onto ``sharding`` (a single
     sharding for all leaves, or a matching tree of per-leaf shardings).
 
@@ -98,9 +98,9 @@ class Snapshot:
     def restore_into(self, trainer) -> None:
         """Place this snapshot into ``trainer``, honoring its sharding layout
         (replicated for plain DP; per-leaf specs for TP/EP/PP trainers)."""
-        p_sh, o_sh = _state_shardings(trainer)
-        trainer.params = _place(self.params, p_sh)
-        trainer.opt_state = _place(self.opt_state, o_sh)
+        p_sh, o_sh = state_shardings(trainer)
+        trainer.params = place_on(self.params, p_sh)
+        trainer.opt_state = place_on(self.opt_state, o_sh)
         trainer.step_num = self.step
 
 
@@ -159,9 +159,9 @@ class TrainerCheckpointer:
         # trainer's CURRENT layout — replicated for plain DP, per-leaf
         # shardings for TP/EP/PP trainers (this is also what makes
         # restore-into-a-different-mesh work after an elastic re-mesh).
-        p_sh, o_sh = _state_shardings(trainer)
-        trainer.params = _place(restored["params"], p_sh)
-        trainer.opt_state = _place(restored["opt_state"], o_sh)
+        p_sh, o_sh = state_shardings(trainer)
+        trainer.params = place_on(restored["params"], p_sh)
+        trainer.opt_state = place_on(restored["opt_state"], o_sh)
         trainer.step_num = int(restored["step"])
         return trainer.step_num
 

@@ -258,14 +258,9 @@ class LongContextTrainer:
         ``valid``: per-DP-replica-row contributor mask of shape (dp,);
         None = all rows contribute.
         """
-        if valid is None:
-            valid_arr = np.ones((self.dp,), np.float32)
-        else:
-            valid_arr = np.asarray(valid, np.float32)
-            if valid_arr.shape != (self.dp,):
-                raise ValueError(
-                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
-                )
+        from akka_allreduce_tpu.train.trainer import normalize_valid
+
+        valid_arr = normalize_valid(valid, self.dp)
         xd, yd = self._place(tokens, labels)
         vd = jax.device_put(valid_arr, self._valid_sharding)
         self.params, self.opt_state, loss, cnt = self._step(
@@ -289,16 +284,16 @@ class LongContextTrainer:
         honoring the trainer's sharding layout (replicated or TP specs)."""
         from akka_allreduce_tpu.binder.api import flatten_pytree
         from akka_allreduce_tpu.train.checkpoint import (
-            _place,
-            _state_shardings,
+            place_on,
+            state_shardings,
         )
 
         # the tree structure never changes after __init__: build the
         # unflattener once, not one full device_get per sync round
         if getattr(self, "_unflatten", None) is None:
             _, self._unflatten = flatten_pytree(self.params)
-        p_sh, _ = _state_shardings(self)
-        self.params = _place(
+        p_sh, _ = state_shardings(self)
+        self.params = place_on(
             self._unflatten(np.asarray(vec, np.float32)), p_sh
         )
 
@@ -355,33 +350,19 @@ class LongContextTrainer:
         each replica row draws its own stream and its seq shards slice their
         local columns, so nothing crosses the host inside the loop.
         """
-        # same keying discipline as DPTrainer.train_chain: shape-config key,
-        # sampler pinned in the entry (id() could be a recycled address)
-        cache_key = (steps, rows_per_replica)
-        entry = self._chains.get(cache_key)
-        if entry is None or entry[0] is not sampler:
-            self._chains[cache_key] = (
-                sampler,
-                self._build_chain(sampler, steps, rows_per_replica),
-            )
-        if valid is None:
-            valid_arr = np.ones((self.dp,), np.float32)
-        else:
-            valid_arr = np.asarray(valid, np.float32)
-            if valid_arr.shape != (self.dp,):
-                raise ValueError(
-                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
-                )
-        vd = jax.device_put(valid_arr, self._valid_sharding)
-        key = jax.device_put(
-            jax.random.fold_in(jax.random.PRNGKey(seed), self.step_num),
-            self._replicated,
+        from akka_allreduce_tpu.train.trainer import run_chain_cached
+
+        losses, cnts = run_chain_cached(
+            self,
+            sampler,
+            steps,
+            rows_per_replica,
+            lambda: self._build_chain(sampler, steps, rows_per_replica),
+            valid,
+            self.dp,
+            self._valid_sharding,
+            seed,
         )
-        self.params, self.opt_state, losses, cnts = self._chains[cache_key][1](
-            self.params, self.opt_state, key, vd
-        )
-        losses = np.asarray(jax.device_get(losses))
-        cnts = np.asarray(jax.device_get(cnts))
         out = []
         for loss, cnt in zip(losses, cnts):
             self.step_num += 1

@@ -201,6 +201,9 @@ class MoETrainer:
             out_specs=(self._param_specs, self._opt_specs, P(), P(), P(), P()),
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
+        self._raw_step = step  # reused by train_chain's on-device loop
+        self._replicated = NamedSharding(mesh, P())
+        self._chains: dict = {}
 
     # -- stepping ------------------------------------------------------------
 
@@ -221,14 +224,9 @@ class MoETrainer:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} != {self.seq_len}"
             )
-        if valid is None:
-            valid_arr = np.ones((self.dp,), np.float32)
-        else:
-            valid_arr = np.asarray(valid, np.float32)
-            if valid_arr.shape != (self.dp,):
-                raise ValueError(
-                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
-                )
+        from akka_allreduce_tpu.train.trainer import normalize_valid
+
+        valid_arr = normalize_valid(valid, self.dp)
         xd = jax.device_put(np.asarray(tokens, np.int32), self._data_sharding)
         yd = jax.device_put(np.asarray(labels, np.int32), self._data_sharding)
         vd = jax.device_put(valid_arr, self._valid_sharding)
@@ -246,6 +244,94 @@ class MoETrainer:
 
     def train(self, batches: Iterable) -> list[MoEStepMetrics]:
         return [self.train_step(x, y) for x, y in batches]
+
+    # -- on-device training chain (no host I/O per step) ---------------------
+
+    def _build_chain(self, sampler, steps: int, rows_per_device: int):
+        raw_step = self._raw_step
+        data_axis, expert_axis = self.data_axis, self.expert_axis
+
+        def chain(params, opt_state, key, valid):
+            # one independent stream per DEVICE: both mesh axes carry data
+            # rows for the dense parts, so each (data, expert) coordinate
+            # samples its own batch
+            rkey = jax.random.fold_in(key, lax.axis_index(data_axis))
+            if expert_axis is not None:
+                rkey = jax.random.fold_in(rkey, lax.axis_index(expert_axis))
+
+            def body(carry, i):
+                p, o = carry
+                k = jax.random.fold_in(rkey, i)
+                x, y = sampler(k, rows_per_device)
+                p, o, loss, aux, dropped, cnt = raw_step(p, o, x, y, valid)
+                return (p, o), (loss, aux, dropped, cnt)
+
+            (params, opt_state), outs = lax.scan(
+                body, (params, opt_state), jnp.arange(steps)
+            )
+            return params, opt_state, *outs
+
+        mapped = jax.shard_map(
+            chain,
+            mesh=self.mesh,
+            in_specs=(
+                self._param_specs,
+                self._opt_specs,
+                P(),
+                P(self.data_axis),
+            ),
+            out_specs=(
+                self._param_specs,
+                self._opt_specs,
+                P(),
+                P(),
+                P(),
+                P(),
+            ),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_chain(
+        self,
+        sampler,
+        steps: int,
+        rows_per_device: int,
+        *,
+        valid: Sequence[float] | None = None,
+        seed: int = 0,
+    ) -> list[MoEStepMetrics]:
+        """Run ``steps`` DP x EP steps entirely on device in ONE dispatch.
+
+        ``sampler`` is a traced ``(key, rows) -> (tokens, labels)`` (e.g.
+        ``SyntheticCopyLM.device_sampler``); each device draws its own
+        stream, so the loop does zero host I/O.
+        """
+        from akka_allreduce_tpu.train.trainer import run_chain_cached
+
+        losses, auxes, droppeds, cnts = run_chain_cached(
+            self,
+            sampler,
+            steps,
+            rows_per_device,
+            lambda: self._build_chain(sampler, steps, rows_per_device),
+            valid,
+            self.dp,
+            self._valid_sharding,
+            seed,
+        )
+        out = []
+        for loss, aux, dropped, cnt in zip(losses, auxes, droppeds, cnts):
+            self.step_num += 1
+            out.append(
+                MoEStepMetrics(
+                    step=self.step_num,
+                    loss=float(loss),
+                    aux_loss=float(aux),
+                    dropped=float(dropped),
+                    contributors=float(cnt),
+                )
+            )
+        return out
 
     def get_flat_params(self) -> np.ndarray:
         from akka_allreduce_tpu.binder.api import flatten_pytree

@@ -260,6 +260,9 @@ class PipelineLMTrainer:
             out_specs=(self._param_specs, self._opt_specs, P(), P()),
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
+        self._raw_step = step  # reused by train_chain's on-device loop
+        self._replicated = NamedSharding(mesh, P())
+        self._chains: dict = {}
 
     # -- stepping ------------------------------------------------------------
 
@@ -281,14 +284,9 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} != {self.seq_len}"
             )
-        if valid is None:
-            valid_arr = np.ones((self.dp,), np.float32)
-        else:
-            valid_arr = np.asarray(valid, np.float32)
-            if valid_arr.shape != (self.dp,):
-                raise ValueError(
-                    f"valid must have shape ({self.dp},), got {valid_arr.shape}"
-                )
+        from akka_allreduce_tpu.train.trainer import normalize_valid
+
+        valid_arr = normalize_valid(valid, self.dp)
         xd = jax.device_put(np.asarray(tokens, np.int32), self._data_sharding)
         yd = jax.device_put(np.asarray(labels, np.int32), self._data_sharding)
         vd = jax.device_put(valid_arr, self._valid_sharding)
@@ -302,6 +300,82 @@ class PipelineLMTrainer:
 
     def train(self, batches) -> list[PipelineStepMetrics]:
         return [self.train_step(x, y) for x, y in batches]
+
+    # -- on-device training chain (no host I/O per step) ---------------------
+
+    def _build_chain(self, sampler, steps: int, rows_per_replica: int):
+        raw_step = self._raw_step
+        data_axis = self.data_axis
+
+        def chain(params, opt_state, key, valid):
+            # one stream per DP replica row; all pipe stages of a row fold
+            # the same data coordinate, so they agree on the row's tokens
+            # (stage 0 injects, the last stage reads labels)
+            rkey = jax.random.fold_in(key, lax.axis_index(data_axis))
+
+            def body(carry, i):
+                p, o = carry
+                k = jax.random.fold_in(rkey, i)
+                x, y = sampler(k, rows_per_replica)
+                p, o, loss, cnt = raw_step(p, o, x, y, valid)
+                return (p, o), (loss, cnt)
+
+            (params, opt_state), (losses, cnts) = lax.scan(
+                body, (params, opt_state), jnp.arange(steps)
+            )
+            return params, opt_state, losses, cnts
+
+        mapped = jax.shard_map(
+            chain,
+            mesh=self.mesh,
+            in_specs=(
+                self._param_specs,
+                self._opt_specs,
+                P(),
+                P(self.data_axis),
+            ),
+            out_specs=(self._param_specs, self._opt_specs, P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_chain(
+        self,
+        sampler,
+        steps: int,
+        rows_per_replica: int,
+        *,
+        valid: Sequence[float] | None = None,
+        seed: int = 0,
+    ) -> list[PipelineStepMetrics]:
+        """Run ``steps`` DP x PP steps entirely on device in ONE dispatch
+        (``rows_per_replica`` must divide by ``microbatches``)."""
+        if rows_per_replica % self.microbatches:
+            raise ValueError(
+                f"rows_per_replica {rows_per_replica} not divisible by "
+                f"{self.microbatches} microbatches"
+            )
+        from akka_allreduce_tpu.train.trainer import run_chain_cached
+
+        losses, cnts = run_chain_cached(
+            self,
+            sampler,
+            steps,
+            rows_per_replica,
+            lambda: self._build_chain(sampler, steps, rows_per_replica),
+            valid,
+            self.dp,
+            self._valid_sharding,
+            seed,
+        )
+        out = []
+        for loss, cnt in zip(losses, cnts):
+            self.step_num += 1
+            out.append(
+                PipelineStepMetrics(
+                    step=self.step_num, loss=float(loss), contributors=float(cnt)
+                )
+            )
+        return out
 
     def get_flat_params(self) -> np.ndarray:
         from akka_allreduce_tpu.binder.api import flatten_pytree

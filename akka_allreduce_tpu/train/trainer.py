@@ -53,6 +53,48 @@ def normalize_valid(valid: Sequence[float] | None, n: int) -> np.ndarray:
     return arr
 
 
+def run_chain_cached(
+    trainer,
+    sampler,
+    steps: int,
+    rows: int,
+    build,
+    valid: Sequence[float] | None,
+    n_valid: int,
+    valid_sharding,
+    seed: int,
+) -> tuple[np.ndarray, ...]:
+    """Shared ``train_chain`` scaffolding for every trainer.
+
+    - chain cache keyed on the shape config ``(steps, rows)`` with the
+      sampler object pinned by IDENTITY in the entry: ``id()`` alone could
+      match a new sampler allocated at a recycled address after the old one
+      was garbage-collected, silently reusing a chain compiled against the
+      old closure;
+    - contributor mask normalized to ``(n_valid,)`` and placed;
+    - the PRNG key folds in ``step_num`` so consecutive chain calls continue
+      the data stream instead of replaying the same batches.
+
+    The built chain must have signature ``(params, opt_state, key, valid) ->
+    (params, opt_state, *metric_arrays)``; the new state is swapped into the
+    trainer here and the stacked metric arrays are returned as host numpy.
+    """
+    cache_key = (steps, rows)
+    entry = trainer._chains.get(cache_key)
+    if entry is None or entry[0] is not sampler:
+        trainer._chains[cache_key] = (sampler, build())
+    vd = jax.device_put(normalize_valid(valid, n_valid), valid_sharding)
+    key = jax.device_put(
+        jax.random.fold_in(jax.random.PRNGKey(seed), trainer.step_num),
+        trainer._replicated,
+    )
+    out = trainer._chains[cache_key][1](
+        trainer.params, trainer.opt_state, key, vd
+    )
+    trainer.params, trainer.opt_state = out[0], out[1]
+    return tuple(np.asarray(jax.device_get(o)) for o in out[2:])
+
+
 def place_batch(x, y, n_devices: int, data_sharding):
     """Validate divisibility and place a global (x, y) batch on the mesh."""
     if x.shape[0] % n_devices:
@@ -423,30 +465,17 @@ class DPTrainer:
         loop — the data-loader discipline for tunneled/remote chips where a
         per-step host round trip costs more than the step itself.
         """
-        # key by shape config and pin the sampler object in the entry: id()
-        # alone could match a NEW sampler allocated at a recycled address
-        # after the old one was garbage-collected, silently reusing a chain
-        # compiled against the old closure
-        cache_key = (steps, batch_per_device)
-        entry = self._chains.get(cache_key)
-        if entry is None or entry[0] is not sampler:
-            self._chains[cache_key] = (
-                sampler,
-                self._build_chain(sampler, steps, batch_per_device),
-            )
-        valid_arr = self._normalize_valid(valid)
-        vd = jax.device_put(valid_arr, self._data_sharding)
-        # fold the current step count in so consecutive chain calls continue
-        # the data stream instead of replaying the same batches
-        key = jax.device_put(
-            jax.random.fold_in(jax.random.PRNGKey(seed), self.step_num),
-            self._replicated,
+        losses, cnts = run_chain_cached(
+            self,
+            sampler,
+            steps,
+            batch_per_device,
+            lambda: self._build_chain(sampler, steps, batch_per_device),
+            valid,
+            self.n_devices,
+            self._data_sharding,
+            seed,
         )
-        self.params, self.opt_state, losses, cnts = self._chains[cache_key][1](
-            self.params, self.opt_state, key, vd
-        )
-        losses = np.asarray(jax.device_get(losses))
-        cnts = np.asarray(jax.device_get(cnts))
         out = []
         for loss, cnt in zip(losses, cnts):
             self.step_num += 1

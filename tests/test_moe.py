@@ -107,3 +107,11 @@ class TestMoEDtypes:
         x, y = next(ds.batches(8, 1))
         m = t.train_step(x, y)
         assert np.isfinite(m.loss) and m.contributors == 2.0
+
+    def test_train_chain_on_device(self):
+        t = MoETrainer(mesh((2, 4), ("data", "expert")), **KW)
+        sampler = data.lm_copy_task(32, vocab=16).device_sampler()
+        hist = t.train_chain(sampler, steps=4, rows_per_device=2)
+        assert len(hist) == 4
+        assert all(np.isfinite(h.loss) for h in hist)
+        assert hist[-1].step == 4

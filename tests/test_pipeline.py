@@ -76,3 +76,17 @@ class TestPipelineParallel:
             t.train_step(
                 np.zeros((2, 32), np.int32), np.zeros((2, 32), np.int32)
             )
+
+    def test_train_chain_on_device(self):
+        t = PipelineLMTrainer(mesh(2, 4), layers_per_stage=1, **KW)
+        sampler = data.lm_copy_task(32, vocab=16).device_sampler()
+        hist = t.train_chain(sampler, steps=4, rows_per_replica=4)
+        assert len(hist) == 4
+        assert all(np.isfinite(h.loss) for h in hist)
+        assert hist[0].contributors == 2.0
+
+    def test_train_chain_rejects_bad_rows(self):
+        t = PipelineLMTrainer(mesh(2, 4), layers_per_stage=1, **KW)
+        sampler = data.lm_copy_task(32, vocab=16).device_sampler()
+        with pytest.raises(ValueError, match="microbatches"):
+            t.train_chain(sampler, steps=2, rows_per_replica=3)
