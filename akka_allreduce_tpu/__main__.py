@@ -126,6 +126,14 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
         help="sync gradients in bfloat16 on the wire (half the ICI bytes; "
         "optimizer state stays fp32)",
     )
+    p.add_argument(
+        "--error-feedback",
+        action="store_true",
+        help="carry each device's compression residual into its next "
+        "contribution (EF-SGD): lossy sync becomes unbiased over time and a "
+        "threshold-dropped device's gradient is delayed, not lost "
+        "(requires --compress)",
+    )
 
 
 def _run_training_chain(trainer, ds, args, *, label: str) -> int:
@@ -147,6 +155,11 @@ def _run_training_chain(trainer, ds, args, *, label: str) -> int:
         raise SystemExit(
             "--accum is not supported with --device-data (the on-device "
             "chain samples fixed per-device batches); drop one of the flags"
+        )
+    if getattr(trainer, "error_feedback", False):
+        raise SystemExit(
+            "--error-feedback is not supported with --device-data (the "
+            "residual is not threaded through the chain scan); drop one"
         )
     profile = contextlib.nullcontext()
     if getattr(args, "profile_dir", None):
@@ -238,6 +251,11 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
     accum = getattr(args, "accum", 1)
     if accum < 1:
         raise SystemExit(f"--accum must be >= 1, got {accum}")
+    if accum > 1 and getattr(trainer, "error_feedback", False):
+        raise SystemExit(
+            "--error-feedback is not supported with --accum > 1 (the "
+            "residual is not threaded through the accumulation scan)"
+        )
     t0 = time.perf_counter()
     losses = []
     with profile:
@@ -312,6 +330,7 @@ def _cmd_train_mlp(argv: list[str]) -> int:
         learning_rate=args.lr,
         bucket_size=args.bucket,
         compress=args.compress,
+        error_feedback=args.error_feedback,
     )
     return _run_training(trainer, data.mnist_like(), args, label="mlp_mnist")
 
@@ -343,6 +362,7 @@ def _cmd_train_resnet(argv: list[str]) -> int:
         learning_rate=args.lr,
         bucket_size=args.bucket or 262_144,  # the reference's chunk geometry
         compress=args.compress,
+        error_feedback=args.error_feedback,
     )
     print(f"ResNet params: {trainer.param_count / 1e6:.1f}M")
     ds = data.SyntheticClassification(

@@ -187,6 +187,11 @@ class RemoteTransport:
         for env in envelopes:
             await self.send(env)
 
+    # Largest frame we will buffer from a peer: a corrupt length prefix must
+    # not turn into an unbounded allocation. Generous for real payloads
+    # (dominated by max_chunk_size floats; 256 MB = a 64M-float chunk).
+    max_frame_bytes = 256 << 20
+
     # Back-pressure point: drain (bounded) only once this much is buffered.
     # Draining every frame costs a timer + task round-trip through the event
     # loop per message; letting the OS buffer absorb bursts nearly doubles
@@ -230,8 +235,26 @@ class RemoteTransport:
             while True:
                 header = await reader.readexactly(4)
                 (length,) = _U32.unpack(header)
+                if length > self.max_frame_bytes:
+                    # a corrupt/hostile length prefix must not make us
+                    # buffer gigabytes; drop the connection (the peer's
+                    # framing is gone — nothing after this parses)
+                    log.warning(
+                        "frame length %d exceeds limit %d; closing connection",
+                        length,
+                        self.max_frame_bytes,
+                    )
+                    self.dropped += 1
+                    break
                 body = await reader.readexactly(length)
-                dest, msg = wire.decode_frame_body(body)
+                try:
+                    dest, msg = wire.decode_frame_body(body)
+                except Exception as exc:  # malformed body: drop THIS frame
+                    # framing is length-prefixed, so the stream stays in
+                    # sync — one bad message must not kill the connection
+                    log.warning("undecodable frame (%s); dropping", exc)
+                    self.dropped += 1
+                    continue
                 await self._inbox.put((dest, msg))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass  # peer closed; at-most-once semantics, nothing to recover

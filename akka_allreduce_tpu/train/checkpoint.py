@@ -73,6 +73,18 @@ def place_on(tree, sharding) -> Any:
     return jax.tree.map(put, tree, sharding)
 
 
+def _restore_ef(trainer, ef) -> None:
+    """Place a restored error-feedback residual, redistributing across a
+    re-mesh: the residual is per-device withheld gradient mass, so when the
+    device count changed we preserve its SUM (what the collective is still
+    owed) by splitting it evenly over the new devices."""
+    ef = np.asarray(ef, np.float32)
+    n = trainer.n_devices
+    if ef.shape[0] != n:
+        ef = np.tile(ef.sum(axis=0, keepdims=True) / n, (n, 1))
+    trainer._ef = jax.device_put(ef, trainer._data_sharding)
+
+
 @dataclasses.dataclass
 class Snapshot:
     """In-memory (host RAM) snapshot of trainer state for fast re-mesh resume.
@@ -85,14 +97,17 @@ class Snapshot:
     params: Any  # pytree of np.ndarray
     opt_state: Any  # pytree of np.ndarray / leaves
     step: int
+    ef: Any = None  # error-feedback residual (n_devices, params) or None
 
     @classmethod
     def capture(cls, trainer) -> "Snapshot":
         host = lambda t: jax.tree.map(lambda x: np.asarray(x), t)
+        ef = getattr(trainer, "_ef", None)
         return cls(
             params=host(trainer.params),
             opt_state=host(trainer.opt_state),
             step=trainer.step_num,
+            ef=None if ef is None else np.asarray(ef),
         )
 
     def restore_into(self, trainer) -> None:
@@ -102,6 +117,8 @@ class Snapshot:
         trainer.params = place_on(self.params, p_sh)
         trainer.opt_state = place_on(self.opt_state, o_sh)
         trainer.step_num = self.step
+        if self.ef is not None and getattr(trainer, "_ef", None) is not None:
+            _restore_ef(trainer, self.ef)
 
 
 class TrainerCheckpointer:
@@ -131,6 +148,10 @@ class TrainerCheckpointer:
             "opt_state": trainer.opt_state,
             "step": trainer.step_num,
         }
+        if getattr(trainer, "_ef", None) is not None:
+            # error-feedback residual is training state: dropping it on
+            # restart would permanently lose every withheld gradient
+            state["ef"] = trainer._ef
         saved = self._mgr.save(
             trainer.step_num, args=ocp.args.StandardSave(state), force=force
         )
@@ -152,6 +173,9 @@ class TrainerCheckpointer:
             "opt_state": trainer.opt_state,
             "step": trainer.step_num,
         }
+        has_ef = getattr(trainer, "_ef", None) is not None
+        if has_ef:
+            target["ef"] = trainer._ef
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(target)
         )
@@ -163,6 +187,8 @@ class TrainerCheckpointer:
         trainer.params = place_on(restored["params"], p_sh)
         trainer.opt_state = place_on(restored["opt_state"], o_sh)
         trainer.step_num = int(restored["step"])
+        if has_ef and "ef" in restored:
+            _restore_ef(trainer, restored["ef"])
         return trainer.step_num
 
     def close(self) -> None:

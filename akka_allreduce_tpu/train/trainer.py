@@ -120,6 +120,13 @@ class DPTrainer:
         (halves ICI bytes on the bandwidth-bound grad allreduce; counts and
         the optimizer state stay float32). Forces the explicit-collective
         path (one bucket when ``bucket_size`` is None).
+      error_feedback: carry each device's quantization residual into its
+        next contribution (EF-SGD): ``c = g + e; send cast(c·v);
+        e' = c − sent`` — what compression withholds this step is re-sent
+        the next, making the lossy sync unbiased over time. A masked-out
+        device (v=0) sends nothing, so its ENTIRE contribution carries
+        forward — threshold dropout loses no gradient signal, only delays
+        it. Requires ``compress``; train_step only (not accum/chain).
     """
 
     def __init__(
@@ -134,11 +141,17 @@ class DPTrainer:
         loss_fn: Callable | None = None,
         seed: int = 0,
         compress: str | None = None,
+        error_feedback: bool = False,
     ) -> None:
         if compress not in (None, "bf16"):
             raise ValueError(
                 f"compress must be None or 'bf16', got {compress!r} "
                 "(int8 needs per-hop scales: use the ring schedule in comm/)"
+            )
+        if error_feedback and compress is None:
+            raise ValueError(
+                "error_feedback compensates COMPRESSION error: it requires "
+                "compress='bf16' (lossless sync has no residual to carry)"
             )
         self.model = model
         self.mesh = mesh
@@ -147,6 +160,7 @@ class DPTrainer:
         self.tx = optimizer or optax.sgd(learning_rate)
         self.bucket_size = bucket_size
         self.compress = compress
+        self.error_feedback = error_feedback
         # how many independent data streams train_chain samples (one per
         # device here; the long-context trainer has one per DP replica row)
         self.data_shards = self.n_devices
@@ -173,55 +187,72 @@ class DPTrainer:
         tx = self.tx
         wire_bf16 = compress == "bf16"
 
+        def explicit_step(params, opt_state, x, y, v, ef):
+            """Explicit bucketed collective (the reference's chunked buffer):
+            make params device-varying first so grads stay LOCAL (no implicit
+            psum), then run the bucketed masked collective ourselves — in
+            bfloat16 on the wire when compressing, with an optional
+            error-feedback residual folded in and carried out."""
+            scalar_cnt = lax.psum(v, axis_names)
+            denom = jnp.maximum(scalar_cnt, 1.0)
+            params_local = jax.tree.map(
+                lambda p: lax.pcast(p, axis_names, to="varying"), params
+            )
+
+            def local_loss(p):
+                logits = model_apply(p, x)
+                return loss_impl(logits, y)
+
+            loss, grads = jax.value_and_grad(local_loss)(params_local)
+            flat, unravel = ravel_pytree(grads)
+            c = flat if ef is None else flat + ef.reshape(-1)
+            b = bucket if bucket is not None else flat.shape[0]
+            n_buckets = -(-flat.shape[0] // b)
+            # bf16 wire: masked_psum runs the payload collective at half
+            # width; counts stay float32 (exact at any mesh size)
+            gsum, cnt = masked_psum(
+                c,
+                jnp.full((n_buckets,), v),
+                axis_names,
+                bucket_size=b,
+                wire_dtype=jnp.bfloat16 if wire_bf16 else None,
+            )
+            if ef is None:
+                new_ef = None
+            else:
+                # what the collective actually summed from this device (the
+                # same mask-then-cast masked_psum performs for a 0/1 scalar
+                # mask); the residual is everything it withheld — all of c
+                # when this device was masked out
+                sent = (c * v).astype(jnp.bfloat16).astype(jnp.float32)
+                new_ef = (c - sent).reshape(ef.shape)
+            denom_el = jnp.maximum(expand_counts(cnt, flat.shape[0], b), 1.0)
+            gavg = unravel(gsum / denom_el)
+            loss_avg = lax.psum(loss * v, axis_names) / denom
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, new_ef, loss_avg, scalar_cnt
+
         def step(params, opt_state, x, y, valid):
             v = valid.reshape(())
+            if bucket is not None or wire_bf16:
+                out = explicit_step(params, opt_state, x, y, v, None)
+                return out[0], out[1], out[3], out[4]
+            # Differentiating the v-weighted local loss w.r.t. REPLICATED
+            # params makes JAX's shard_map autodiff insert the cross-device
+            # psum itself (the transpose of the params broadcast), so the
+            # gradient that comes back is already sum_d(v_d * g_d) in ONE
+            # fused collective — the masked allreduce with zero extra code.
             scalar_cnt = lax.psum(v, axis_names)
             denom = jnp.maximum(scalar_cnt, 1.0)
 
-            if bucket is None and not wire_bf16:
-                # Differentiating the v-weighted local loss w.r.t. REPLICATED
-                # params makes JAX's shard_map autodiff insert the cross-device
-                # psum itself (the transpose of the params broadcast), so the
-                # gradient that comes back is already sum_d(v_d * g_d) in ONE
-                # fused collective — the masked allreduce with zero extra code.
-                def global_masked_loss(p):
-                    logits = model_apply(p, x)
-                    return loss_impl(logits, y) * v
+            def global_masked_loss(p):
+                logits = model_apply(p, x)
+                return loss_impl(logits, y) * v
 
-                lsum, gsum_tree = jax.value_and_grad(global_masked_loss)(params)
-                gavg = jax.tree.map(lambda g: g / denom, gsum_tree)
-                loss_avg = lax.psum(lsum, axis_names) / denom
-            else:
-                # Explicit bucketed path (the reference's chunked buffer): make
-                # params device-varying first so grads stay LOCAL (no implicit
-                # psum), then run the bucketed masked collective ourselves.
-                params_local = jax.tree.map(
-                    lambda p: lax.pcast(p, axis_names, to="varying"), params
-                )
-
-                def local_loss(p):
-                    logits = model_apply(p, x)
-                    return loss_impl(logits, y)
-
-                loss, grads = jax.value_and_grad(local_loss)(params_local)
-                flat, unravel = ravel_pytree(grads)
-                b = bucket if bucket is not None else flat.shape[0]
-                n_buckets = -(-flat.shape[0] // b)
-                # bf16 wire: masked_psum runs the payload collective at half
-                # width; counts stay float32 (exact at any mesh size)
-                gsum, cnt = masked_psum(
-                    flat,
-                    jnp.full((n_buckets,), v),
-                    axis_names,
-                    bucket_size=b,
-                    wire_dtype=jnp.bfloat16 if wire_bf16 else None,
-                )
-                denom_el = jnp.maximum(
-                    expand_counts(cnt, flat.shape[0], b), 1.0
-                )
-                gavg = unravel(gsum / denom_el)
-                loss_avg = lax.psum(loss * v, axis_names) / denom
-
+            lsum, gsum_tree = jax.value_and_grad(global_masked_loss)(params)
+            gavg = jax.tree.map(lambda g: g / denom, gsum_tree)
+            loss_avg = lax.psum(lsum, axis_names) / denom
             updates, new_opt = tx.update(gavg, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, loss_avg, scalar_cnt
@@ -234,6 +265,31 @@ class DPTrainer:
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
+
+        if error_feedback:
+            # per-device float32 residual of the compressed grad sync,
+            # device-varying (each device carries ITS OWN withheld error)
+            self._ef = jax.device_put(
+                np.zeros((self.n_devices, self.param_count), np.float32),
+                self._data_sharding,
+            )
+
+            def step_ef(params, opt_state, ef, x, y, valid):
+                return explicit_step(
+                    params, opt_state, x, y, valid.reshape(()), ef
+                )
+
+            self._step_ef = jax.jit(
+                jax.shard_map(
+                    step_ef,
+                    mesh=mesh,
+                    in_specs=(
+                        P(), P(), data_spec, data_spec, data_spec, data_spec
+                    ),
+                    out_specs=(P(), P(), data_spec, P(), P()),
+                ),
+                donate_argnums=(0, 1, 2),
+            )
         self._chains: dict = {}
         self._accum_steps_fns: dict = {}
 
@@ -266,6 +322,14 @@ class DPTrainer:
         valid_arr = self._normalize_valid(valid)
         xd, yd = self._place_batch(x, y)
         vd = jax.device_put(valid_arr, self._data_sharding)
+        if self.error_feedback:
+            self.params, self.opt_state, self._ef, loss, cnt = self._step_ef(
+                self.params, self.opt_state, self._ef, xd, yd, vd
+            )
+            self.step_num += 1
+            return TrainStepMetrics(
+                step=self.step_num, loss=float(loss), contributors=float(cnt)
+            )
         self.params, self.opt_state, loss, cnt = self._step(
             self.params, self.opt_state, xd, yd, vd
         )
@@ -383,6 +447,11 @@ class DPTrainer:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         if accum_steps == 1:  # identical math; reuse the already-built step
             return self.train_step(x, y, valid)
+        if self.error_feedback:
+            raise NotImplementedError(
+                "error_feedback is train_step-only (the residual state is "
+                "not threaded through the accumulation scan)"
+            )
         n = self.n_devices * accum_steps
         if x.shape[0] % n:
             raise ValueError(
@@ -465,6 +534,11 @@ class DPTrainer:
         loop — the data-loader discipline for tunneled/remote chips where a
         per-step host round trip costs more than the step itself.
         """
+        if self.error_feedback:
+            raise NotImplementedError(
+                "error_feedback is train_step-only (the residual state is "
+                "not threaded through the chain scan)"
+            )
         losses, cnts = run_chain_cached(
             self,
             sampler,

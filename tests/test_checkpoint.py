@@ -192,3 +192,61 @@ class TestShardedTrainerCheckpoint:
         assert q.addressable_shards[0].data.shape == (32, 1, 8)  # tp=4
         m = other.train_step(*next(ds.batches(4, 1, seed_offset=3)))
         assert np.isfinite(m.loss)
+
+
+class TestErrorFeedbackCheckpoint:
+    """The EF residual is training state: save/restore must carry it, and a
+    re-mesh must preserve its SUM (the mass the collective is still owed)."""
+
+    def _trainer(self, n, seed=0):
+        import optax
+
+        from akka_allreduce_tpu.models import MLP
+        from akka_allreduce_tpu.parallel import line_mesh
+        from akka_allreduce_tpu.train import DPTrainer
+
+        return DPTrainer(
+            MLP(hidden=(8,), classes=10),
+            line_mesh(n),
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.sgd(0.1),
+            seed=seed,
+            compress="bf16",
+            error_feedback=True,
+        )
+
+    def test_checkpoint_roundtrips_residual(self, tmp_path):
+        from akka_allreduce_tpu.models import data
+        from akka_allreduce_tpu.train import TrainerCheckpointer
+
+        t = self._trainer(8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0  # device 3's whole gradient lives only in _ef
+        t.train_step(x, y, valid)
+        ef_before = np.asarray(t._ef)
+        assert np.linalg.norm(ef_before[3]) > 0
+        with TrainerCheckpointer(tmp_path / "ef") as ckpt:
+            assert ckpt.save(t)
+            fresh = self._trainer(8, seed=9)
+            ckpt.restore(fresh)
+        np.testing.assert_array_equal(np.asarray(fresh._ef), ef_before)
+
+    def test_snapshot_remesh_preserves_residual_sum(self):
+        from akka_allreduce_tpu.models import data
+        from akka_allreduce_tpu.train import Snapshot
+
+        t8 = self._trainer(8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        t8.train_step(x, y, valid=[1, 1, 1, 0, 1, 1, 1, 1])
+        snap = Snapshot.capture(t8)
+        t4 = self._trainer(4, seed=9)  # re-mesh: 8 -> 4 devices
+        snap.restore_into(t4)
+        np.testing.assert_allclose(
+            np.asarray(t4._ef).sum(axis=0),
+            np.asarray(t8._ef).sum(axis=0),
+            rtol=1e-5,
+            atol=1e-7,
+        )

@@ -693,3 +693,65 @@ def test_rejoin_ignored_after_graceful_leave():
             await h.stop()
 
     asyncio.run(run())
+
+
+def test_wire_decode_rejects_garbage_without_crashing():
+    """decode must raise (not hang/corrupt) on malformed bodies; every
+    mutation of a valid message either decodes to SOMETHING or raises a
+    clean error — never a segfault-ish surprise (fuzz the codec directly)."""
+    rng = np.random.default_rng(0)
+    base = wire.encode(
+        ScatterBlock(np.ones(50, np.float32), 1, 2, 3, 4)
+    )
+    for trial in range(300):
+        buf = bytearray(base)
+        kind = trial % 3
+        if kind == 0:  # truncate
+            buf = buf[: int(rng.integers(0, len(buf)))]
+        elif kind == 1:  # bit flips
+            for _ in range(int(rng.integers(1, 4))):
+                i = int(rng.integers(0, len(buf)))
+                buf[i] ^= 1 << int(rng.integers(0, 8))
+        else:  # random garbage of random length
+            buf = bytes(rng.integers(0, 256, size=int(rng.integers(1, 64)), dtype=np.uint8))
+        try:
+            wire.decode(bytes(buf))
+        except Exception:
+            pass  # clean rejection is fine; crashing the process is not
+
+
+def test_transport_survives_malformed_frames_between_valid_ones():
+    """A peer that sends one garbage frame must not kill the connection:
+    length-prefixed framing keeps the stream in sync, so valid frames
+    before AND after still deliver."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport, _U32
+
+    async def run():
+        rx = RemoteTransport()
+        got = []
+        rx.register("sink", lambda m: got.append(m.round_num) or [])
+        ep = await rx.start()
+        try:
+            reader, writer = await asyncio.open_connection(ep.host, ep.port)
+            good1 = wire.encode_frame(
+                "sink", ScatterBlock(np.ones(4, np.float32), 0, 1, 0, 1)
+            )
+            garbage_body = b"\xff\x00garbage-not-a-frame"
+            bad = _U32.pack(len(garbage_body)) + garbage_body
+            good2 = wire.encode_frame(
+                "sink", ScatterBlock(np.ones(4, np.float32), 0, 1, 0, 2)
+            )
+            writer.write(good1 + bad + good2)
+            await writer.drain()
+            await wait_until(lambda: got == [1, 2], 10.0)
+            assert rx.dropped == 1
+            # an absurd length prefix closes the connection instead of
+            # buffering it
+            writer.write(_U32.pack(1 << 31))
+            await writer.drain()
+            await wait_until(lambda: rx.dropped == 2, 10.0)
+            writer.close()
+        finally:
+            await rx.stop()
+
+    asyncio.run(run())

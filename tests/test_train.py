@@ -288,3 +288,68 @@ def test_compress_bucketed_accum_masked_combo(line8):
     valid[5] = 0.0
     m = t.train_step_accum(x, y, accum_steps=2, valid=valid)
     assert m.contributors == 7.0 and np.isfinite(m.loss)
+
+
+class TestErrorFeedback:
+    """EF compression: c = g + e, send cast(c*v), e' = c - sent — lossy sync
+    becomes unbiased over time, and a masked device's whole contribution
+    carries forward instead of being lost."""
+
+    def _make(self, line8, compress=None, ef=False, seed=0):
+        import optax
+
+        return DPTrainer(
+            MLP(hidden=(32,), classes=10),
+            line8,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.sgd(0.1),
+            seed=seed,
+            compress=compress,
+            error_feedback=ef,
+        )
+
+    def test_trains_and_stays_close_to_f32(self, line8):
+        t_f32 = self._make(line8)
+        t_ef = self._make(line8, "bf16", True)
+        ds = data.mnist_like()
+        batches = list(ds.batches(64, 15))
+        h = []
+        for x, y in batches:
+            t_f32.train_step(x, y)
+            h.append(t_ef.train_step(x, y))
+        assert h[-1].loss < h[0].loss
+        drift = np.abs(t_ef.get_flat_params() - t_f32.get_flat_params()).max()
+        scale = np.abs(t_f32.get_flat_params()).max()
+        assert drift / scale < 1e-2
+        # the residual is live (bf16 truncation error being carried)
+        assert float(np.abs(np.asarray(t_ef._ef)).max()) > 0
+
+    def test_masked_device_carries_full_contribution(self, line8):
+        t = self._make(line8, "bf16", True)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 7.0
+        ef = np.asarray(t._ef)
+        masked_norm = np.linalg.norm(ef[3])
+        other = max(
+            np.linalg.norm(ef[i]) for i in range(8) if i != 3
+        )
+        # the dropped device withheld its WHOLE gradient; contributors only
+        # carry bf16 truncation crumbs
+        assert masked_norm > 50 * other, (masked_norm, other)
+
+    def test_requires_compress(self, line8):
+        with pytest.raises(ValueError, match="error_feedback"):
+            self._make(line8, None, True)
+
+    def test_accum_and_chain_rejected(self, line8):
+        t = self._make(line8, "bf16", True)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(32, 1)))
+        with pytest.raises(NotImplementedError):
+            t.train_step_accum(x, y, accum_steps=2)
+        with pytest.raises(NotImplementedError):
+            t.train_chain(ds.device_sampler(), 2, 2)
