@@ -143,15 +143,22 @@ class TrainerCheckpointer:
     def save(self, trainer, *, force: bool = False) -> bool:
         if trainer.step_num in self._mgr.all_steps():
             return False  # this step is already durable; nothing to do
-        state = {
-            "params": trainer.params,
-            "opt_state": trainer.opt_state,
-            "step": trainer.step_num,
-        }
-        if getattr(trainer, "_ef", None) is not None:
-            # error-feedback residual is training state: dropping it on
-            # restart would permanently lose every withheld gradient
-            state["ef"] = trainer._ef
+        if hasattr(trainer, "checkpoint_state"):
+            # trainer-defined serialization (e.g. ZeRO-1's flat weights +
+            # sharded optimizer state, which don't fit the params/opt_state
+            # pytree shape)
+            state = dict(trainer.checkpoint_state())
+            state["step"] = trainer.step_num
+        else:
+            state = {
+                "params": trainer.params,
+                "opt_state": trainer.opt_state,
+                "step": trainer.step_num,
+            }
+            if getattr(trainer, "_ef", None) is not None:
+                # error-feedback residual is training state: dropping it on
+                # restart would permanently lose every withheld gradient
+                state["ef"] = trainer._ef
         saved = self._mgr.save(
             trainer.step_num, args=ocp.args.StandardSave(state), force=force
         )
@@ -166,6 +173,15 @@ class TrainerCheckpointer:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        if hasattr(trainer, "checkpoint_state"):
+            target = dict(trainer.checkpoint_state())
+            target["step"] = trainer.step_num
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
+            trainer.step_num = int(restored.pop("step"))
+            trainer.restore_checkpoint_state(restored)
+            return trainer.step_num
         # Use the trainer's live state as the abstract target so leaves come
         # back with the right dtypes/shardings for its current mesh.
         target = {

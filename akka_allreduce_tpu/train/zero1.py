@@ -17,9 +17,10 @@ the reduce-scatter and divided by the contributor count after, exactly
 ``comm.allreduce.masked_psum``'s math on each shard.
 
 Numerically identical to ``DPTrainer`` with the same optimizer (verified in
-tests/test_zero1.py). Not yet wired into ``TrainerCheckpointer`` — weights
-round-trip via ``params``/flat helpers, but optimizer-state checkpointing of
-the sharded layout is future work.
+tests/test_zero1.py). Checkpointing goes through ``TrainerCheckpointer``'s
+trainer-defined protocol (``checkpoint_state``/``restore_checkpoint_state``):
+the flat weight vector and the 1/n optimizer-moment shards serialize as-is
+and restore onto the same mesh size.
 
 Beyond the reference (which has no optimizer-state concept at all); it exists
 here because memory per chip is the binding constraint the framework is built
@@ -203,6 +204,39 @@ class Zero1DPTrainer:
             jnp.pad(vec, (0, self._padded - self.param_count)),
             self._replicated,
         )
+
+    # -- checkpoint seam (TrainerCheckpointer's trainer-defined protocol) ----
+
+    def checkpoint_state(self) -> dict:
+        """ZeRO-1 state doesn't fit the params/opt_state pytree shape the
+        default checkpoint path assumes (weights are one padded flat vector,
+        optimizer moments are 1/n shards): serialize it explicitly."""
+        return {
+            "flat_params": self.flat_params,
+            "opt_state": self.opt_state,
+        }
+
+    def restore_checkpoint_state(self, state: dict) -> None:
+        """Re-place restored state on this trainer's mesh: flat weights
+        replicated, optimizer moments sharded 1/n (scalar counters
+        replicated). Same device count only — the moment shards are
+        per-device state. Placement reshards on device (a no-op when Orbax
+        already restored onto the right shardings)."""
+        from akka_allreduce_tpu.train.checkpoint import place_on
+
+        flat = state["flat_params"]
+        if flat.shape != (self._padded,):
+            raise ValueError(
+                f"flat_params shape {flat.shape} != padded ({self._padded},):"
+                " restore into a trainer with the same model and mesh size"
+            )
+        self.flat_params = place_on(flat, self._replicated)
+        sharding_tree = jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self._opt_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.opt_state = place_on(state["opt_state"], sharding_tree)
 
     # -- stepping --------------------------------------------------------------
 
