@@ -162,6 +162,16 @@ class TestBandwidthHarness:
         rec = json.loads(lines[0])
         assert rec["n_devices"] == 8 and rec["bus_gbps"] > 0
 
+    @pytest.mark.parametrize(
+        "schedule,compress", [("psum", "bf16"), ("ring", "int8")]
+    )
+    def test_measure_with_compression(self, line8, schedule, compress):
+        rep = measure_allreduce(
+            line8, 4096, iters=2, warmup=1,
+            schedule=schedule, compress=compress,
+        )
+        assert rep.bus_gbps_best > 0
+
 
 class TestMeshHelpers:
     def test_grid_factors(self):
