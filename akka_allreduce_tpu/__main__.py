@@ -808,6 +808,10 @@ def _cmd_train_moe(argv: list[str]) -> int:
     p.add_argument("--ep", type=int, default=1, help="expert-parallel shards")
     p.add_argument("--experts", type=int, default=4)
     p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument(
+        "--topk", type=int, choices=(1, 2), default=1,
+        help="router: 1 = Switch top-1, 2 = GShard top-2",
+    )
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
@@ -841,6 +845,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         n_experts=args.experts,
         seq_len=args.seq_len,
         capacity_factor=args.capacity_factor,
+        router_topk=args.topk,
         learning_rate=args.lr,
     )
     print(

@@ -221,6 +221,7 @@ class MoEBlock(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     expert_axis: str | None = None
     ep_size: int = 1
+    router_topk: int = 1  # 1 = Switch, 2 = GShard top-2
 
     @nn.compact
     def __call__(self, x):
@@ -256,6 +257,7 @@ class MoEBlock(nn.Module):
             n_experts=self.n_experts,
             capacity_factor=self.capacity_factor,
             expert_axis=self.expert_axis if self.ep_size > 1 else None,
+            router_topk=self.router_topk,
         )
         return x + y.reshape(x.shape), aux, dropped
 
@@ -274,6 +276,7 @@ class MoETransformerLM(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     expert_axis: str | None = None
     ep_size: int = 1
+    router_topk: int = 1  # 1 = Switch, 2 = GShard top-2
 
     @nn.compact
     def __call__(self, tokens):
@@ -289,6 +292,7 @@ class MoETransformerLM(nn.Module):
                 compute_dtype=self.compute_dtype,
                 expert_axis=self.expert_axis,
                 ep_size=self.ep_size,
+                router_topk=self.router_topk,
             )(x)
             aux_total = aux_total + aux
             dropped_total = dropped_total + dropped

@@ -34,7 +34,9 @@ class RouteResult(NamedTuple):
     dispatch: jax.Array  # (T, E, C) one-hot token->slot assignment
     combine: jax.Array  # (T, E, C) dispatch scaled by the router gate
     aux_loss: jax.Array  # scalar Switch load-balancing loss
-    dropped: jax.Array  # scalar fraction of tokens past capacity
+    dropped: jax.Array  # fraction of (token, choice) ASSIGNMENTS past
+    # capacity — denominator k*T, so under top-2 a secondary-only drop
+    # contributes half what losing a token entirely would
 
 
 def switch_route(
@@ -45,27 +47,55 @@ def switch_route(
     ``logits``: (T, E) router scores for T tokens over E experts.
     ``capacity``: max tokens per expert (this device's contribution).
     """
+    return topk_route(logits, capacity, k=1)
+
+
+def topk_route(logits: jax.Array, capacity: int, k: int = 2) -> RouteResult:
+    """Top-k routing with static capacity (k=1 -> Switch, k=2 -> GShard).
+
+    Each token is dispatched to its ``k`` highest-scoring experts with gates
+    renormalized over the chosen k. Expert queue slots are assigned rank-
+    major (every token's primary choice takes slots before any secondary
+    choice — the GShard priority discipline), so under capacity pressure
+    secondary assignments drop first. ``dropped`` counts dropped
+    (token, choice) pairs as a fraction of all ``k * T`` assignments.
+    """
     t, e = logits.shape
+    if not 1 <= k <= e:
+        raise ValueError(f"need 1 <= k <= {e} experts, got {k}")
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate = probs.max(axis=-1)  # (T,)
-    idx = probs.argmax(axis=-1)  # (T,)
-    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, E)
-    # position of each token within its expert's queue (0-based)
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (T, E)
-    pos_t = pos.sum(axis=-1)  # (T,)
-    keep = (pos_t < capacity).astype(jnp.float32)
-    slot = jnp.minimum(pos_t, capacity - 1).astype(jnp.int32)
-    dispatch = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(slot, capacity)[:, None, :]
-        * keep[:, None, None]
-    )  # (T, E, C)
-    combine = dispatch * gate[:, None, None]
-    # Switch aux loss: E * sum_e f_e * P_e  (f = fraction routed, P = mean prob)
-    f = onehot.mean(axis=0)
-    p = probs.mean(axis=0)
-    aux = e * jnp.sum(f * p)
-    dropped = 1.0 - keep.mean()
+    gate_vals, idx = lax.top_k(probs, k)  # (T, k)
+    if k == 1:
+        gates = gate_vals  # Switch: raw router probability scales the output
+    else:
+        # GShard: renormalize over the chosen k so the mix sums to 1
+        gates = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9
+        )
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    kept = jnp.float32(0.0)
+    base = jnp.zeros((e,), jnp.float32)  # slots consumed by earlier ranks
+    for r in range(k):
+        onehot = jax.nn.one_hot(idx[:, r], e, dtype=jnp.float32)  # (T, E)
+        # position within this rank's queue, offset by earlier ranks' fill
+        within = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (T, E)
+        pos_t = (within + base[None, :] * onehot).sum(axis=-1)  # (T,)
+        keep = (pos_t < capacity).astype(jnp.float32)
+        slot = jnp.minimum(pos_t, capacity - 1).astype(jnp.int32)
+        d_r = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(slot, capacity)[:, None, :]
+            * keep[:, None, None]
+        )  # (T, E, C)
+        dispatch = dispatch + d_r
+        combine = combine + d_r * gates[:, r, None, None]
+        kept = kept + keep.sum()
+        base = base + onehot.sum(axis=0)
+    # Switch/GShard aux loss on the PRIMARY assignment: E * sum_e f_e * P_e
+    primary = jax.nn.one_hot(idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(primary.mean(axis=0) * probs.mean(axis=0))
+    dropped = 1.0 - kept / (k * t)
     return RouteResult(dispatch, combine, aux, dropped)
 
 
@@ -86,19 +116,24 @@ def moe_dispatch_compute(
     n_experts: int,
     capacity_factor: float = 1.25,
     expert_axis: str | None = None,
+    router_topk: int = 1,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Route ``x`` (T, d) through the expert MLPs; returns (y, aux, dropped).
 
     Expert weights are LOCAL shards: ``w1`` is (E/ep, d, hidden) when
     ``expert_axis`` names an ep-sized mesh axis (run inside shard_map), or the
     full (E, d, hidden) dense form when ``expert_axis`` is None.
+    ``router_topk``: 1 = Switch, 2 = GShard top-2 (capacity scales with k so
+    the same capacity_factor means the same slack per assignment).
     """
     t = x.shape[0]
-    capacity = max(1, -(-int(t * capacity_factor) // n_experts))
+    capacity = max(
+        1, -(-int(t * capacity_factor) * router_topk // n_experts)
+    )
     # routing numerics (softmax/cumsum) stay float32; the heavy einsums below
     # run in x's dtype so bf16 compute flows through the expert path
     logits = x.astype(jnp.float32) @ router_w  # (T, E) — router always full E
-    route = switch_route(logits, capacity)
+    route = topk_route(logits, capacity, k=router_topk)
     w1, b1, w2 = (w.astype(x.dtype) for w in (w1, b1, w2))
     # tokens -> per-expert slots: (E, C, d)
     slots = jnp.einsum("tec,td->ecd", route.dispatch.astype(x.dtype), x)

@@ -30,7 +30,8 @@ class MoEStepMetrics:
     step: int
     loss: float  # masked per-token cross-entropy (aux not included)
     aux_loss: float  # Switch load-balancing loss (global weighted mean)
-    dropped: float  # fraction of tokens past expert capacity (capacity knob)
+    dropped: float  # fraction of routing ASSIGNMENTS past expert capacity
+    # (denominator k*T under top-k) — the capacity_factor tuning knob
     contributors: float  # contributing DP replica rows
 
 
@@ -57,6 +58,7 @@ class MoETrainer:
         n_experts: int = 4,
         seq_len: int = 64,
         capacity_factor: float = 1.25,
+        router_topk: int = 1,
         aux_coef: float = 0.01,
         optimizer: optax.GradientTransformation | None = None,
         learning_rate: float = 1e-2,
@@ -96,6 +98,7 @@ class MoETrainer:
             compute_dtype=compute_dtype,
             expert_axis=self.expert_axis if self.ep > 1 else None,
             ep_size=self.ep,
+            router_topk=router_topk,
         )
         self.tx = optimizer or optax.adam(learning_rate)
 
@@ -108,6 +111,7 @@ class MoETrainer:
             n_experts=n_experts,
             capacity_factor=capacity_factor,
             compute_dtype=compute_dtype,
+            router_topk=router_topk,
         )
         tokens0 = jnp.zeros((1, seq_len), jnp.int32)
         self.params = init_model.init(jax.random.PRNGKey(seed), tokens0)

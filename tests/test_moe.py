@@ -115,3 +115,63 @@ class TestMoEDtypes:
         assert len(hist) == 4
         assert all(np.isfinite(h.loss) for h in hist)
         assert hist[-1].step == 4
+
+
+class TestTop2Routing:
+    """GShard-style top-2: tokens mix their two best experts with
+    renormalized gates; primary choices take queue slots first."""
+
+    def test_top2_dispatches_two_experts_with_normalized_gates(self):
+        import jax
+        import jax.numpy as jnp
+
+        from akka_allreduce_tpu.ops.moe import topk_route
+
+        logits = jnp.array([[2.0, 1.0, -5.0, -5.0]])
+        r = topk_route(logits, capacity=2, k=2)
+        probs = jax.nn.softmax(logits)[0]
+        g0 = float(probs[0] / (probs[0] + probs[1]))
+        assert float(r.combine[0, 0, 0]) == pytest.approx(g0, rel=1e-5)
+        assert float(r.combine[0, 1, 0]) == pytest.approx(1 - g0, rel=1e-5)
+        assert float(r.dispatch.sum()) == 2.0
+        assert float(r.dropped) == 0.0
+
+    def test_primary_choices_take_slots_first(self):
+        import jax.numpy as jnp
+
+        from akka_allreduce_tpu.ops.moe import topk_route
+
+        # both tokens pick expert 0 (primary) then expert 1 (secondary);
+        # with capacity 1 per expert, token 0 claims both single slots
+        # (rank-major priority) and token 1 loses both assignments
+        logits = jnp.array([[3.0, 1.0, -9.0], [3.0, 1.0, -9.0]])
+        r = topk_route(logits, capacity=1, k=2)
+        # expert 0: token 0's primary kept, token 1's dropped (cap 1)
+        assert float(r.dispatch[0, 0, 0]) == 1.0
+        assert float(r.dispatch[1, 0, :].sum()) == 0.0
+        # expert 1: token 0's secondary kept, token 1's dropped (cap 1)
+        assert float(r.dispatch[0, 1, 0]) == 1.0
+        assert float(r.dispatch[1, 1, :].sum()) == 0.0
+        assert float(r.dropped) == pytest.approx(0.5)
+
+    def test_top2_ep_matches_dense(self):
+        kw = dict(KW)
+        t_ep = MoETrainer(
+            mesh((2, 4), ("data", "expert")), router_topk=2, **kw
+        )
+        t_dn = MoETrainer(mesh((8,), ("data",)), router_topk=2, **kw)
+        ds = data.lm_copy_task(32, vocab=16)
+        for i in range(2):
+            x, y = next(ds.batches(8, 1, seed_offset=i))
+            m1 = t_ep.train_step(x, y)
+            m2 = t_dn.train_step(x, y)
+            assert abs(m1.loss - m2.loss) < 1e-4
+        d = np.abs(t_ep.get_flat_params() - t_dn.get_flat_params()).max()
+        assert d < 1e-3, d
+
+    def test_top2_trains(self):
+        t = MoETrainer(mesh((2, 4), ("data", "expert")), router_topk=2, **KW)
+        ds = data.lm_copy_task(32, vocab=16)
+        hist = [t.train_step(x, y) for x, y in ds.batches(8, 15)]
+        assert hist[-1].loss < hist[0].loss
+        assert all(np.isfinite(h.aux_loss) for h in hist)
