@@ -17,10 +17,13 @@ the reduce-scatter and divided by the contributor count after, exactly
 ``comm.allreduce.masked_psum``'s math on each shard.
 
 Numerically identical to ``DPTrainer`` with the same optimizer (verified in
-tests/test_zero1.py). Checkpointing goes through ``TrainerCheckpointer``'s
-trainer-defined protocol (``checkpoint_state``/``restore_checkpoint_state``):
-the flat weight vector and the 1/n optimizer-moment shards serialize as-is
-and restore onto the same mesh size.
+tests/test_zero1.py) — except under ``compress="bf16"``, which runs the
+gradient reduce-scatter in bfloat16 on the wire (half the ICI bytes; weights
+and their all_gather stay float32), trading bit-identity for bandwidth.
+Checkpointing goes through ``TrainerCheckpointer``'s trainer-defined protocol
+(``checkpoint_state``/``restore_checkpoint_state``): the flat weight vector
+and the 1/n optimizer-moment shards serialize as-is and restore onto the
+same mesh size.
 
 Beyond the reference (which has no optimizer-state concept at all); it exists
 here because memory per chip is the binding constraint the framework is built
@@ -65,11 +68,19 @@ class Zero1DPTrainer:
         learning_rate: float = 0.1,
         loss_fn: Callable | None = None,
         seed: int = 0,
+        compress: str | None = None,
     ) -> None:
         if len(mesh.axis_names) != 1:
             raise ValueError(
                 f"zero-1 shards over ONE mesh axis, got {mesh.axis_names}"
             )
+        if compress not in (None, "bf16"):
+            raise ValueError(
+                f"compress must be None or 'bf16', got {compress!r}"
+            )
+        # informational only: the jitted step closes over the constructor
+        # value — mutating this attribute after construction has no effect
+        self.compress = compress
         self.model = model
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
@@ -135,8 +146,15 @@ class Zero1DPTrainer:
 
             loss, gflat = jax.value_and_grad(local_loss)(full)
             gpad = jnp.pad(gflat * v, (0, shard * lax.axis_size(axis) - count))
-            # masked reduce-scatter: my shard of sum_d(v_d * g_d)
-            gshard = lax.psum_scatter(gpad, axis, tiled=True) / denom
+            # masked reduce-scatter: my shard of sum_d(v_d * g_d) — in bf16
+            # on the wire when compressing (weights all_gather stays f32:
+            # compression here is a GRADIENT trade, not a weight truncation)
+            if compress == "bf16":
+                gshard = lax.psum_scatter(
+                    gpad.astype(jnp.bfloat16), axis, tiled=True
+                ).astype(jnp.float32) / denom
+            else:
+                gshard = lax.psum_scatter(gpad, axis, tiled=True) / denom
             # my param shard + my optimizer shard -> updated shard
             my = lax.axis_index(axis)
             pshard = lax.dynamic_slice_in_dim(

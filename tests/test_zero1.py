@@ -119,3 +119,18 @@ def test_zero1_checkpoint_roundtrip(tmp_path, line8):
     m1 = fresh.train_step(*batches[2])
     m2 = t.train_step(*batches[2])
     assert abs(m1.loss - m2.loss) < 1e-6
+
+
+def test_zero1_bf16_wire_close_to_f32(line8):
+    a = _make(Zero1DPTrainer, line8)
+    b = _make(Zero1DPTrainer, line8, compress="bf16")
+    ds = data.mnist_like()
+    for x, y in ds.batches(32, 5):
+        ma = a.train_step(x, y)
+        mb = b.train_step(x, y)
+        assert abs(ma.loss - mb.loss) < 5e-2
+    fa, fb = a.get_flat_params(), b.get_flat_params()
+    scale = np.abs(fa).max()
+    assert np.abs(fa - fb).max() / scale < 5e-2
+    with pytest.raises(ValueError, match="compress"):
+        _make(Zero1DPTrainer, line8, compress="int8")
