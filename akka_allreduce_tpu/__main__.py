@@ -121,10 +121,11 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--compress",
-        choices=("bf16",),
+        choices=("bf16", "int8"),
         default=None,
-        help="sync gradients in bfloat16 on the wire (half the ICI bytes; "
-        "optimizer state stays fp32)",
+        help="gradient wire compression: bf16 halves the collective bytes "
+        "(psum); int8 quarters them (explicit ring, 1D mesh only; "
+        "optimizer state stays fp32 either way)",
     )
     p.add_argument(
         "--error-feedback",
@@ -255,6 +256,11 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
         raise SystemExit(
             "--error-feedback is not supported with --accum > 1 (the "
             "residual is not threaded through the accumulation scan)"
+        )
+    if accum > 1 and getattr(trainer, "compress", None) == "int8":
+        raise SystemExit(
+            "--compress int8 is not supported with --accum > 1 (the "
+            "accumulation path uses the fused psum collective)"
         )
     t0 = time.perf_counter()
     losses = []

@@ -26,7 +26,11 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from akka_allreduce_tpu.binder.api import flatten_pytree
-from akka_allreduce_tpu.comm.allreduce import expand_counts, masked_psum
+from akka_allreduce_tpu.comm.allreduce import (
+    expand_counts,
+    masked_psum,
+    ring_allreduce_sum,
+)
 
 
 @dataclasses.dataclass
@@ -116,10 +120,13 @@ class DPTrainer:
       example_input: one device's worth of input used for ``init``.
       optimizer: optax transform (default: SGD).
       bucket_size: gradient bucket size in elements (None = single fused psum).
-      compress: None | "bf16" — sync gradients in bfloat16 on the wire
-        (halves ICI bytes on the bandwidth-bound grad allreduce; counts and
-        the optimizer state stay float32). Forces the explicit-collective
-        path (one bucket when ``bucket_size`` is None).
+      compress: None | "bf16" | "int8" — gradient wire compression. bf16
+        runs the psum collective at half width; int8 rides the explicit
+        ring schedule with per-segment max-abs scales at a quarter (one
+        mesh axis only; the ring segments by device count, so
+        ``bucket_size`` does not set its wire chunking). Counts and the
+        optimizer state stay float32 either way. Forces the
+        explicit-collective path (one bucket when ``bucket_size`` is None).
       error_feedback: carry each device's quantization residual into its
         next contribution (EF-SGD): ``c = g + e; send cast(c·v);
         e' = c − sent`` — what compression withholds this step is re-sent
@@ -143,15 +150,21 @@ class DPTrainer:
         compress: str | None = None,
         error_feedback: bool = False,
     ) -> None:
-        if compress not in (None, "bf16"):
+        if compress not in (None, "bf16", "int8"):
             raise ValueError(
-                f"compress must be None or 'bf16', got {compress!r} "
-                "(int8 needs per-hop scales: use the ring schedule in comm/)"
+                f"compress must be None, 'bf16' or 'int8', got {compress!r}"
             )
-        if error_feedback and compress is None:
+        if compress == "int8" and len(mesh.axis_names) != 1:
             raise ValueError(
-                "error_feedback compensates COMPRESSION error: it requires "
-                "compress='bf16' (lossless sync has no residual to carry)"
+                "int8 grad sync rides the explicit ring schedule, which "
+                f"reduces over ONE mesh axis; got axes {mesh.axis_names}"
+            )
+        if error_feedback and compress != "bf16":
+            raise ValueError(
+                "error_feedback requires compress='bf16': the bf16 cast "
+                "error is locally computable; the int8 ring re-quantizes "
+                "per hop (no exact local residual), and lossless sync has "
+                "no residual to carry"
             )
         self.model = model
         self.mesh = mesh
@@ -186,6 +199,7 @@ class DPTrainer:
         loss_impl = self._loss
         tx = self.tx
         wire_bf16 = compress == "bf16"
+        n_devices_static = self.n_devices
 
         def explicit_step(params, opt_state, x, y, v, ef):
             """Explicit bucketed collective (the reference's chunked buffer):
@@ -208,15 +222,30 @@ class DPTrainer:
             c = flat if ef is None else flat + ef.reshape(-1)
             b = bucket if bucket is not None else flat.shape[0]
             n_buckets = -(-flat.shape[0] // b)
-            # bf16 wire: masked_psum runs the payload collective at half
-            # width; counts stay float32 (exact at any mesh size)
-            gsum, cnt = masked_psum(
-                c,
-                jnp.full((n_buckets,), v),
-                axis_names,
-                bucket_size=b,
-                wire_dtype=jnp.bfloat16 if wire_bf16 else None,
-            )
+            if compress == "int8":
+                # quarter-width wire: the explicit ring carries int8 hops
+                # with per-segment max-abs scales (comm/allreduce.py); the
+                # ring segments by DEVICE COUNT, so bucket_size only sets
+                # count granularity here, not wire chunking. Counts reuse
+                # the scalar psum already computed above — no extra
+                # collective on the hot path.
+                gsum = ring_allreduce_sum(
+                    c * v.astype(c.dtype),
+                    axis_names[0],
+                    n_devices_static,
+                    compress="int8",
+                )
+                cnt = jnp.full((n_buckets,), scalar_cnt, jnp.float32)
+            else:
+                # bf16 wire: masked_psum runs the payload collective at half
+                # width; counts stay float32 (exact at any mesh size)
+                gsum, cnt = masked_psum(
+                    c,
+                    jnp.full((n_buckets,), v),
+                    axis_names,
+                    bucket_size=b,
+                    wire_dtype=jnp.bfloat16 if wire_bf16 else None,
+                )
             if ef is None:
                 new_ef = None
             else:
@@ -235,7 +264,7 @@ class DPTrainer:
 
         def step(params, opt_state, x, y, valid):
             v = valid.reshape(())
-            if bucket is not None or wire_bf16:
+            if bucket is not None or compress is not None:
                 out = explicit_step(params, opt_state, x, y, v, None)
                 return out[0], out[1], out[3], out[4]
             # Differentiating the v-weighted local loss w.r.t. REPLICATED
@@ -262,6 +291,10 @@ class DPTrainer:
             mesh=mesh,
             in_specs=(P(), P(), data_spec, data_spec, data_spec),
             out_specs=(P(), P(), P(), P()),
+            # the int8 ring's all-gather result IS replicated, but the static
+            # varying-axes check cannot prove it (same caveat as the comm
+            # layer's ring schedules); the f32-equivalence tests are the oracle
+            check_vma=(compress != "int8"),
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
@@ -452,6 +485,11 @@ class DPTrainer:
                 "error_feedback is train_step-only (the residual state is "
                 "not threaded through the accumulation scan)"
             )
+        if self.compress == "int8":
+            raise NotImplementedError(
+                "int8 grad sync is train_step/train_chain-only (the "
+                "accumulation path uses the fused psum collective)"
+            )
         n = self.n_devices * accum_steps
         if x.shape[0] % n:
             raise ValueError(
@@ -514,6 +552,8 @@ class DPTrainer:
             mesh=self.mesh,
             in_specs=(P(), P(), P(), self._data_spec),
             out_specs=(P(), P(), P(), P()),
+            # same int8-ring caveat as the step's shard_map
+            check_vma=(self.compress != "int8"),
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 

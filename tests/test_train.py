@@ -268,7 +268,7 @@ class TestCompressedGradSync:
 
     def test_rejects_unknown_mode(self, line8):
         with pytest.raises(ValueError, match="compress"):
-            self._trainer(line8, compress="int8")
+            self._trainer(line8, compress="fp4")
 
 
 def test_compress_bucketed_accum_masked_combo(line8):
@@ -353,3 +353,66 @@ class TestErrorFeedback:
             t.train_step_accum(x, y, accum_steps=2)
         with pytest.raises(NotImplementedError):
             t.train_chain(ds.device_sampler(), 2, 2)
+
+
+class TestInt8GradSync:
+    """int8 grad sync on the explicit ring: quarter-width wire, per-segment
+    max-abs scales; close to f32, exact counts, guarded combinations."""
+
+    def _make(self, mesh, compress=None, seed=0):
+        import optax
+
+        return DPTrainer(
+            MLP(hidden=(32,), classes=10),
+            mesh,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.sgd(0.1),
+            seed=seed,
+            compress=compress,
+        )
+
+    def test_int8_close_to_f32_and_converges(self, line8):
+        t8 = self._make(line8, "int8")
+        tf = self._make(line8)
+        ds = data.mnist_like()
+        batches = list(ds.batches(64, 10))
+        hist = []
+        for x, y in batches:
+            hist.append(t8.train_step(x, y))
+            tf.train_step(x, y)
+        assert hist[-1].loss < hist[0].loss
+        a, b = t8.get_flat_params(), tf.get_flat_params()
+        scale = np.abs(b).max()
+        assert np.abs(a - b).max() / scale < 0.1
+
+    def test_int8_masked_device(self, line8):
+        t = self._make(line8, "int8")
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[2] = 0.0
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 7.0 and np.isfinite(m.loss)
+
+    def test_int8_chain_works_accum_rejected(self, line8):
+        t = self._make(line8, "int8")
+        ds = data.mnist_like()
+        hist = t.train_chain(ds.device_sampler(), 3, 4)
+        assert len(hist) == 3 and np.isfinite(hist[-1].loss)
+        x, y = next(iter(ds.batches(32, 1)))
+        with pytest.raises(NotImplementedError):
+            t.train_step_accum(x, y, accum_steps=2)
+
+    def test_int8_rejects_grid_mesh_and_ef(self, line8):
+        from akka_allreduce_tpu.parallel import grid_mesh
+
+        with pytest.raises(ValueError, match="ONE mesh axis"):
+            self._make(grid_mesh(2, 4), "int8")
+        with pytest.raises(ValueError, match="error_feedback"):
+            DPTrainer(
+                MLP(hidden=(8,), classes=10),
+                line8,
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                compress="int8",
+                error_feedback=True,
+            )
