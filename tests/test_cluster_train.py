@@ -229,3 +229,82 @@ def test_elastic_cluster_trains_transformer_lm():
         assert gap_after < gap_before, (gap_before, gap_after)
 
     asyncio.run(run())
+
+
+def test_training_survives_master_restart():
+    """The control plane's single point of failure dies MID-TRAINING and a
+    replacement binds the same seed endpoint: nodes rejoin (via the failure
+    counter or the replacement's Rejoin reply to an unknown heartbeat),
+    sync rounds resume, and the learners keep making progress end to end."""
+    from tests.test_remote import wait_until
+
+    async def run():
+        t0, t1 = _trainer(1), _trainer(2)
+        cfg = AllreduceConfig(
+            threshold=ThresholdConfig(1.0, 1.0, 1.0),
+            metadata=MetaDataConfig(
+                data_size=t0.param_count, max_chunk_size=4096
+            ),
+            line_master=LineMasterConfig(round_window=2, max_rounds=-1),
+            master=MasterConfig(
+                node_num=2, dimensions=1, heartbeat_interval_s=0.05
+            ),
+        )
+        master = MasterProcess(cfg, port=0)
+        seed_ep = await master.start()
+        port = seed_ep.port
+        # an effectively-unbounded step budget: the learners must still be
+        # running whenever the replacement comes up, however fast the
+        # machine — the test asserts through the RESUME point, then stops
+        # the nodes itself
+        nodes = [
+            ElasticClusterNode(
+                seed_ep,
+                trainer,
+                iter(data.mnist_like(seed=i).batches(16, 100_000)),
+                preferred_node_id=i,
+            )
+            for i, trainer in enumerate([t0, t1])
+        ]
+        tasks = []
+        try:
+            tasks = [asyncio.ensure_future(n.run(100_000)) for n in nodes]
+            # gate on BOTH sync rounds and actual learner steps (the first
+            # step includes jit compile; sync rounds alone don't prove the
+            # learners are live)
+            await wait_until(
+                lambda: min(n.rounds_applied for n in nodes) >= 3
+                and min(len(n.losses) for n in nodes) >= 3,
+                60.0,
+            )
+            await master.stop()  # master crash mid-training
+            await asyncio.sleep(0.3)  # a few heartbeats bounce
+            master = MasterProcess(cfg, port=port)  # replacement, same seed
+            await master.start()
+            await wait_until(
+                lambda: sorted(master.grid.nodes) == [0, 1], 30.0
+            )
+            marks = [n.rounds_applied for n in nodes]
+            step_marks = [len(n.losses) for n in nodes]
+            # sync rounds AND learner steps RESUME through the replacement
+            await wait_until(
+                lambda: all(
+                    n.rounds_applied > m and len(n.losses) > sm
+                    for n, m, sm in zip(nodes, marks, step_marks)
+                ),
+                30.0,
+            )
+        finally:
+            for task in tasks:
+                task.cancel()
+            # barrier: surface any real node exception and shut node
+            # transports down BEFORE the master's
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await master.stop()
+        for n in nodes:
+            # the learners trained through the outage and beyond; loss
+            # CONVERGENCE is covered by the other cluster-training tests
+            assert len(n.losses) >= 4
+            assert all(np.isfinite(l) for l in n.losses)
+
+    asyncio.run(run())
