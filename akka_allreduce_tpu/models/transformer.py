@@ -222,6 +222,8 @@ class MoEBlock(nn.Module):
     expert_axis: str | None = None
     ep_size: int = 1
     router_topk: int = 1  # 1 = Switch, 2 = GShard top-2
+    seq_axis: str | None = None  # sequence-parallel axis (ring/Ulysses attn)
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -235,7 +237,12 @@ class MoEBlock(nn.Module):
             )
         e_local = self.n_experts // self.ep_size
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        x = x + Attention(self.n_heads, compute_dtype=self.compute_dtype)(h)
+        x = x + Attention(
+            self.n_heads,
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
+            compute_dtype=self.compute_dtype,
+        )(h)
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
         router = self.param(
             "router", nn.initializers.lecun_normal(), (d_model, self.n_experts)
@@ -258,6 +265,7 @@ class MoEBlock(nn.Module):
             capacity_factor=self.capacity_factor,
             expert_axis=self.expert_axis if self.ep_size > 1 else None,
             router_topk=self.router_topk,
+            seq_axis=self.seq_axis,
         )
         return x + y.reshape(x.shape), aux, dropped
 
@@ -277,6 +285,8 @@ class MoETransformerLM(nn.Module):
     expert_axis: str | None = None
     ep_size: int = 1
     router_topk: int = 1  # 1 = Switch, 2 = GShard top-2
+    seq_axis: str | None = None  # sequence-parallel axis (ring/Ulysses attn)
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, tokens):
@@ -293,6 +303,8 @@ class MoETransformerLM(nn.Module):
                 expert_axis=self.expert_axis,
                 ep_size=self.ep_size,
                 router_topk=self.router_topk,
+                seq_axis=self.seq_axis,
+                seq_impl=self.seq_impl,
             )(x)
             aux_total = aux_total + aux
             dropped_total = dropped_total + dropped

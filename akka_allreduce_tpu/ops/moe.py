@@ -117,6 +117,7 @@ def moe_dispatch_compute(
     capacity_factor: float = 1.25,
     expert_axis: str | None = None,
     router_topk: int = 1,
+    seq_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Route ``x`` (T, d) through the expert MLPs; returns (y, aux, dropped).
 
@@ -125,6 +126,10 @@ def moe_dispatch_compute(
     full (E, d, hidden) dense form when ``expert_axis`` is None.
     ``router_topk``: 1 = Switch, 2 = GShard top-2 (capacity scales with k so
     the same capacity_factor means the same slack per assignment).
+    ``seq_axis``: under sequence parallelism the aux statistics (fraction
+    routed, mean router prob) are psum-averaged over the seq shards, so the
+    load-balancing loss is computed over the GLOBAL token population — the
+    bilinear E·Σf·p of per-shard means would depend on the partition.
     """
     t = x.shape[0]
     capacity = max(
@@ -134,6 +139,16 @@ def moe_dispatch_compute(
     # run in x's dtype so bf16 compute flows through the expert path
     logits = x.astype(jnp.float32) @ router_w  # (T, E) — router always full E
     route = topk_route(logits, capacity, k=router_topk)
+    aux = route.aux_loss
+    if seq_axis is not None:
+        probs = jax.nn.softmax(logits, axis=-1)
+        primary = jax.nn.one_hot(
+            jnp.argmax(probs, axis=-1), n_experts, dtype=jnp.float32
+        )
+        t_global = lax.psum(jnp.float32(t), seq_axis)
+        f = lax.psum(primary.sum(axis=0), seq_axis) / t_global
+        p = lax.psum(probs.sum(axis=0), seq_axis) / t_global
+        aux = n_experts * jnp.sum(f * p)
     w1, b1, w2 = (w.astype(x.dtype) for w in (w1, b1, w2))
     # tokens -> per-expert slots: (E, C, d)
     slots = jnp.einsum("tec,td->ecd", route.dispatch.astype(x.dtype), x)
@@ -158,4 +173,4 @@ def moe_dispatch_compute(
             outbound, expert_axis, split_axis=0, concat_axis=0, tiled=True
         )  # back at the source device, (E, C, d)
     y = jnp.einsum("tec,ecd->td", route.combine.astype(x.dtype), ys)
-    return y, route.aux_loss, route.dropped
+    return y, aux, route.dropped

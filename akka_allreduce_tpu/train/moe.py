@@ -1,9 +1,11 @@
-"""Expert-parallel MoE trainer: DP x EP over a (data, expert) mesh.
+"""Expert-parallel MoE trainer: DP x EP over a (data, expert) mesh, or
+DP x SP x EP over (data, seq, expert) with ring/Ulysses attention.
 
 Beyond-parity capability (the reference is DP-only, SURVEY.md §3). The dense
-non-MoE parts treat BOTH mesh axes as data parallelism — the global batch is
-sharded over data x expert jointly — while each MoE layer's all_to_all pair
-(ops/moe.py) rides the ``expert`` axis. Gradient plumbing reuses the
+non-MoE parts treat the data and expert axes as data parallelism — the
+global batch's rows shard over data x expert jointly (and its sequence over
+``seq`` when present) — while each MoE layer's all_to_all pair (ops/moe.py)
+rides the ``expert`` axis. Gradient plumbing reuses the
 framework's one mechanism: expert weights enter shard_map device-varying on
 ``expert`` (ep_param_specs), so shard_map autodiff psums their grads over
 ``data`` only; replicated leaves psum over both axes — the threshold-masked
@@ -39,11 +41,18 @@ class MoETrainer:
     """DP (x EP) trainer for :class:`~akka_allreduce_tpu.models.MoETransformerLM`.
 
     Args:
-      mesh: a 1-axis (data,) mesh for dense MoE, or a 2-axis (data, expert)
-        mesh for expert parallelism (``parallel.grid_mesh`` with those axis
-        names, or any mesh whose second axis size divides ``n_experts``).
-      seq_len: per-sample sequence length (not sharded — compose with
-        LongContextTrainer's seq axis is future work).
+      mesh: a 1-axis (data,) mesh for dense MoE, a 2-axis (data, expert)
+        mesh for expert parallelism, or a 3-axis (data, seq, expert) mesh
+        composing sequence parallelism with EP — ring/Ulysses attention
+        shards the sequence over ``seq`` while each MoE layer's all_to_all
+        rides ``expert``. Routing stays per-device, so expert capacity is
+        computed over LOCAL tokens (T/sp per device), while the aux
+        load-balancing statistics are psum-averaged over the seq shards —
+        so with ample ``capacity_factor`` the whole step is exactly
+        partition-independent (the tests' oracle); under capacity pressure,
+        drops depend on the sharding, as in any capacity-based MoE system.
+      seq_len: GLOBAL per-sample sequence length (divisible by the seq
+        axis size when present).
       aux_coef: weight of the Switch load-balancing loss.
     """
 
@@ -59,6 +68,7 @@ class MoETrainer:
         seq_len: int = 64,
         capacity_factor: float = 1.25,
         router_topk: int = 1,
+        seq_impl: str = "ring",
         aux_coef: float = 0.01,
         optimizer: optax.GradientTransformation | None = None,
         learning_rate: float = 1e-2,
@@ -70,20 +80,34 @@ class MoETrainer:
             ep_param_specs,
         )
 
-        if len(mesh.axis_names) not in (1, 2):
+        if len(mesh.axis_names) not in (1, 2, 3):
             raise ValueError(
-                f"need a (data[, expert]) mesh, got axes {mesh.axis_names}"
+                f"need a (data[, expert] | data, seq, expert) mesh, got "
+                f"axes {mesh.axis_names}"
             )
         self.mesh = mesh
         self.data_axis = mesh.axis_names[0]
-        self.expert_axis = (
-            mesh.axis_names[1] if len(mesh.axis_names) == 2 else None
-        )
+        if len(mesh.axis_names) == 3:
+            # (data, seq, expert): sequence parallelism composed with EP —
+            # ring/Ulysses attention over `seq`, expert all_to_all over
+            # `expert`, the dense parts data-parallel over data x expert
+            self.seq_axis = mesh.axis_names[1]
+            self.expert_axis = mesh.axis_names[2]
+        else:
+            self.seq_axis = None
+            self.expert_axis = (
+                mesh.axis_names[1] if len(mesh.axis_names) == 2 else None
+            )
         self.dp = int(mesh.shape[self.data_axis])
+        self.sp = int(mesh.shape[self.seq_axis]) if self.seq_axis else 1
         self.ep = int(mesh.shape[self.expert_axis]) if self.expert_axis else 1
         if n_experts % self.ep:
             raise ValueError(f"{n_experts=} not divisible by ep={self.ep}")
-        self.n_devices = self.dp * self.ep
+        if seq_len % self.sp:
+            raise ValueError(
+                f"{seq_len=} not divisible by seq shards {self.sp}"
+            )
+        self.n_devices = self.dp * self.sp * self.ep
         self.data_shards = self.dp
         self.seq_len = seq_len
         self.vocab = vocab
@@ -99,6 +123,8 @@ class MoETrainer:
             expert_axis=self.expert_axis if self.ep > 1 else None,
             ep_size=self.ep,
             router_topk=router_topk,
+            seq_axis=self.seq_axis if self.sp > 1 else None,
+            seq_impl=seq_impl,
         )
         self.tx = optimizer or optax.adam(learning_rate)
 
@@ -113,7 +139,7 @@ class MoETrainer:
             compute_dtype=compute_dtype,
             router_topk=router_topk,
         )
-        tokens0 = jnp.zeros((1, seq_len), jnp.int32)
+        tokens0 = jnp.zeros((1, seq_len // self.sp), jnp.int32)
         self.params = init_model.init(jax.random.PRNGKey(seed), tokens0)
         self.opt_state = self.tx.init(self.params)
         self.param_count = int(
@@ -145,24 +171,26 @@ class MoETrainer:
         )
 
         axis_names = tuple(mesh.axis_names)
-        batch_spec = P(
-            axis_names if len(axis_names) > 1 else axis_names[0]
-        )
+        if self.seq_axis is not None:
+            # rows over data x expert, the sequence dim over seq
+            batch_spec = P((self.data_axis, self.expert_axis), self.seq_axis)
+        elif len(axis_names) > 1:
+            batch_spec = P(axis_names)
+        else:
+            batch_spec = P(axis_names[0])
         self._data_sharding = NamedSharding(mesh, batch_spec)
         self._valid_sharding = NamedSharding(mesh, P(self.data_axis))
         data_axis = self.data_axis
-        expert_axis = self.expert_axis
+        vary_axes = tuple(n for n in axis_names if n != data_axis)
         model_apply = self.model.apply
         tx = self.tx
         aux_coef = self.aux_coef
 
         def step(params, opt_state, x, y, valid):
             v0 = valid.reshape(())
-            v = (
-                lax.pcast(v0, expert_axis, to="varying")
-                if expert_axis is not None
-                else v0
-            )
+            v = v0
+            for ax in vary_axes:
+                v = lax.pcast(v, ax, to="varying")
             tokens_local = jnp.float32(x.shape[0] * x.shape[1])
             denom = jnp.maximum(lax.psum(v * tokens_local, axis_names), 1.0)
 
@@ -219,10 +247,11 @@ class MoETrainer:
     ) -> MoEStepMetrics:
         """One step on a GLOBAL (batch, seq_len) token array; batch divisible
         by dp * ep. ``valid``: per-DP-replica-row mask of shape (dp,)."""
-        if tokens.shape[0] % self.n_devices:
+        row_shards = self.dp * self.ep  # rows shard over data x expert only
+        if tokens.shape[0] % row_shards:
             raise ValueError(
                 f"global batch {tokens.shape[0]} not divisible by "
-                f"{self.n_devices} devices"
+                f"{row_shards} row shards (data x expert)"
             )
         if tokens.shape[1] != self.seq_len:
             raise ValueError(
@@ -310,6 +339,12 @@ class MoETrainer:
         ``SyntheticCopyLM.device_sampler``); each device draws its own
         stream, so the loop does zero host I/O.
         """
+        if self.sp > 1:
+            raise NotImplementedError(
+                "train_chain is not implemented for the (data, seq, expert) "
+                "mesh (the sampler would need per-seq-shard column slicing); "
+                "use train_step"
+            )
         from akka_allreduce_tpu.train.trainer import run_chain_cached
 
         losses, auxes, droppeds, cnts = run_chain_cached(
