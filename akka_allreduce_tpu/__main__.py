@@ -803,8 +803,9 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
 def _cmd_train_moe(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         "train-moe",
-        description="Switch-MoE LM with expert parallelism: DP x EP over a "
-        "(data, expert) mesh (no analog in the reference — SURVEY.md §3)",
+        description="MoE LM with expert parallelism: DP x EP over a "
+        "(data, expert) mesh, or DP x SP x EP with --sp (no analog in the "
+        "reference — SURVEY.md §3)",
     )
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch", type=int, default=8, help="global batch size")
@@ -812,6 +813,14 @@ def _cmd_train_moe(argv: list[str]) -> int:
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--dp", type=int, default=None, help="data-parallel rows")
     p.add_argument("--ep", type=int, default=1, help="expert-parallel shards")
+    p.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel shards (3-axis data x seq x expert mesh)",
+    )
+    p.add_argument(
+        "--impl", choices=("ring", "ulysses"), default="ring",
+        help="attention schedule over the seq axis (with --sp > 1)",
+    )
     p.add_argument("--experts", type=int, default=4)
     p.add_argument("--capacity-factor", type=float, default=1.25)
     p.add_argument(
@@ -829,19 +838,30 @@ def _cmd_train_moe(argv: list[str]) -> int:
         "I/O per step)",
     )
     args = p.parse_args(argv)
+    if args.device_data and args.sp > 1:
+        p.error(
+            "--device-data is not supported with --sp > 1 (the chain "
+            "sampler has no per-seq-shard column slicing)"
+        )
 
     import jax
 
     from akka_allreduce_tpu.models import data
+    from akka_allreduce_tpu.parallel import data_seq_model_mesh
     from akka_allreduce_tpu.train import MoETrainer
 
     devs = jax.devices()
-    dp = args.dp or (len(devs) // args.ep)
-    mesh = jax.make_mesh(
-        (dp, args.ep), ("data", "expert"), devices=devs[: dp * args.ep]
-    ) if args.ep > 1 else jax.make_mesh(
-        (dp,), ("data",), devices=devs[:dp]
-    )
+    dp = args.dp or max(1, len(devs) // (args.ep * args.sp))
+    if args.sp > 1:
+        mesh = data_seq_model_mesh(
+            dp, args.sp, args.ep, axes=("data", "seq", "expert")
+        )
+    elif args.ep > 1:
+        mesh = jax.make_mesh(
+            (dp, args.ep), ("data", "expert"), devices=devs[: dp * args.ep]
+        )
+    else:
+        mesh = jax.make_mesh((dp,), ("data",), devices=devs[:dp])
     trainer = MoETrainer(
         mesh,
         vocab=args.vocab,
@@ -852,11 +872,13 @@ def _cmd_train_moe(argv: list[str]) -> int:
         seq_len=args.seq_len,
         capacity_factor=args.capacity_factor,
         router_topk=args.topk,
+        seq_impl=args.impl,
         learning_rate=args.lr,
     )
     print(
         f"MoE params: {trainer.param_count / 1e6:.2f}M "
-        f"({args.experts} experts), mesh dp={trainer.dp} x ep={trainer.ep}"
+        f"({args.experts} experts), mesh dp={trainer.dp} x sp={trainer.sp} "
+        f"x ep={trainer.ep}"
     )
     if args.steps <= 0:
         return 0
