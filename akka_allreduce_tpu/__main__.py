@@ -937,6 +937,12 @@ def _cmd_train_pp(argv: list[str]) -> int:
         help="sample batches ON DEVICE inside one jitted chain (no host "
         "I/O per step)",
     )
+    p.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialize each layer on backward (jax.checkpoint): "
+        "stage activation memory drops from layers_per_stage to 1 layer",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -958,6 +964,7 @@ def _cmd_train_pp(argv: list[str]) -> int:
         microbatches=args.microbatches,
         seq_len=args.seq_len,
         learning_rate=args.lr,
+        remat=args.remat,
     )
     print(
         f"PP params: {trainer.param_count / 1e6:.2f}M "

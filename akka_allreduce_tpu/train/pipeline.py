@@ -87,6 +87,7 @@ class PipelineLMTrainer:
         learning_rate: float = 1e-2,
         seed: int = 0,
         compute_dtype=jnp.float32,
+        remat: bool = False,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import Block
 
@@ -170,11 +171,16 @@ class PipelineLMTrainer:
         head_apply = head.apply
 
         def run_stage(trunk_local, h):
-            """Apply this stage's layers_per_stage blocks sequentially."""
+            """Apply this stage's layers_per_stage blocks sequentially;
+            with ``remat`` each layer recomputes on backward (jax.checkpoint)
+            so a stage holds one layer's activations, not layers_per_stage —
+            the memory knob for deep stages and long sequences."""
 
             def body(carry, layer_p):
                 return block_apply({"params": layer_p}, carry), None
 
+            if remat:
+                body = jax.checkpoint(body)
             out, _ = lax.scan(body, h, trunk_local)
             return out
 

@@ -90,3 +90,19 @@ class TestPipelineParallel:
         sampler = data.lm_copy_task(32, vocab=16).device_sampler()
         with pytest.raises(ValueError, match="microbatches"):
             t.train_chain(sampler, steps=2, rows_per_replica=3)
+
+    def test_remat_matches_plain(self):
+        t_r = PipelineLMTrainer(
+            mesh(2, 4), layers_per_stage=2, remat=True, **KW
+        )
+        t_p = PipelineLMTrainer(mesh(2, 4), layers_per_stage=2, **KW)
+        ds = data.lm_copy_task(32, vocab=16)
+        for i in range(2):
+            x, y = next(ds.batches(8, 1, seed_offset=i))
+            m1 = t_r.train_step(x, y)
+            m2 = t_p.train_step(x, y)
+            assert abs(m1.loss - m2.loss) < 1e-5
+        # recompute reassociation + adam: tight, not bitwise
+        np.testing.assert_allclose(
+            t_r.get_flat_params(), t_p.get_flat_params(), rtol=1e-4, atol=1e-5
+        )
