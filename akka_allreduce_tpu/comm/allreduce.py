@@ -358,7 +358,11 @@ def build_threshold_allreduce(
                     else _DEF_SEG_ROWS
                 )
                 total = pallas_ring_allreduce_sum(
-                    vx, axis_names[0], n_devices, seg_rows=seg_rows
+                    vx, axis_names[0], n_devices, seg_rows=seg_rows,
+                    # decide interpret mode by the MESH's platform, not the
+                    # process default backend: with the TPU plugin loaded a
+                    # virtual CPU mesh still reports default_backend()=="tpu"
+                    interpret=mesh.devices.flat[0].platform != "tpu",
                 )
             else:
                 total = ring_allreduce_sum(

@@ -1,0 +1,43 @@
+"""Which platform will a Pallas kernel actually lower onto?
+
+``jax.default_backend()`` is the wrong signal whenever data lives on devices
+of a *non-default* platform — e.g. a TPU plugin is loaded (default backend
+"tpu") but the computation runs on a virtual CPU mesh. Compiled-mode Pallas
+TPU kernels then lower onto CPU and fail outright
+(``Only interpret mode is supported on CPU backend``).
+
+The reliable signals, in order of preference:
+
+1. the mesh's device platform — callers that hold a ``Mesh`` (comm.allreduce)
+   pass ``mesh.devices.flat[0].platform`` explicitly;
+2. the platform of a concrete input array's committed device — available for
+   the single-device ops (local_reduce, local_attention) when called eagerly;
+3. ``jax.default_backend()`` — the only thing left for tracers inside
+   ``jit``/``shard_map``; correct whenever the enclosing jit targets the
+   default platform (which the test conftest and dryrun guarantee by forcing
+   ``jax_platforms=cpu`` before any backend touch).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def data_platform(*arrays) -> str:
+    """Platform the given arrays live on, else the default backend."""
+    for x in arrays:
+        devices_fn = getattr(x, "devices", None)
+        if devices_fn is None:
+            continue  # numpy input: no device
+        try:
+            devs = devices_fn()
+        except Exception:
+            continue  # tracer: .devices exists but raises when called
+        if devs:
+            return next(iter(devs)).platform
+    return jax.default_backend()
+
+
+def interpret_default(*arrays) -> bool:
+    """True when a Pallas TPU kernel must run in interpret mode."""
+    return data_platform(*arrays) != "tpu"

@@ -112,7 +112,9 @@ def flash_shapes_ok(t: int, d: int) -> bool:
 
 def _flash_ok(q: jax.Array, k: jax.Array, q_offset, k_offset) -> bool:
     """Shape/placement gate for the Pallas TPU flash kernel."""
-    if jax.default_backend() != "tpu":
+    from akka_allreduce_tpu.ops._platform import interpret_default
+
+    if interpret_default(q, k):
         return False
     if not (isinstance(q_offset, int) and q_offset == 0):
         return False

@@ -41,8 +41,7 @@ LANE = 128
 _DEF_ROWS = 512  # 512*128 fp32 = 256 KB per K-slice tile (measured sweet spot)
 
 
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+from akka_allreduce_tpu.ops._platform import interpret_default as _interpret_default
 
 
 def _pad_to_tiles(x: jax.Array, rows: int) -> tuple[jax.Array, int]:
@@ -115,7 +114,7 @@ def masked_average(
       ``(avg, count)``: ``avg[i] = sum_k v_k x_k[i] / max(count, 1)``.
     """
     if interpret is None:
-        interpret = _interpret_default()
+        interpret = _interpret_default(x)
     return _masked_average_impl(
         x, valid, rows=rows, interpret=bool(interpret)
     )
@@ -196,7 +195,7 @@ def elastic_average_step(
     docstring).
     """
     if interpret is None:
-        interpret = _interpret_default()
+        interpret = _interpret_default(x)
     if x.ndim == 3:
         if x.shape[2] != LANE or x.shape[1] % rows:
             raise ValueError(

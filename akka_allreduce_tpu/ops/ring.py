@@ -125,12 +125,19 @@ def pallas_ring_allreduce_sum(
     ``interpret`` defaults to True off-TPU (the Pallas TPU interpreter), so
     the same kernel is testable on the CPU mesh; ``detect_races=True`` turns
     on the interpreter's race detector (tests only — it is slow).
+
+    Callers that know their mesh (comm.allreduce) pass ``interpret``
+    explicitly from the mesh's device platform: ``jax.default_backend()`` is
+    the wrong signal when a TPU plugin is present but the mesh is a virtual
+    CPU one — compiled-mode Pallas would then lower onto CPU and fail.
     """
     n = axis_size
     if n == 1:
         return x
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from akka_allreduce_tpu.ops._platform import interpret_default
+
+        interpret = interpret_default(x)
     data = x.shape[0]
     bucket = n * seg_rows * LANE
     n_buckets = max(1, -(-data // bucket))
