@@ -15,6 +15,8 @@ Usage: ``python -m akka_allreduce_tpu bench-suite [--out FILE] [--quick]``.
 from __future__ import annotations
 
 import json
+import math
+import os
 import time
 from typing import Any, Callable
 
@@ -171,13 +173,12 @@ def _xla_allreduce_record(
 
     Xt = pack_tiles(X)
     sync(Xt)
-    # Scale the lo->hi trip delta to ~150ms of device time (estimated from
-    # read+write traffic at ~300 GB/s): tunnel RTT jitter is O(ms), so a
-    # small delta drowns in it and can report impossible (> HBM peak)
-    # bandwidths. bench.py uses the same slope discipline with delta=100.
-    est_iter_s = 2.0 * floats * 4 / 300e9
+    # Modest static spread; median_slope's target_signal_s rescale owns the
+    # real scaling (it measures the actual throughput, which matters when
+    # the working set turns out VMEM-resident and runs ~8x faster than any
+    # static HBM-speed estimate).
     trips_lo = 3
-    trips_hi = trips_lo + max(100, int(0.25 / max(est_iter_s, 1e-6)))
+    trips_hi = trips_lo + 100
 
     def timed(trips):
         t0 = time.perf_counter()
@@ -185,16 +186,10 @@ def _xla_allreduce_record(
         sync(out)
         return time.perf_counter() - t0
 
-    timed(trips_lo)  # compile + warm both trip counts
-    timed(trips_hi)
-    # ALTERNATE lo/hi samples (not two blocks): tunnel congestion drifts on
-    # the seconds scale, and min-pairing only cancels it when both trip
-    # counts sample the same conditions
-    lows, highs = [], []
-    for _ in range(4):
-        lows.append(timed(trips_lo))
-        highs.append(timed(trips_hi))
-    dt = (min(highs) - min(lows)) / (trips_hi - trips_lo)
+    from akka_allreduce_tpu.utils.benchmarking import median_slope
+
+    est = median_slope(timed, trips_lo, trips_hi, outer=6, target_signal_s=0.3)
+    dt = est.seconds_per_iter
     gbps = K * per * 4 / dt / 1e9 if dt > 0 else 0.0
     working_set_mb = Xt.size * 4 / 1e6
     # When the aliased loop carry fits in VMEM (~128 MiB on v5e), the whole
@@ -202,14 +197,23 @@ def _xla_allreduce_record(
     # measured ~1.4 TB/s at 25M floats vs ~330 GB/s HBM-bound at 64M.
     # (Verified linear in trip count, so it is throughput, not mis-timing.)
     vmem_resident = working_set_mb < 110
+    max_spread = float(os.environ.get("BENCH_MAX_SPREAD_PCT", 15.0))
+    if dt <= 0:
+        suffix = "_UNMEASURABLE"
+    elif est.noisy(max_spread):
+        suffix = "_NOISY"
+    else:
+        suffix = ""
     return _record(
         config,
-        name,
+        name + suffix,
         devices=1,
         virtual_workers=K,
         floats=floats,
         working_set_mb=round(working_set_mb, 1),
-        seconds_best=round(dt, 6),
+        seconds_per_iter=round(dt, 6),
+        # None (JSON null), not Infinity: inf is not interchange-safe JSON
+        spread_pct=est.spread_pct if math.isfinite(est.spread_pct) else None,
         reduce_gbps=round(gbps, 2),
         vs_baseline=round(gbps / REFERENCE_GBPS, 1),
         path="single_chip_fused_reduce"
@@ -259,18 +263,35 @@ def config3_mlp_step(steps: int = 20, batch_per_device: int = 16) -> dict:
 
     # on-device chain: data sampled inside the jitted scan, so per-step time
     # excludes host I/O entirely — slope between two chain lengths cancels
-    # the constant dispatch/transfer overhead
+    # the constant dispatch/transfer overhead. Chain length is a STATIC scan
+    # length (recompiles per value), so use a wide fixed spread rather than
+    # median_slope's autoscale: the 20000-step delta puts the device signal
+    # (~0.4 s at ~20us/step on v5e) well above tunnel jitter, and scan
+    # compile time is length-independent. fetch_metrics=False keeps the
+    # O(steps) metric fetch/conversion out of the timed window (it is linear
+    # in steps, so the slope would keep it, not cancel it); the 4-byte sync
+    # is the same trick the other configs use.
+    import jax
+    import jax.numpy as jnp
+
+    from akka_allreduce_tpu.utils.benchmarking import median_slope
+
     sampler = ds.device_sampler()
-    lo_steps, hi_steps = 20, 220
-    trainer.train_chain(sampler, lo_steps, batch_per_device)  # compile lo
-    trainer.train_chain(sampler, hi_steps, batch_per_device)  # compile hi
-    t0 = time.perf_counter()
-    trainer.train_chain(sampler, lo_steps, batch_per_device)
-    t_lo = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    chain_hist = trainer.train_chain(sampler, hi_steps, batch_per_device)
-    t_hi = time.perf_counter() - t0
-    device_step_ms = (t_hi - t_lo) / (hi_steps - lo_steps) * 1e3
+    lo_steps, hi_steps = 20, 20020
+    last_losses = []
+
+    def timed_chain(steps: int) -> float:
+        t0 = time.perf_counter()
+        losses_arr, _ = trainer.train_chain(
+            sampler, steps, batch_per_device, fetch_metrics=False
+        )
+        jax.device_get(jnp.ravel(losses_arr)[:1])  # 4-byte sync
+        last_losses[:] = [losses_arr]
+        return time.perf_counter() - t0
+
+    chain_est = median_slope(timed_chain, lo_steps, hi_steps, outer=4)
+    device_step_ms = chain_est.seconds_per_iter * 1e3
+    chain_loss_last = float(np.asarray(jax.device_get(last_losses[0]))[-1])
 
     return _record(
         3,
@@ -280,7 +301,10 @@ def config3_mlp_step(steps: int = 20, batch_per_device: int = 16) -> dict:
         global_batch=batch,
         step_ms=round(dt * 1e3, 2),
         device_step_ms=round(device_step_ms, 3),
-        chain_loss_last=round(chain_hist[-1].loss, 4),
+        device_step_spread_pct=(
+            chain_est.spread_pct if math.isfinite(chain_est.spread_pct) else None
+        ),
+        chain_loss_last=round(chain_loss_last, 4),
         loss_first=round(losses[0], 4),
         loss_last=round(losses[-1], 4),
         path="xla_dp_step",

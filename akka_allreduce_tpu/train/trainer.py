@@ -67,7 +67,8 @@ def run_chain_cached(
     n_valid: int,
     valid_sharding,
     seed: int,
-) -> tuple[np.ndarray, ...]:
+    fetch_metrics: bool = True,
+) -> tuple:
     """Shared ``train_chain`` scaffolding for every trainer.
 
     - chain cache keyed on the shape config ``(steps, rows)`` with the
@@ -96,6 +97,11 @@ def run_chain_cached(
         trainer.params, trainer.opt_state, key, vd
     )
     trainer.params, trainer.opt_state = out[0], out[1]
+    if not fetch_metrics:
+        # raw device arrays: benchmarks time the chain without the O(steps)
+        # metric fetch (the device_get payload grows linearly with steps and
+        # would ride on the timing slope instead of cancelling)
+        return out[2:]
     return tuple(np.asarray(jax.device_get(o)) for o in out[2:])
 
 
@@ -565,6 +571,7 @@ class DPTrainer:
         *,
         valid: Sequence[float] | None = None,
         seed: int = 0,
+        fetch_metrics: bool = True,
     ) -> list[TrainStepMetrics]:
         """Run ``steps`` DP steps entirely on device in ONE dispatch.
 
@@ -573,13 +580,17 @@ class DPTrainer:
         batch shard per step, so no host->device transfer happens inside the
         loop — the data-loader discipline for tunneled/remote chips where a
         per-step host round trip costs more than the step itself.
+
+        ``fetch_metrics=False`` returns the raw ``(losses, counts)`` device
+        arrays instead of a metrics list — for benchmarks that must keep the
+        O(steps) host fetch/conversion out of their timed window.
         """
         if self.error_feedback:
             raise NotImplementedError(
                 "error_feedback is train_step-only (the residual state is "
                 "not threaded through the chain scan)"
             )
-        losses, cnts = run_chain_cached(
+        result = run_chain_cached(
             self,
             sampler,
             steps,
@@ -589,7 +600,12 @@ class DPTrainer:
             self.n_devices,
             self._data_sharding,
             seed,
+            fetch_metrics=fetch_metrics,
         )
+        if not fetch_metrics:
+            self.step_num += steps  # keep the data stream advancing
+            return result
+        losses, cnts = result
         out = []
         for loss, cnt in zip(losses, cnts):
             self.step_num += 1
