@@ -572,7 +572,7 @@ class DPTrainer:
         valid: Sequence[float] | None = None,
         seed: int = 0,
         fetch_metrics: bool = True,
-    ) -> list[TrainStepMetrics]:
+    ) -> list[TrainStepMetrics] | tuple:
         """Run ``steps`` DP steps entirely on device in ONE dispatch.
 
         ``sampler`` is a traced ``(key, batch_size) -> (x, y)`` (e.g.

@@ -1,0 +1,80 @@
+"""Subprocess body for the multiprocess ``jax.distributed`` CPU test.
+
+Each process plays one "host" of a pod (SURVEY.md §5: multiprocess
+``jax.distributed`` CPU runs): 4 virtual CPU devices per process, a real
+coordinator on loopback, a global (n_procs*4)-device line mesh, and ONE
+cross-process threshold_allreduce checked against the numpy masked-mean
+oracle. Not a pytest file — launched by tests/test_multihost.py.
+
+Usage: python tests/multihost_worker.py <process_id> <num_processes> <port>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+LOCAL_DEVICES = 4
+
+
+def main() -> None:
+    process_id, num_processes, port = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from akka_allreduce_tpu.comm.allreduce import threshold_allreduce
+    from akka_allreduce_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes, jax.process_count()
+    n = len(jax.devices())
+    assert n == LOCAL_DEVICES * num_processes, n
+    assert len(jax.local_devices()) == LOCAL_DEVICES
+
+    mesh = multihost.global_line_mesh()
+
+    # Deterministic global payload known to every process; each passes ONLY
+    # its host-local rows through host_local_to_global (the pod data path).
+    rng = np.random.default_rng(0)
+    xs_global = rng.standard_normal((n, 1024)).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    mask[-1] = 0.0  # one straggler masked out (threshold semantics)
+
+    lo, hi = process_id * LOCAL_DEVICES, (process_id + 1) * LOCAL_DEVICES
+    xs = multihost.host_local_to_global(xs_global[lo:hi], mesh, P("line"))
+    valid = multihost.host_local_to_global(mask[lo:hi], mesh, P("line"))
+
+    res = threshold_allreduce(mesh, xs, valid)
+    avg = np.asarray(jax.device_get(res.average()))  # output replicated
+    oracle = (xs_global * mask[:, None]).sum(0) / mask.sum()
+    np.testing.assert_allclose(avg, oracle, rtol=1e-5, atol=1e-6)
+
+    # control-plane helper: every process contributes its id
+    gathered = multihost.process_allgather(np.int32(process_id))
+    assert sorted(np.asarray(gathered).ravel().tolist()) == list(
+        range(num_processes)
+    ), gathered
+
+    print(f"MULTIHOST_OK {process_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
