@@ -157,11 +157,6 @@ def _run_training_chain(trainer, ds, args, *, label: str) -> int:
             "--accum is not supported with --device-data (the on-device "
             "chain samples fixed per-device batches); drop one of the flags"
         )
-    if getattr(trainer, "error_feedback", False):
-        raise SystemExit(
-            "--error-feedback is not supported with --device-data (the "
-            "residual is not threaded through the chain scan); drop one"
-        )
     profile = contextlib.nullcontext()
     if getattr(args, "profile_dir", None):
         import jax
@@ -252,11 +247,6 @@ def _run_training(trainer, ds, args, *, label: str) -> int:
     accum = getattr(args, "accum", 1)
     if accum < 1:
         raise SystemExit(f"--accum must be >= 1, got {accum}")
-    if accum > 1 and getattr(trainer, "error_feedback", False):
-        raise SystemExit(
-            "--error-feedback is not supported with --accum > 1 (the "
-            "residual is not threaded through the accumulation scan)"
-        )
     if accum > 1 and getattr(trainer, "compress", None) == "int8":
         raise SystemExit(
             "--compress int8 is not supported with --accum > 1 (the "

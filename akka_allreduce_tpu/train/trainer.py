@@ -40,6 +40,19 @@ class TrainStepMetrics:
     contributors: float
 
 
+def ef_fold(flat: jax.Array, ef) -> jax.Array:
+    """Fold the EF residual into this step's contribution: ``c = g + e``."""
+    return flat if ef is None else flat + ef.reshape(-1)
+
+
+def ef_residual(c: jax.Array, v: jax.Array, ef) -> jax.Array:
+    """``e' = c - sent`` where ``sent`` mirrors masked_psum's mask-then-cast
+    EXACTLY (what the bf16 collective actually summed from this device) —
+    all of ``c`` carries forward when the device was masked out."""
+    sent = (c * v).astype(jnp.bfloat16).astype(jnp.float32)
+    return (c - sent).reshape(ef.shape)
+
+
 def default_classification_loss():
     """Mean softmax cross-entropy over integer labels (the trainers' default)."""
     return lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
@@ -68,6 +81,7 @@ def run_chain_cached(
     valid_sharding,
     seed: int,
     fetch_metrics: bool = True,
+    extra_state: tuple = (),
 ) -> tuple:
     """Shared ``train_chain`` scaffolding for every trainer.
 
@@ -80,9 +94,11 @@ def run_chain_cached(
     - the PRNG key folds in ``step_num`` so consecutive chain calls continue
       the data stream instead of replaying the same batches.
 
-    The built chain must have signature ``(params, opt_state, key, valid) ->
-    (params, opt_state, *metric_arrays)``; the new state is swapped into the
-    trainer here and the stacked metric arrays are returned as host numpy.
+    The built chain must have signature ``(params, opt_state, *extras, key,
+    valid) -> (params, opt_state, *new_extras, *metric_arrays)``, where
+    ``extra_state`` names the trainer attributes holding the extras (e.g.
+    ``("_ef",)`` for the error-feedback residual); new state is swapped into
+    the trainer here and the stacked metric arrays are returned as host numpy.
     """
     cache_key = (steps, rows)
     entry = trainer._chains.get(cache_key)
@@ -93,10 +109,14 @@ def run_chain_cached(
         jax.random.fold_in(jax.random.PRNGKey(seed), trainer.step_num),
         trainer._replicated,
     )
+    extras = tuple(getattr(trainer, name) for name in extra_state)
     out = trainer._chains[cache_key][1](
-        trainer.params, trainer.opt_state, key, vd
+        trainer.params, trainer.opt_state, *extras, key, vd
     )
     trainer.params, trainer.opt_state = out[0], out[1]
+    for i, name in enumerate(extra_state):
+        setattr(trainer, name, out[2 + i])
+    out = out[:2] + out[2 + len(extra_state):]
     if not fetch_metrics:
         # raw device arrays: benchmarks time the chain without the O(steps)
         # metric fetch (the device_get payload grows linearly with steps and
@@ -139,7 +159,9 @@ class DPTrainer:
         the next, making the lossy sync unbiased over time. A masked-out
         device (v=0) sends nothing, so its ENTIRE contribution carries
         forward — threshold dropout loses no gradient signal, only delays
-        it. Requires ``compress``; train_step only (not accum/chain).
+        it. Requires ``compress``. Works on train_step, train_step_accum
+        (residual of the accumulated mean gradient) and train_chain (the
+        residual rides the scan carry).
     """
 
     def __init__(
@@ -225,7 +247,7 @@ class DPTrainer:
 
             loss, grads = jax.value_and_grad(local_loss)(params_local)
             flat, unravel = ravel_pytree(grads)
-            c = flat if ef is None else flat + ef.reshape(-1)
+            c = ef_fold(flat, ef)
             b = bucket if bucket is not None else flat.shape[0]
             n_buckets = -(-flat.shape[0] // b)
             if compress == "int8":
@@ -252,15 +274,7 @@ class DPTrainer:
                     bucket_size=b,
                     wire_dtype=jnp.bfloat16 if wire_bf16 else None,
                 )
-            if ef is None:
-                new_ef = None
-            else:
-                # what the collective actually summed from this device (the
-                # same mask-then-cast masked_psum performs for a 0/1 scalar
-                # mask); the residual is everything it withheld — all of c
-                # when this device was masked out
-                sent = (c * v).astype(jnp.bfloat16).astype(jnp.float32)
-                new_ef = (c - sent).reshape(ef.shape)
+            new_ef = None if ef is None else ef_residual(c, v, ef)
             denom_el = jnp.maximum(expand_counts(cnt, flat.shape[0], b), 1.0)
             gavg = unravel(gsum / denom_el)
             loss_avg = lax.psum(loss * v, axis_names) / denom
@@ -318,6 +332,7 @@ class DPTrainer:
                     params, opt_state, x, y, valid.reshape(()), ef
                 )
 
+            self._raw_step_ef = step_ef  # reused by train_chain's EF loop
             self._step_ef = jax.jit(
                 jax.shard_map(
                     step_ef,
@@ -408,9 +423,10 @@ class DPTrainer:
         loss_impl = self._loss
         tx = self.tx
         bucket = self.bucket_size
+        ef_enabled = self.error_feedback
 
-        def step(params, opt_state, x, y, valid):
-            # x: (accum, micro, ...) per-device block
+        def compute(params, opt_state, ef, x, y, valid):
+            # x: (accum, micro, ...) per-device block; ef: residual or None
             v = valid.reshape(())
             scalar_cnt = lax.psum(v, axis_names)
             denom = jnp.maximum(scalar_cnt, 1.0)
@@ -442,14 +458,16 @@ class DPTrainer:
             flat, unravel = ravel_pytree(
                 jax.tree.map(lambda g: g / accum_steps, gsum)
             )
+            # EF (train_step semantics on the accumulated mean gradient)
+            c = ef_fold(flat, ef)
             wire = jnp.bfloat16 if self.compress == "bf16" else None
             if bucket is None:
-                total, cnt = masked_psum(flat, v, axis_names, wire_dtype=wire)
+                total, cnt = masked_psum(c, v, axis_names, wire_dtype=wire)
                 denom_el = jnp.maximum(cnt, 1.0)
             else:
                 n_buckets = -(-flat.shape[0] // bucket)
                 total, cnt = masked_psum(
-                    flat,
+                    c,
                     jnp.full((n_buckets,), v),
                     axis_names,
                     bucket_size=bucket,
@@ -458,16 +476,34 @@ class DPTrainer:
                 denom_el = jnp.maximum(
                     expand_counts(cnt, flat.shape[0], bucket), 1.0
                 )
+            new_ef = None if ef is None else ef_residual(c, v, ef)
             gavg = unravel(total / denom_el)
             loss_avg = lax.psum(lsum * v / accum_steps, axis_names) / denom
             updates, new_opt = tx.update(gavg, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return new_params, new_opt, loss_avg, scalar_cnt
+            if ef is None:
+                return new_params, new_opt, loss_avg, scalar_cnt
+            return new_params, new_opt, new_ef, loss_avg, scalar_cnt
+
+        data_spec = self._data_spec
+        if ef_enabled:
+            # compute already has the exact (params, opt, ef, x, y, valid)
+            # signature; only the non-EF branch needs a wrapper to bind None
+            mapped = jax.shard_map(
+                compute,
+                mesh=self.mesh,
+                in_specs=(P(), P(), data_spec, data_spec, data_spec, data_spec),
+                out_specs=(P(), P(), data_spec, P(), P()),
+            )
+            return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
+        def step(params, opt_state, x, y, valid):
+            return compute(params, opt_state, None, x, y, valid)
 
         mapped = jax.shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(P(), P(), self._data_spec, self._data_spec, self._data_spec),
+            in_specs=(P(), P(), data_spec, data_spec, data_spec),
             out_specs=(P(), P(), P(), P()),
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -486,11 +522,6 @@ class DPTrainer:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         if accum_steps == 1:  # identical math; reuse the already-built step
             return self.train_step(x, y, valid)
-        if self.error_feedback:
-            raise NotImplementedError(
-                "error_feedback is train_step-only (the residual state is "
-                "not threaded through the accumulation scan)"
-            )
         if self.compress == "int8":
             raise NotImplementedError(
                 "int8 grad sync is train_step/train_chain-only (the "
@@ -520,9 +551,15 @@ class DPTrainer:
         )
         yd = jax.device_put(rearrange(np.asarray(y, np.int32)), self._data_sharding)
         vd = jax.device_put(valid_arr, self._data_sharding)
-        self.params, self.opt_state, loss, cnt = self._accum_steps_fns[
-            accum_steps
-        ](self.params, self.opt_state, xd, yd, vd)
+        fn = self._accum_steps_fns[accum_steps]
+        if self.error_feedback:
+            self.params, self.opt_state, self._ef, loss, cnt = fn(
+                self.params, self.opt_state, self._ef, xd, yd, vd
+            )
+        else:
+            self.params, self.opt_state, loss, cnt = fn(
+                self.params, self.opt_state, xd, yd, vd
+            )
         self.step_num += 1
         return TrainStepMetrics(
             step=self.step_num, loss=float(loss), contributors=float(cnt)
@@ -532,14 +569,44 @@ class DPTrainer:
 
     def _build_chain(self, sampler, steps: int, batch_per_device: int):
         axis_names = self.axis_names
+
+        def device_key(key):
+            # independent per-device stream: fold the device's mesh
+            # coordinates into the key (this IS the DP data shard)
+            for a in axis_names:
+                key = jax.random.fold_in(key, lax.axis_index(a))
+            return key
+
+        if self.error_feedback:
+            raw_step_ef = self._raw_step_ef
+
+            def chain_ef(params, opt_state, ef, key, valid):
+                dkey = device_key(key)
+
+                def body(carry, i):
+                    p, o, e = carry
+                    k = jax.random.fold_in(dkey, i)
+                    x, y = sampler(k, batch_per_device)
+                    p, o, e, loss, cnt = raw_step_ef(p, o, e, x, y, valid)
+                    return (p, o, e), (loss, cnt)
+
+                (params, opt_state, ef), (losses, cnts) = lax.scan(
+                    body, (params, opt_state, ef), jnp.arange(steps)
+                )
+                return params, opt_state, ef, losses, cnts
+
+            mapped = jax.shard_map(
+                chain_ef,
+                mesh=self.mesh,
+                in_specs=(P(), P(), self._data_spec, P(), self._data_spec),
+                out_specs=(P(), P(), self._data_spec, P(), P()),
+            )
+            return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
         raw_step = self._raw_step
 
         def chain(params, opt_state, key, valid):
-            # independent per-device stream: fold the device's mesh
-            # coordinates into the key (this IS the DP data shard)
-            dkey = key
-            for a in axis_names:
-                dkey = jax.random.fold_in(dkey, lax.axis_index(a))
+            dkey = device_key(key)
 
             def body(carry, i):
                 p, o = carry
@@ -585,11 +652,6 @@ class DPTrainer:
         arrays instead of a metrics list — for benchmarks that must keep the
         O(steps) host fetch/conversion out of their timed window.
         """
-        if self.error_feedback:
-            raise NotImplementedError(
-                "error_feedback is train_step-only (the residual state is "
-                "not threaded through the chain scan)"
-            )
         result = run_chain_cached(
             self,
             sampler,
@@ -601,6 +663,8 @@ class DPTrainer:
             self._data_sharding,
             seed,
             fetch_metrics=fetch_metrics,
+            # the EF residual rides the scan carry and comes back as state
+            extra_state=("_ef",) if self.error_feedback else (),
         )
         if not fetch_metrics:
             self.step_num += steps  # keep the data stream advancing
