@@ -24,6 +24,17 @@ import sys
 import time
 
 
+def _add_sharded_compress_flag(p: argparse.ArgumentParser) -> None:
+    """--compress for the sharded-param trainers (train-lm/-moe/-pp)."""
+    p.add_argument(
+        "--compress",
+        choices=("bf16",),
+        default=None,
+        help="gradient wire compression: the grad collective runs with a "
+        "bf16 payload (explicit grouped psum per sharding class)",
+    )
+
+
 def _add_mesh_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     p.add_argument(
@@ -412,6 +423,7 @@ def _cmd_train_lm(argv: list[str]) -> int:
     )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
 
     import jax.numpy as jnp
@@ -437,6 +449,7 @@ def _cmd_train_lm(argv: list[str]) -> int:
         learning_rate=args.lr,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         remat=args.remat,
+        compress=args.compress,
     )
     print(
         f"LM params: {trainer.param_count / 1e6:.2f}M, mesh "
@@ -827,6 +840,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         help="sample batches ON DEVICE inside one jitted chain (no host "
         "I/O per step)",
     )
+    _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
     if args.device_data and args.sp > 1:
         p.error(
@@ -864,6 +878,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         router_topk=args.topk,
         seq_impl=args.impl,
         learning_rate=args.lr,
+        compress=args.compress,
     )
     print(
         f"MoE params: {trainer.param_count / 1e6:.2f}M "
@@ -933,6 +948,7 @@ def _cmd_train_pp(argv: list[str]) -> int:
         help="rematerialize each layer on backward (jax.checkpoint): "
         "stage activation memory drops from layers_per_stage to 1 layer",
     )
+    _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
 
     import jax
@@ -955,6 +971,7 @@ def _cmd_train_pp(argv: list[str]) -> int:
         seq_len=args.seq_len,
         learning_rate=args.lr,
         remat=args.remat,
+        compress=args.compress,
     )
     print(
         f"PP params: {trainer.param_count / 1e6:.2f}M "

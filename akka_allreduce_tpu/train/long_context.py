@@ -78,11 +78,16 @@ class LongContextTrainer:
         seed: int = 0,
         compute_dtype=jnp.float32,
         remat: bool = False,
+        compress: str | None = None,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import (
             TransformerLM,
             tp_param_specs,
         )
+
+        from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
+
+        self.compress = validate_trainer_compress(compress)
 
         if len(mesh.axis_names) not in (2, 3):
             raise ValueError(
@@ -168,6 +173,7 @@ class LongContextTrainer:
         vary_axes = tuple(n for n in axis_names if n != data_axis)
         model_apply = self.model.apply
         tx = self.tx
+        param_specs = self._param_specs
 
         def step(params, opt_state, x, y, valid):
             # The mask arrives sharded on `data` only; mark it varying on the
@@ -192,7 +198,19 @@ class LongContextTrainer:
                 ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
                 return ce.sum() * v / denom
 
-            lval, gavg = jax.value_and_grad(masked_loss_sum)(params)
+            if compress == "bf16":
+                # wire compression needs the explicit collective: one
+                # grouped bf16 psum per sharding class, counts/denominator
+                # staying f32 (comm.allreduce.compressed_value_and_grad)
+                from akka_allreduce_tpu.comm.allreduce import (
+                    compressed_value_and_grad,
+                )
+
+                lval, gavg = compressed_value_and_grad(
+                    masked_loss_sum, params, param_specs, axis_names
+                )
+            else:
+                lval, gavg = jax.value_and_grad(masked_loss_sum)(params)
             loss_avg = lax.psum(lval, axis_names)  # already /denom
             contributors = lax.psum(v0, data_axis)
             updates, new_opt = tx.update(gavg, opt_state, params)

@@ -74,11 +74,16 @@ class MoETrainer:
         learning_rate: float = 1e-2,
         seed: int = 0,
         compute_dtype=jnp.float32,
+        compress: str | None = None,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import (
             MoETransformerLM,
             ep_param_specs,
         )
+
+        from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
+
+        self.compress = validate_trainer_compress(compress)
 
         if len(mesh.axis_names) not in (1, 2, 3):
             raise ValueError(
@@ -185,6 +190,7 @@ class MoETrainer:
         model_apply = self.model.apply
         tx = self.tx
         aux_coef = self.aux_coef
+        param_specs = self._param_specs
 
         def step(params, opt_state, x, y, valid):
             v0 = valid.reshape(())
@@ -204,9 +210,21 @@ class MoETrainer:
                 total = (ce + aux_coef * aux * tokens_local) * v / denom
                 return total, (ce, aux, dropped)
 
-            (_, (ce, aux, dropped)), gavg = jax.value_and_grad(
-                masked_loss, has_aux=True
-            )(params)
+            if compress == "bf16":
+                # explicit grouped bf16 collective (see long_context.py);
+                # expert-sharded leaves reduce over data/seq only
+                from akka_allreduce_tpu.comm.allreduce import (
+                    compressed_value_and_grad,
+                )
+
+                (_, (ce, aux, dropped)), gavg = compressed_value_and_grad(
+                    masked_loss, params, param_specs, axis_names,
+                    has_aux=True,
+                )
+            else:
+                (_, (ce, aux, dropped)), gavg = jax.value_and_grad(
+                    masked_loss, has_aux=True
+                )(params)
             loss_avg = lax.psum(ce * v / denom, axis_names)
             aux_avg = lax.psum(aux * tokens_local * v / denom, axis_names)
             dropped_avg = lax.psum(

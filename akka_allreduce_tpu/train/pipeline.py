@@ -88,6 +88,7 @@ class PipelineLMTrainer:
         seed: int = 0,
         compute_dtype=jnp.float32,
         remat: bool = False,
+        compress: str | None = None,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import Block
 
@@ -95,6 +96,9 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"need a (data, pipe) mesh, got axes {mesh.axis_names}"
             )
+        from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
+
+        self.compress = validate_trainer_compress(compress)
         self.mesh = mesh
         self.data_axis, self.pipe_axis = mesh.axis_names
         self.dp = int(mesh.shape[self.data_axis])
@@ -166,6 +170,7 @@ class PipelineLMTrainer:
         s_count = self.stages
         m_count = microbatches
         tx = self.tx
+        param_specs = self._param_specs
         block_apply = block.apply
         embed_apply = embed.apply
         head_apply = head.apply
@@ -241,9 +246,22 @@ class PipelineLMTrainer:
                 ce_total = ces.sum()
                 return ce_total * v / denom, ce_total
 
-            (_, ce_total), gavg = jax.value_and_grad(
-                masked_loss, has_aux=True
-            )(params)
+            if compress == "bf16":
+                # explicit grouped bf16 collective (see long_context.py);
+                # trunk leaves (pipe-sharded) reduce over data only,
+                # embed/head over data x pipe
+                from akka_allreduce_tpu.comm.allreduce import (
+                    compressed_value_and_grad,
+                )
+
+                (_, ce_total), gavg = compressed_value_and_grad(
+                    masked_loss, params, param_specs, axis_names,
+                    has_aux=True,
+                )
+            else:
+                (_, ce_total), gavg = jax.value_and_grad(
+                    masked_loss, has_aux=True
+                )(params)
             loss_avg = lax.psum(ce_total * v * is_last / denom, axis_names)
             contributors = lax.psum(v0, data_axis)
             updates, new_opt = tx.update(gavg, opt_state, params)
