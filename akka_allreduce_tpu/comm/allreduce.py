@@ -425,10 +425,9 @@ def build_threshold_allreduce(
     if compress == "int8" and schedule != "ring":
         raise ValueError(
             "int8 compression needs per-hop scales: only the explicit ring "
-            "schedule carries them (psum/butterfly sum on the wire)"
+            "schedule carries them (psum/butterfly sum on the wire; the "
+            "pallas_ring kernel stages bf16 hops only)"
         )
-    if compress is not None and schedule == "pallas_ring":
-        raise ValueError("pallas_ring does not support compression yet")
 
     spec_in = P(axis_names if len(axis_names) > 1 else axis_names[0])
 
@@ -467,6 +466,7 @@ def build_threshold_allreduce(
                 )
                 total = pallas_ring_allreduce_sum(
                     vx, axis_names[0], n_devices, seg_rows=seg_rows,
+                    compress=compress,
                     # decide interpret mode by the MESH's platform, not the
                     # process default backend: with the TPU plugin loaded a
                     # virtual CPU mesh still reports default_backend()=="tpu"

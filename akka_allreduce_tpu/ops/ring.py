@@ -32,8 +32,8 @@ _DEF_SEG_ROWS = 512  # per-step transfer: 512*128 fp32 = 256 KB
 _LOGICAL = pltpu.DeviceIdType.LOGICAL
 
 
-def _ring_kernel(n: int, axis_name: str, x_ref, out_ref, recv_buf, send_sem,
-                 recv_sem, cap_sem):
+def _ring_kernel(n: int, axis_name: str, compress: bool, x_ref, out_ref,
+                 *scratch):
     """One bucket: (n*seg_rows, LANE) in VMEM -> allreduced same shape.
 
     Unified reduce-scatter + all-gather loop, 2(n-1) steps. Step s:
@@ -45,7 +45,18 @@ def _ring_kernel(n: int, axis_name: str, x_ref, out_ref, recv_buf, send_sem,
     consuming a slot, signal the left neighbor. Signals are emitted only for
     steps that have a matching wait (s <= S-3), so every semaphore drains to
     zero by kernel end.
+
+    ``compress``: every hop's wire payload rides bfloat16 (half the ICI
+    bytes) staged through ``send_buf``; the VMEM accumulator stays f32.
+    Semantics mirror comm.allreduce.ring_allreduce_sum(compress="bf16"):
+    partial sums re-quantize per RS hop, and the reduced segment is
+    quantized ONCE more before the gather phase — on the owner's copy too —
+    so every device returns bit-identical output.
     """
+    if compress:
+        recv_buf, send_buf, send_sem, recv_sem, cap_sem = scratch
+    else:
+        (recv_buf, send_sem, recv_sem, cap_sem), send_buf = scratch, None
     seg_rows = x_ref.shape[0] // n
     my = lax.axis_index(axis_name)
     right = lax.rem(my + 1, n)
@@ -70,12 +81,32 @@ def _ring_kernel(n: int, axis_name: str, x_ref, out_ref, recv_buf, send_sem,
         recv_idx = lax.rem(jnp.where(rs, my - s - 1, my - sp) + 2 * n, n)
         slot = lax.rem(s, 2)
 
+        if compress:
+            # entering the gather phase: quantize the OWNED reduced segment
+            # (seg (my+1) % n, the first AG send) in place, so the owner's
+            # copy equals what every peer will reconstruct from the wire
+            @pl.when(s == n - 1)
+            def _():
+                own = pl.ds(lax.rem(my + 1, n) * seg_rows, seg_rows)
+                out_ref[own] = (
+                    out_ref[own].astype(jnp.bfloat16).astype(out_ref.dtype)
+                )
+
         @pl.when(s >= 2)
         def _():
             pltpu.semaphore_wait(cap_sem, 1)
 
+        src_slice = pl.ds(send_idx * seg_rows, seg_rows)
+        if compress:
+            # stage the hop payload as bf16: the DMA then moves half the
+            # bytes; the previous send from this slot completed at step s-2
+            # (rdma.wait() blocks on send completion), so the write is safe
+            send_buf[slot] = out_ref[src_slice].astype(send_buf.dtype)
+            src_ref = send_buf.at[slot]
+        else:
+            src_ref = out_ref.at[src_slice]
         rdma = pltpu.make_async_remote_copy(
-            src_ref=out_ref.at[pl.ds(send_idx * seg_rows, seg_rows)],
+            src_ref=src_ref,
             dst_ref=recv_buf.at[slot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[slot],
@@ -91,11 +122,11 @@ def _ring_kernel(n: int, axis_name: str, x_ref, out_ref, recv_buf, send_sem,
 
         @pl.when(rs)
         def _():
-            out_ref[dst] = out_ref[dst] + recv_buf[slot]
+            out_ref[dst] = out_ref[dst] + recv_buf[slot].astype(out_ref.dtype)
 
         @pl.when(jnp.logical_not(rs))
         def _():
-            out_ref[dst] = recv_buf[slot]
+            out_ref[dst] = recv_buf[slot].astype(out_ref.dtype)
 
         # slot consumed: left neighbor may overwrite it (their step s+2)
         @pl.when(s <= total_steps - 3)
@@ -115,6 +146,8 @@ def pallas_ring_allreduce_sum(
     seg_rows: int = _DEF_SEG_ROWS,
     interpret: bool | None = None,
     detect_races: bool = False,
+    compress: str | None = None,
+    collective_id: int = 7,
 ) -> jax.Array:
     """Ring-allreduce ``sum(x)`` over ``axis_name`` inside ``shard_map``.
 
@@ -130,10 +163,20 @@ def pallas_ring_allreduce_sum(
     explicitly from the mesh's device platform: ``jax.default_backend()`` is
     the wrong signal when a TPU plugin is present but the mesh is a virtual
     CPU one — compiled-mode Pallas would then lower onto CPU and fail.
+
+    ``compress="bf16"`` stages every hop through a bfloat16 send buffer —
+    half the wire bytes, f32 VMEM accumulation (see ``_ring_kernel``).
+    ``collective_id`` must be UNIQUE among collective Pallas kernels alive
+    in one program; compose-with-another-kernel callers pass their own.
     """
     n = axis_size
     if n == 1:
         return x
+    if compress not in (None, "bf16"):
+        raise ValueError(
+            f"pallas_ring compress supports only 'bf16', got {compress!r} "
+            "(int8 per-hop scales are not implemented in the kernel)"
+        )
     if interpret is None:
         from akka_allreduce_tpu.ops._platform import interpret_default
 
@@ -149,19 +192,23 @@ def pallas_ring_allreduce_sum(
     else:
         interp = False
 
+    wire = jnp.bfloat16 if compress == "bf16" else x.dtype
+    scratch = [pltpu.VMEM((2, seg_rows, LANE), wire)]  # recv slots
+    if compress == "bf16":
+        scratch.append(pltpu.VMEM((2, seg_rows, LANE), wire))  # send staging
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),  # send
+        pltpu.SemaphoreType.DMA((2,)),  # recv
+        pltpu.SemaphoreType.REGULAR,  # capacity (back-pressure)
+    ]
     call = pl.pallas_call(
-        functools.partial(_ring_kernel, n, axis_name),
+        functools.partial(_ring_kernel, n, axis_name, compress == "bf16"),
         out_shape=jax.ShapeDtypeStruct((n * seg_rows, LANE), x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2, seg_rows, LANE), x.dtype),  # recv slots
-            pltpu.SemaphoreType.DMA((2,)),  # send
-            pltpu.SemaphoreType.DMA((2,)),  # recv
-            pltpu.SemaphoreType.REGULAR,  # capacity (back-pressure)
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=7
+            has_side_effects=True, collective_id=collective_id
         ),
         interpret=interp,
     )
