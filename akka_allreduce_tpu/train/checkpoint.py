@@ -174,7 +174,12 @@ class TrainerCheckpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         if hasattr(trainer, "checkpoint_state"):
-            target = dict(trainer.checkpoint_state())
+            # prefer the abstract template (shape/dtype only): building the
+            # target must not gather the throwaway fresh state to host
+            template_fn = getattr(
+                trainer, "checkpoint_template", trainer.checkpoint_state
+            )
+            target = dict(template_fn())
             target["step"] = trainer.step_num
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(target)

@@ -22,8 +22,9 @@ gradient reduce-scatter in bfloat16 on the wire (half the ICI bytes; weights
 and their all_gather stay float32), trading bit-identity for bandwidth.
 Checkpointing goes through ``TrainerCheckpointer``'s trainer-defined protocol
 (``checkpoint_state``/``restore_checkpoint_state``): the flat weight vector
-and the 1/n optimizer-moment shards serialize as-is and restore onto the
-same mesh size.
+and optimizer moments serialize UNPADDED (mesh-size-independent), so an
+n-device checkpoint restores onto any other device count — the moments are
+re-padded and re-sharded 1/n' over the new mesh on restore.
 
 Beyond the reference (which has no optimizer-state concept at all); it exists
 here because memory per chip is the binding constraint the framework is built
@@ -228,33 +229,75 @@ class Zero1DPTrainer:
     def checkpoint_state(self) -> dict:
         """ZeRO-1 state doesn't fit the params/opt_state pytree shape the
         default checkpoint path assumes (weights are one padded flat vector,
-        optimizer moments are 1/n shards): serialize it explicitly."""
+        optimizer moments are 1/n shards): serialize it explicitly.
+
+        The serialized form is mesh-size-INDEPENDENT: the mesh-dependent
+        padding tails are stripped, so a checkpoint saved on n devices
+        restores onto any other device count (moments are per-flat-element
+        state laid out exactly like the flat weight vector, so unpad/re-pad
+        is exact — gather-then-reshard at checkpoint scale). Checkpoints
+        written by the round-1 padded per-mesh format are not loadable.
+        """
+        count = self.param_count
+
+        def unpad(leaf):
+            # via host: slicing a P(axis)-sharded array is an ambiguous
+            # gather for the sharding typer, and checkpoint-scale
+            # gather-to-host is cheap
+            arr = np.asarray(jax.device_get(leaf))
+            if arr.ndim == 0:  # step counters etc.
+                return arr
+            return arr.reshape(-1)[:count]
+
         return {
-            "flat_params": self.flat_params,
-            "opt_state": self.opt_state,
+            "flat_params": self.get_flat_params(),
+            "opt_state": jax.tree.map(unpad, self.opt_state),
+        }
+
+    def checkpoint_template(self) -> dict:
+        """Abstract (shape/dtype-only) form of :meth:`checkpoint_state` for
+        the restore target — no device_get of throwaway freshly-initialized
+        state just to build a template."""
+        count = self.param_count
+
+        def tmpl(leaf):
+            leaf = jnp.asarray(leaf)
+            if leaf.ndim == 0:
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+            return jax.ShapeDtypeStruct((count,), leaf.dtype)
+
+        return {
+            "flat_params": jax.ShapeDtypeStruct((count,), jnp.float32),
+            "opt_state": jax.tree.map(tmpl, self.opt_state),
         }
 
     def restore_checkpoint_state(self, state: dict) -> None:
-        """Re-place restored state on this trainer's mesh: flat weights
-        replicated, optimizer moments sharded 1/n (scalar counters
-        replicated). Same device count only — the moment shards are
-        per-device state. Placement reshards on device (a no-op when Orbax
-        already restored onto the right shardings)."""
+        """Re-place restored (unpadded) state on this trainer's mesh: flat
+        weights re-padded and replicated, optimizer moments re-padded and
+        sharded 1/n over THIS mesh (scalar counters replicated) — the mesh
+        size at save time is irrelevant."""
         from akka_allreduce_tpu.train.checkpoint import place_on
 
-        flat = state["flat_params"]
-        if flat.shape != (self._padded,):
-            raise ValueError(
-                f"flat_params shape {flat.shape} != padded ({self._padded},):"
-                " restore into a trainer with the same model and mesh size"
+        count = self.param_count
+        pad = self._padded - count
+        self.set_flat_params(np.asarray(state["flat_params"]))
+
+        def reshard(leaf, spec):
+            leaf = jnp.asarray(leaf)
+            if leaf.ndim == 0:
+                return place_on(leaf, self._replicated)
+            if leaf.shape != (count,):
+                raise ValueError(
+                    f"optimizer leaf shape {leaf.shape} != ({count},): "
+                    "restore into a trainer with the same model"
+                )
+            return place_on(
+                jnp.pad(leaf, (0, pad)), NamedSharding(self.mesh, spec)
             )
-        self.flat_params = place_on(flat, self._replicated)
-        sharding_tree = jax.tree.map(
-            lambda spec: NamedSharding(self.mesh, spec),
-            self._opt_specs,
-            is_leaf=lambda x: isinstance(x, P),
+
+        self.opt_state = jax.tree.map(
+            reshard, state["opt_state"], self._opt_specs
         )
-        self.opt_state = place_on(state["opt_state"], sharding_tree)
 
     # -- stepping --------------------------------------------------------------
 
