@@ -34,7 +34,7 @@ Layout (mirrors SURVEY.md §2's layer map):
 - ``utils``    — logging, metrics JSONL, timing
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from akka_allreduce_tpu.config import (  # noqa: F401
     AllreduceConfig,

@@ -3,10 +3,11 @@
 The TPU data plane is XLA (comm/allreduce.py); this native library carries the
 *host* data path — engine unit mode, CPU fallback, DCN chunk staging — the
 role the reference's JVM float loops play (SURVEY.md §3 "Reduction executor").
-Built from ``native/threshold_reduce.cpp`` via ``make -C native`` or, failing
-that, compiled on first import when a C++ toolchain is present. Every entry
-point has a numpy fallback, so the framework is fully functional without the
-.so; ``available()`` reports which path is live.
+Built from ``threshold_reduce.cpp`` (shipped as package data, so installed
+copies can build too) via ``make -C native`` or, failing that, compiled on
+first import when a C++ toolchain is present. Every entry point has a numpy
+fallback, so the framework is fully functional without the .so;
+``available()`` reports which path is live.
 """
 
 from __future__ import annotations
@@ -22,11 +23,7 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "_threshold_reduce.so")
-_SRC_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-    "threshold_reduce.cpp",
-)
+_SRC_PATH = os.path.join(os.path.dirname(__file__), "threshold_reduce.cpp")
 
 _ABI_VERSION = 2
 
