@@ -146,6 +146,14 @@ def _train_flags(p: argparse.ArgumentParser) -> None:
         "threshold-dropped device's gradient is delayed, not lost "
         "(requires --compress)",
     )
+    p.add_argument(
+        "--overlap",
+        action="store_true",
+        help="issue one grad collective per param leaf INSIDE the backward "
+        "pass so the latency-hiding scheduler can run comm behind compute "
+        "(SURVEY.md §8.4; composes with --compress bf16; excludes --bucket, "
+        "int8, --error-feedback)",
+    )
 
 
 def _run_training_chain(trainer, ds, args, *, label: str) -> int:
@@ -338,6 +346,7 @@ def _cmd_train_mlp(argv: list[str]) -> int:
         bucket_size=args.bucket,
         compress=args.compress,
         error_feedback=args.error_feedback,
+        overlap=args.overlap,
     )
     return _run_training(trainer, data.mnist_like(), args, label="mlp_mnist")
 
@@ -367,9 +376,17 @@ def _cmd_train_resnet(argv: list[str]) -> int:
             (1, args.image_size, args.image_size, 3), np.float32
         ),
         learning_rate=args.lr,
-        bucket_size=args.bucket or 262_144,  # the reference's chunk geometry
+        # the reference's chunk geometry by default; --overlap drops only
+        # the DEFAULT (an explicit --bucket still reaches the trainer's
+        # conflicting-flags guard, same contract as train-mlp)
+        bucket_size=(
+            args.bucket
+            if args.bucket is not None
+            else (None if args.overlap else 262_144)
+        ),
         compress=args.compress,
         error_feedback=args.error_feedback,
+        overlap=args.overlap,
     )
     print(f"ResNet params: {trainer.param_count / 1e6:.1f}M")
     ds = data.SyntheticClassification(

@@ -196,6 +196,49 @@ def grouped_tree_psum(grads, specs, axis_names: Axes, wire_dtype=None):
     return jax.tree.unflatten(treedef, out)
 
 
+def backward_psum_sync(axis_names: str | Axes, wire_dtype=None):
+    """An identity whose BACKWARD masked-psums the cotangent — the
+    comm/compute-overlap primitive (SURVEY.md §8.4 "Overlap").
+
+    Wrap each param leaf with the returned ``sync(p, v)`` before the loss:
+    in reverse-mode, leaf k's collective then depends ONLY on leaf k's
+    backward subgraph, not on the whole gradient like a single fused psum.
+    That dependence structure is what lets XLA's latency-hiding scheduler
+    (TPU: async ``all-reduce-start``/``-done`` pairs) run layer k's grad
+    collective while layer k-1's backward still computes. The trade is one
+    collective per leaf instead of one fused launch — more dispatches,
+    hideable behind compute.
+
+    ``v`` is the scalar 0/1 contributor mask; the synced cotangent is
+    ``sum_d(v_d * g_d)``, exactly the trainers' masked grad collective.
+    ``wire_dtype`` (e.g. bf16) compresses each leaf's payload.
+
+    The custom_vjp erases varying-axes typing, so enclosing shard_maps need
+    ``check_vma=False`` (same caveat as the ring schedules).
+    """
+
+    @jax.custom_vjp
+    def sync(p, v):
+        return p
+
+    def fwd(p, v):
+        return p, v
+
+    def bwd(res, ct):
+        v = res
+        masked = ct * v.astype(ct.dtype)
+        if wire_dtype is not None and masked.dtype != wire_dtype:
+            total = lax.psum(
+                masked.astype(wire_dtype), axis_names
+            ).astype(ct.dtype)
+        else:
+            total = lax.psum(masked, axis_names)
+        return total, jnp.zeros_like(v)
+
+    sync.defvjp(fwd, bwd)
+    return sync
+
+
 def compressed_value_and_grad(
     loss_fn,
     params,

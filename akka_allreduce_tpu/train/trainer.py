@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from akka_allreduce_tpu.binder.api import flatten_pytree
 from akka_allreduce_tpu.comm.allreduce import (
+    backward_psum_sync,
     expand_counts,
     masked_psum,
     ring_allreduce_sum,
@@ -162,6 +163,14 @@ class DPTrainer:
         it. Requires ``compress``. Works on train_step, train_step_accum
         (residual of the accumulated mean gradient) and train_chain (the
         residual rides the scan carry).
+      overlap: issue ONE masked collective per param leaf INSIDE the
+        backward pass (``comm.allreduce.backward_psum_sync``) instead of a
+        single fused psum at the end. Leaf k's collective then depends only
+        on leaf k's backward subgraph, so the latency-hiding scheduler
+        (TPU async all-reduce pairs) can hide it behind the remaining
+        backward compute — SURVEY.md §8.4's overlap story. Composes with
+        ``compress="bf16"``; mutually exclusive with ``bucket_size``
+        (leaf granularity IS the bucketing), int8, and error_feedback.
     """
 
     def __init__(
@@ -177,7 +186,17 @@ class DPTrainer:
         seed: int = 0,
         compress: str | None = None,
         error_feedback: bool = False,
+        overlap: bool = False,
     ) -> None:
+        if overlap and (bucket_size is not None or compress == "int8"
+                        or error_feedback):
+            raise ValueError(
+                "overlap issues ONE collective per param leaf inside the "
+                "backward pass — leaf granularity IS its bucketing, and "
+                "neither the int8 ring nor the EF residual fit a per-leaf "
+                "in-backward collective; use overlap with compress=None or "
+                "'bf16' only"
+            )
         if compress not in (None, "bf16", "int8"):
             raise ValueError(
                 f"compress must be None, 'bf16' or 'int8', got {compress!r}"
@@ -202,6 +221,7 @@ class DPTrainer:
         self.bucket_size = bucket_size
         self.compress = compress
         self.error_feedback = error_feedback
+        self.overlap = overlap
         # how many independent data streams train_chain samples (one per
         # device here; the long-context trainer has one per DP replica row)
         self.data_shards = self.n_devices
@@ -282,8 +302,38 @@ class DPTrainer:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, new_ef, loss_avg, scalar_cnt
 
+        if overlap:
+            grad_sync = backward_psum_sync(
+                axis_names,
+                jnp.bfloat16 if wire_bf16 else None,
+            )
+
+            def overlapped_step(params, opt_state, x, y, v):
+                """Per-leaf collectives issued INSIDE the backward pass:
+                leaf k's psum depends only on leaf k's backward subgraph, so
+                the latency-hiding scheduler can run it behind the rest of
+                the backward (SURVEY.md §8.4; backward_psum_sync)."""
+                scalar_cnt = lax.psum(v, axis_names)
+                denom = jnp.maximum(scalar_cnt, 1.0)
+                params_local = jax.tree.map(
+                    lambda p: lax.pcast(p, axis_names, to="varying"), params
+                )
+
+                def local_loss(pt):
+                    ps = jax.tree.map(lambda p: grad_sync(p, v), pt)
+                    return loss_impl(model_apply(ps, x), y)
+
+                loss, gsum = jax.value_and_grad(local_loss)(params_local)
+                gavg = jax.tree.map(lambda g: g / denom, gsum)
+                loss_avg = lax.psum(loss * v, axis_names) / denom
+                updates, new_opt = tx.update(gavg, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt, loss_avg, scalar_cnt
+
         def step(params, opt_state, x, y, valid):
             v = valid.reshape(())
+            if overlap:
+                return overlapped_step(params, opt_state, x, y, v)
             if bucket is not None or compress is not None:
                 out = explicit_step(params, opt_state, x, y, v, None)
                 return out[0], out[1], out[3], out[4]
@@ -311,10 +361,11 @@ class DPTrainer:
             mesh=mesh,
             in_specs=(P(), P(), data_spec, data_spec, data_spec),
             out_specs=(P(), P(), P(), P()),
-            # the int8 ring's all-gather result IS replicated, but the static
-            # varying-axes check cannot prove it (same caveat as the comm
-            # layer's ring schedules); the f32-equivalence tests are the oracle
-            check_vma=(compress != "int8"),
+            # the int8 ring's all-gather result IS replicated and the overlap
+            # custom_vjp's psum erases vma typing, but the static varying-axes
+            # check cannot see either (same caveat as the comm layer's ring
+            # schedules); the f32-equivalence tests are the oracle
+            check_vma=(compress != "int8" and not overlap),
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
@@ -522,6 +573,13 @@ class DPTrainer:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         if accum_steps == 1:  # identical math; reuse the already-built step
             return self.train_step(x, y, valid)
+        if self.overlap:
+            raise NotImplementedError(
+                "overlap is pointless under gradient accumulation: every "
+                "leaf's gradient depends on the WHOLE accumulation scan, so "
+                "per-leaf collectives could never run behind the backward; "
+                "use the accumulation path without overlap"
+            )
         if self.compress == "int8":
             raise NotImplementedError(
                 "int8 grad sync is train_step/train_chain-only (the "
@@ -625,8 +683,8 @@ class DPTrainer:
             mesh=self.mesh,
             in_specs=(P(), P(), P(), self._data_spec),
             out_specs=(P(), P(), P(), P()),
-            # same int8-ring caveat as the step's shard_map
-            check_vma=(self.compress != "int8"),
+            # same int8-ring / overlap caveat as the step's shard_map
+            check_vma=(self.compress != "int8" and not self.overlap),
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
