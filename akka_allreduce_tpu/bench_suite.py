@@ -385,7 +385,12 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
     completed = len(outs)
     mean_count = float(np.mean(outs[-1].count)) if outs else 0.0
 
-    # tier 2: elastic re-mesh latency around a node loss (XLA trainer)
+    # tier 2: elastic re-mesh latency around a node loss AND a late joiner
+    # (XLA trainer). On a single real chip the device count cannot change,
+    # but membership still does — a zero-device control node drops and
+    # rejoins — so the FULL re-mesh cycle (snapshot of live HBM state,
+    # trainer rebuild, XLA recompile, sharded restore, first step) runs
+    # against the real device; the record says which shape ran.
     import jax
 
     from akka_allreduce_tpu.models import MLP, data
@@ -394,29 +399,48 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
     devices = jax.devices()
     nodes = min(4, len(devices))
     per = max(1, len(devices) // nodes)
+    if nodes >= 2:
+        assignment = {k: devices[k * per : (k + 1) * per] for k in range(nodes)}
+        zero_device_node = False
+    else:
+        assignment = {0: list(devices[:1]), 1: []}
+        nodes = 2
+        zero_device_node = True
+    lost = nodes - 1
+    survivors = [k for k in range(nodes) if k != lost]
     now = {"t": 0.0}
     trainer = ElasticDPTrainer(
         MLP(hidden=(16,), classes=10),
-        {k: devices[k * per : (k + 1) * per] for k in range(nodes)},
+        assignment,
         example_input=np.zeros((1, 28, 28, 1), np.float32),
         clock=lambda: now["t"],
     )
     ds = data.mnist_like()
     x, y = next(iter(ds.batches(8 * trainer.n_devices, 1)))
     trainer.train_step(x, y)  # compile generation 0
-    # lose the last node (single-device meshes have no node to spare: the
-    # re-mesh tier then measures a clean poll + step with no loss)
-    survivors = range(nodes - 1) if nodes >= 2 else range(nodes)
+
+    # dropout: the last node goes silent long enough for phi to accrue
+    # while the survivors keep heartbeating across the gap
     for k in survivors:
         trainer.heartbeat(k)
     now["t"] += 60.0
     for k in survivors:
         trainer.heartbeat(k)
     t0 = time.perf_counter()
-    remeshed = trainer.poll()
+    dropped_remesh = trainer.poll()
     x, y = next(iter(ds.batches(8 * trainer.n_devices, 1, seed_offset=2)))
-    m = trainer.train_step(x, y)  # includes new-mesh compile
-    remesh_s = time.perf_counter() - t0
+    m_drop = trainer.train_step(x, y)  # includes new-mesh compile
+    drop_remesh_s = time.perf_counter() - t0
+
+    # late joiner: the lost node heartbeats again -> membership grows back
+    now["t"] += 1.0
+    trainer.heartbeat(lost)
+    t0 = time.perf_counter()
+    rejoin_remesh = trainer.poll()
+    x, y = next(iter(ds.batches(8 * trainer.n_devices, 1, seed_offset=3)))
+    m_join = trainer.train_step(x, y)
+    rejoin_remesh_s = time.perf_counter() - t0
+
     return _record(
         5,
         "threshold_dropout_recovery",
@@ -425,10 +449,16 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
         rounds_completed=completed,
         seconds=round(dt, 4),
         mean_contributors=round(mean_count, 2),
-        remeshed=bool(remeshed),
+        dropped_remeshed=bool(dropped_remesh),
+        rejoin_remeshed=bool(rejoin_remesh),
+        remeshed=bool(dropped_remesh) and bool(rejoin_remesh),
         remesh_nodes=trainer.n_nodes,
-        remesh_and_first_step_s=round(remesh_s, 3),
-        post_remesh_loss=round(m.loss, 4),
+        device_platform=devices[0].platform,
+        zero_device_control_node=zero_device_node,
+        drop_remesh_and_first_step_s=round(drop_remesh_s, 3),
+        rejoin_remesh_and_first_step_s=round(rejoin_remesh_s, 3),
+        post_remesh_loss=round(m_drop.loss, 4),
+        post_rejoin_loss=round(m_join.loss, 4),
         path="host_engine + xla_elastic",
     )
 

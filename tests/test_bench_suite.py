@@ -19,10 +19,14 @@ def test_config5_dropout_recovery_record():
     assert rec["rounds_completed"] == 10
     # contributor counts reflect the threshold, not full participation
     assert 2.0 <= rec["mean_contributors"] <= 3.0
-    # tier 2: the elastic trainer re-meshed off the lost node and stepped
+    # tier 2: the elastic trainer re-meshed off the lost node, stepped,
+    # then re-meshed the late joiner back in and stepped again
+    assert rec["dropped_remeshed"] is True
+    assert rec["rejoin_remeshed"] is True
     assert rec["remeshed"] is True
     assert rec["remesh_nodes"] >= 1
-    assert rec["remesh_and_first_step_s"] > 0
+    assert rec["drop_remesh_and_first_step_s"] > 0
+    assert rec["rejoin_remesh_and_first_step_s"] > 0
 
 
 def test_config3_mlp_step_record():
