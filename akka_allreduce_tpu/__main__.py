@@ -25,13 +25,20 @@ import time
 
 
 def _add_sharded_compress_flag(p: argparse.ArgumentParser) -> None:
-    """--compress for the sharded-param trainers (train-lm/-moe/-pp)."""
+    """--compress/--overlap for the sharded-param trainers (train-lm/-moe/-pp)."""
     p.add_argument(
         "--compress",
         choices=("bf16",),
         default=None,
         help="gradient wire compression: the grad collective runs with a "
         "bf16 payload (explicit grouped psum per sharding class)",
+    )
+    p.add_argument(
+        "--overlap",
+        action="store_true",
+        help="issue one grad collective per param leaf INSIDE the backward "
+        "pass (each over the leaf's replication axes) so the latency-hiding "
+        "scheduler can run comm behind compute; composes with --compress",
     )
 
 
@@ -467,6 +474,7 @@ def _cmd_train_lm(argv: list[str]) -> int:
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         remat=args.remat,
         compress=args.compress,
+        overlap=args.overlap,
     )
     print(
         f"LM params: {trainer.param_count / 1e6:.2f}M, mesh "
@@ -896,6 +904,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         seq_impl=args.impl,
         learning_rate=args.lr,
         compress=args.compress,
+        overlap=args.overlap,
     )
     print(
         f"MoE params: {trainer.param_count / 1e6:.2f}M "
@@ -989,6 +998,7 @@ def _cmd_train_pp(argv: list[str]) -> int:
         learning_rate=args.lr,
         remat=args.remat,
         compress=args.compress,
+        overlap=args.overlap,
     )
     print(
         f"PP params: {trainer.param_count / 1e6:.2f}M "

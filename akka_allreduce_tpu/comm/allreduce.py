@@ -239,6 +239,75 @@ def backward_psum_sync(axis_names: str | Axes, wire_dtype=None):
     return sync
 
 
+def backward_tree_sync(specs, axis_names: Axes, wire_dtype=None):
+    """Per-leaf in-backward sync for a SHARDED params tree.
+
+    Returns ``apply(tree_local, v)``: wraps each leaf with a
+    :func:`backward_psum_sync` over the axes its spec does NOT shard (the
+    same reduce-axes classes as :func:`grouped_tree_psum`), so leaf k's
+    masked collective fires in leaf k's backward subgraph — the overlap
+    dependence structure — while TP/EP/PP-sharded leaves still reduce over
+    only their replication axes. One custom_vjp per reduce-axes class.
+
+    The wrapped loss must NOT also multiply by ``v``: the sync masks each
+    leaf's cotangent itself (``sum_d(v_d * g_d)``), and double-masking would
+    square the mask. A leaf sharded over EVERY axis would silently skip that
+    masking, so it is rejected loudly (no current trainer shards params over
+    the data axis).
+    """
+    syncs: dict = {}
+
+    def sync_for(spec):
+        reduce_over = tuple(a for a in axis_names if a not in spec_axes(spec))
+        if not reduce_over:
+            raise ValueError(
+                f"leaf spec {spec} shards over every mesh axis: its grad "
+                "has no replication axes to sync over, and the in-backward "
+                "mask would be skipped — overlap does not support it"
+            )
+        if reduce_over not in syncs:
+            syncs[reduce_over] = backward_psum_sync(reduce_over, wire_dtype)
+        return syncs[reduce_over]
+
+    def apply(tree_local, v):
+        return jax.tree.map(
+            lambda p, s: sync_for(s)(p, v),
+            tree_local,
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    return apply
+
+
+def overlap_value_and_grad(
+    loss_fn,
+    params,
+    specs,
+    axis_names: Axes,
+    v,
+    *,
+    has_aux: bool = False,
+    wire_dtype=None,
+):
+    """``value_and_grad`` with per-leaf IN-BACKWARD masked collectives.
+
+    The one-call form of :func:`localize_tree` + :func:`backward_tree_sync`
+    (the sibling of :func:`compressed_value_and_grad`, trading its one
+    grouped launch per sharding class for overlap-capable per-leaf
+    dependence). ``loss_fn`` must be UNMASKED — each leaf's sync multiplies
+    its cotangent by ``v`` itself, and a ``v`` in the loss would square the
+    mask. The returned loss value is LOCAL and unmasked; callers fold ``v``
+    into their metric psums."""
+    sync = backward_tree_sync(specs, axis_names, wire_dtype)
+    params_local = localize_tree(params, specs, axis_names)
+
+    def wrapped(pt):
+        return loss_fn(sync(pt, v))
+
+    return jax.value_and_grad(wrapped, has_aux=has_aux)(params_local)
+
+
 def compressed_value_and_grad(
     loss_fn,
     params,

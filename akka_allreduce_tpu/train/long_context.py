@@ -79,6 +79,7 @@ class LongContextTrainer:
         compute_dtype=jnp.float32,
         remat: bool = False,
         compress: str | None = None,
+        overlap: bool = False,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import (
             TransformerLM,
@@ -88,6 +89,7 @@ class LongContextTrainer:
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
         self.compress = validate_trainer_compress(compress)
+        self.overlap = overlap
 
         if len(mesh.axis_names) not in (2, 3):
             raise ValueError(
@@ -174,6 +176,7 @@ class LongContextTrainer:
         model_apply = self.model.apply
         tx = self.tx
         param_specs = self._param_specs
+        wire_dtype = jnp.bfloat16 if compress == "bf16" else None
 
         def step(params, opt_state, x, y, valid):
             # The mask arrives sharded on `data` only; mark it varying on the
@@ -198,7 +201,28 @@ class LongContextTrainer:
                 ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
                 return ce.sum() * v / denom
 
-            if compress == "bf16":
+            if overlap:
+                # per-leaf in-backward collectives (comm/compute overlap,
+                # SURVEY.md §8.4): the loss is UNMASKED — each leaf's sync
+                # masks its cotangent itself (sum_d v_d g_d) — so v is
+                # folded back into the metric here
+                from akka_allreduce_tpu.comm.allreduce import (
+                    overlap_value_and_grad,
+                )
+
+                def unmasked_loss_sum(ps):
+                    logits = model_apply(ps, x)
+                    ce = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, y
+                    )
+                    return ce.sum() / denom
+
+                lval, gavg = overlap_value_and_grad(
+                    unmasked_loss_sum, params, param_specs, axis_names, v,
+                    wire_dtype=wire_dtype,
+                )
+                lval = lval * v
+            elif compress == "bf16":
                 # wire compression needs the explicit collective: one
                 # grouped bf16 psum per sharding class, counts/denominator
                 # staying f32 (comm.allreduce.compressed_value_and_grad)
@@ -211,7 +235,7 @@ class LongContextTrainer:
                 )
             else:
                 lval, gavg = jax.value_and_grad(masked_loss_sum)(params)
-            loss_avg = lax.psum(lval, axis_names)  # already /denom
+            loss_avg = lax.psum(lval, axis_names)  # masked, already /denom
             contributors = lax.psum(v0, data_axis)
             updates, new_opt = tx.update(gavg, opt_state, params)
             new_params = optax.apply_updates(params, updates)
@@ -227,7 +251,7 @@ class LongContextTrainer:
 
         head_dim = d_model // n_heads
         local_t = seq_len if (self.sp == 1 or seq_impl == "ulysses") else 0
-        self._check_vma = not (
+        self._check_vma = not overlap and not (
             jax.default_backend() == "tpu"
             and local_t > 0
             and flash_shapes_ok(local_t, head_dim)

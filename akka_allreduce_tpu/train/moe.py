@@ -75,6 +75,7 @@ class MoETrainer:
         seed: int = 0,
         compute_dtype=jnp.float32,
         compress: str | None = None,
+        overlap: bool = False,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import (
             MoETransformerLM,
@@ -84,6 +85,7 @@ class MoETrainer:
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
         self.compress = validate_trainer_compress(compress)
+        self.overlap = overlap
 
         if len(mesh.axis_names) not in (1, 2, 3):
             raise ValueError(
@@ -191,6 +193,7 @@ class MoETrainer:
         tx = self.tx
         aux_coef = self.aux_coef
         param_specs = self._param_specs
+        wire_dtype = jnp.bfloat16 if compress == "bf16" else None
 
         def step(params, opt_state, x, y, valid):
             v0 = valid.reshape(())
@@ -210,7 +213,27 @@ class MoETrainer:
                 total = (ce + aux_coef * aux * tokens_local) * v / denom
                 return total, (ce, aux, dropped)
 
-            if compress == "bf16":
+            if overlap:
+                # per-leaf in-backward collectives (SURVEY.md §8.4): the
+                # loss is UNMASKED — each leaf's sync masks its cotangent
+                # itself; the metric psums below re-apply v explicitly
+                from akka_allreduce_tpu.comm.allreduce import (
+                    overlap_value_and_grad,
+                )
+
+                def unmasked_loss(ps):
+                    logits, aux, dropped = model_apply(ps, x)
+                    ce = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, y
+                    ).sum()
+                    total = (ce + aux_coef * aux * tokens_local) / denom
+                    return total, (ce, aux, dropped)
+
+                (_, (ce, aux, dropped)), gavg = overlap_value_and_grad(
+                    unmasked_loss, params, param_specs, axis_names, v,
+                    has_aux=True, wire_dtype=wire_dtype,
+                )
+            elif compress == "bf16":
                 # explicit grouped bf16 collective (see long_context.py);
                 # expert-sharded leaves reduce over data/seq only
                 from akka_allreduce_tpu.comm.allreduce import (
@@ -249,6 +272,9 @@ class MoETrainer:
                 P(self.data_axis),
             ),
             out_specs=(self._param_specs, self._opt_specs, P(), P(), P(), P()),
+            # the overlap custom_vjp erases varying-axes typing (same caveat
+            # as the comm layer's ring schedules); equivalence tests oracle
+            check_vma=not overlap,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
@@ -339,6 +365,8 @@ class MoETrainer:
                 P(),
                 P(),
             ),
+            # same overlap custom_vjp caveat as the step's shard_map
+            check_vma=not self.overlap,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 

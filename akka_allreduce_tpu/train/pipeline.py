@@ -89,6 +89,7 @@ class PipelineLMTrainer:
         compute_dtype=jnp.float32,
         remat: bool = False,
         compress: str | None = None,
+        overlap: bool = False,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import Block
 
@@ -99,6 +100,7 @@ class PipelineLMTrainer:
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
         self.compress = validate_trainer_compress(compress)
+        self.overlap = overlap
         self.mesh = mesh
         self.data_axis, self.pipe_axis = mesh.axis_names
         self.dp = int(mesh.shape[self.data_axis])
@@ -171,6 +173,7 @@ class PipelineLMTrainer:
         m_count = microbatches
         tx = self.tx
         param_specs = self._param_specs
+        wire_dtype = jnp.bfloat16 if compress == "bf16" else None
         block_apply = block.apply
         embed_apply = embed.apply
         head_apply = head.apply
@@ -209,7 +212,9 @@ class PipelineLMTrainer:
                 lax.psum(v * tokens_local * is_last, axis_names), 1.0
             )
 
-            def masked_loss(p):
+            def pipeline_ce(p):
+                """The GPipe forward: this device's summed loss tokens
+                (nonzero only on the last stage's real microbatches)."""
                 xe = embed_apply({"params": p["embed"]}, x)
                 micro = xe.reshape(m_count, mb, t_len, -1)
                 labels = y.reshape(m_count, mb, t_len)
@@ -243,10 +248,29 @@ class PipelineLMTrainer:
                 _, ces = lax.scan(
                     tick, zero, jnp.arange(m_count + s_count - 1)
                 )
-                ce_total = ces.sum()
+                return ces.sum()
+
+            def masked_loss(p):
+                ce_total = pipeline_ce(p)
                 return ce_total * v / denom, ce_total
 
-            if compress == "bf16":
+            if overlap:
+                # per-leaf in-backward collectives (SURVEY.md §8.4): the
+                # loss is UNMASKED — each leaf's sync masks its cotangent;
+                # loss_avg below re-applies v explicitly
+                from akka_allreduce_tpu.comm.allreduce import (
+                    overlap_value_and_grad,
+                )
+
+                def unmasked_loss(ps):
+                    ce_total = pipeline_ce(ps)
+                    return ce_total / denom, ce_total
+
+                (_, ce_total), gavg = overlap_value_and_grad(
+                    unmasked_loss, params, param_specs, axis_names, v,
+                    has_aux=True, wire_dtype=wire_dtype,
+                )
+            elif compress == "bf16":
                 # explicit grouped bf16 collective (see long_context.py);
                 # trunk leaves (pipe-sharded) reduce over data only,
                 # embed/head over data x pipe
@@ -282,6 +306,9 @@ class PipelineLMTrainer:
                 P(self.data_axis),
             ),
             out_specs=(self._param_specs, self._opt_specs, P(), P()),
+            # the overlap custom_vjp erases varying-axes typing (same caveat
+            # as the comm layer's ring schedules); equivalence tests oracle
+            check_vma=not overlap,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
@@ -359,6 +386,8 @@ class PipelineLMTrainer:
                 P(self.data_axis),
             ),
             out_specs=(self._param_specs, self._opt_specs, P(), P()),
+            # same overlap custom_vjp caveat as the step's shard_map
+            check_vma=not self.overlap,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 

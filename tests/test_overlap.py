@@ -115,6 +115,101 @@ class TestOverlapNumerics:
             t.train_step_accum(x, y, accum_steps=2)
 
 
+class TestShardedTrainerOverlap:
+    """overlap on the sharded-param trainers: per-leaf in-backward
+    collectives over each leaf's REPLICATION axes (backward_tree_sync) —
+    TP/EP/PP-sharded leaves reduce over data/seq only, replicated leaves
+    over every axis, same classes as grouped_tree_psum."""
+
+    def test_long_context_dp_sp_tp(self):
+        import optax
+
+        from akka_allreduce_tpu.parallel import data_seq_model_mesh
+        from akka_allreduce_tpu.train import LongContextTrainer
+
+        mesh = data_seq_model_mesh(2, 2, 2)
+        kw = dict(
+            vocab=16, d_model=32, n_heads=4, n_layers=1, seq_len=32,
+            optimizer=optax.sgd(1e-2),
+        )
+        t0 = LongContextTrainer(mesh, **kw)
+        t1 = LongContextTrainer(mesh, overlap=True, **kw)
+        ds = data.lm_copy_task(32, vocab=16)
+        tok, lab = next(ds.batches(4, 1))
+        for i in range(3):
+            v = [1.0, 0.0] if i == 1 else None
+            m0 = t0.train_step(tok, lab, v)
+            m1 = t1.train_step(tok, lab, v)
+            assert m0.contributors == m1.contributors
+            assert abs(m0.loss - m1.loss) < 1e-5
+        np.testing.assert_allclose(
+            t1.get_flat_params(), t0.get_flat_params(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_moe_overlap_matches_default(self):
+        import optax
+
+        from akka_allreduce_tpu.train import MoETrainer
+
+        mesh = jax.make_mesh((4, 2), ("data", "expert"))
+        kw = dict(
+            vocab=16, d_model=32, n_heads=4, n_layers=1, n_experts=4,
+            seq_len=32, optimizer=optax.sgd(1e-2),
+        )
+        t0 = MoETrainer(mesh, **kw)
+        t1 = MoETrainer(mesh, overlap=True, **kw)
+        ds = data.lm_copy_task(32, vocab=16)
+        tok, lab = next(ds.batches(8, 1))
+        for i in range(3):
+            m0 = t0.train_step(tok, lab)
+            m1 = t1.train_step(tok, lab)
+            assert abs(m0.loss - m1.loss) < 1e-5
+        from akka_allreduce_tpu.binder.api import flatten_pytree
+
+        np.testing.assert_allclose(
+            flatten_pytree(t1.params)[0], flatten_pytree(t0.params)[0],
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_pipeline_overlap_bf16(self):
+        import optax
+
+        from akka_allreduce_tpu.binder.api import flatten_pytree
+        from akka_allreduce_tpu.train import PipelineLMTrainer
+
+        mesh = jax.make_mesh((2, 4), ("data", "pipe"))
+        kw = dict(
+            vocab=16, d_model=32, n_heads=4, layers_per_stage=1,
+            microbatches=2, seq_len=32, optimizer=optax.sgd(1e-2),
+        )
+        t0 = PipelineLMTrainer(mesh, **kw)
+        t1 = PipelineLMTrainer(mesh, overlap=True, compress="bf16", **kw)
+        ds = data.lm_copy_task(32, vocab=16)
+        tok, lab = next(ds.batches(4, 1))
+        for _ in range(3):
+            t0.train_step(tok, lab)
+            m1 = t1.train_step(tok, lab)
+        assert np.isfinite(m1.loss)
+        p0 = flatten_pytree(t0.params)[0]
+        p1 = flatten_pytree(t1.params)[0]
+        assert np.abs(p1 - p0).max() / np.abs(p0).max() < 1e-2
+
+    def test_long_context_chain_overlap(self):
+        import optax
+
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+        from akka_allreduce_tpu.train import LongContextTrainer
+
+        t = LongContextTrainer(
+            data_seq_mesh(2, 4), overlap=True, vocab=16, d_model=32,
+            n_heads=4, n_layers=1, seq_len=32, optimizer=optax.sgd(1e-2),
+        )
+        hist = t.train_chain(
+            data.lm_copy_task(32, vocab=16).device_sampler(), 4, 2
+        )
+        assert len(hist) == 4 and np.isfinite(hist[-1].loss)
+
+
 class TestOverlapDependenceStructure:
     def test_one_collective_per_leaf_vs_one_flat_buffer(self, line8):
         ds = data.mnist_like()
