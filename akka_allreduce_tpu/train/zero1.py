@@ -20,6 +20,9 @@ Numerically identical to ``DPTrainer`` with the same optimizer (verified in
 tests/test_zero1.py) — except under ``compress="bf16"``, which runs the
 gradient reduce-scatter in bfloat16 on the wire (half the ICI bytes; weights
 and their all_gather stay float32), trading bit-identity for bandwidth.
+``error_feedback=True`` composes with it (DPTrainer's EF contract: a masked
+device banks its whole gradient); the residual is purely local here, so EF
+adds no collective.
 Checkpointing goes through ``TrainerCheckpointer``'s trainer-defined protocol
 (``checkpoint_state``/``restore_checkpoint_state``): the flat weight vector
 and optimizer moments serialize UNPADDED (mesh-size-independent), so an
@@ -70,6 +73,7 @@ class Zero1DPTrainer:
         loss_fn: Callable | None = None,
         seed: int = 0,
         compress: str | None = None,
+        error_feedback: bool = False,
     ) -> None:
         if len(mesh.axis_names) != 1:
             raise ValueError(
@@ -79,9 +83,17 @@ class Zero1DPTrainer:
             raise ValueError(
                 f"compress must be None or 'bf16', got {compress!r}"
             )
+        if error_feedback and compress != "bf16":
+            raise ValueError(
+                "error_feedback requires compress='bf16' (same contract as "
+                "DPTrainer: lossless sync has no residual to carry)"
+            )
         # informational only: the jitted step closes over the constructor
         # value — mutating this attribute after construction has no effect
         self.compress = compress
+        # NOT merely informational: dispatches train_step and the
+        # checkpoint protocol — construct a new trainer to change it
+        self.error_feedback = error_feedback
         self.model = model
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
@@ -132,7 +144,7 @@ class Zero1DPTrainer:
         loss_impl = self._loss
         tx = self.tx
 
-        def step(flat_params, opt_state, x, y, valid):
+        def compute(flat_params, opt_state, ef, x, y, valid):
             v = valid.reshape(())
             contributors = lax.psum(v, axis)
             denom = jnp.maximum(contributors, 1.0)
@@ -146,16 +158,31 @@ class Zero1DPTrainer:
                 return loss_impl(logits, y)
 
             loss, gflat = jax.value_and_grad(local_loss)(full)
-            gpad = jnp.pad(gflat * v, (0, shard * lax.axis_size(axis) - count))
+            gpad = jnp.pad(gflat, (0, shard * lax.axis_size(axis) - count))
             # masked reduce-scatter: my shard of sum_d(v_d * g_d) — in bf16
             # on the wire when compressing (weights all_gather stays f32:
             # compression here is a GRADIENT trade, not a weight truncation)
             if compress == "bf16":
+                if ef is not None:
+                    # EF-SGD over the reduce-scatter (DPTrainer contract:
+                    # c = g + e; send cast(c·v); e' = c - sent). A masked
+                    # device sends nothing, so its WHOLE contribution banks
+                    # in e'. The residual is purely LOCAL — each device
+                    # knows exactly what the cast withheld — so EF costs no
+                    # extra collective here.
+                    c = gpad.reshape(-1) + ef.reshape(-1)
+                    sent16 = (c * v).astype(jnp.bfloat16)
+                    new_ef = (c - sent16.astype(jnp.float32)).reshape(ef.shape)
+                    wire = sent16
+                else:
+                    new_ef = None
+                    wire = (gpad * v).astype(jnp.bfloat16)
                 gshard = lax.psum_scatter(
-                    gpad.astype(jnp.bfloat16), axis, tiled=True
+                    wire, axis, tiled=True
                 ).astype(jnp.float32) / denom
             else:
-                gshard = lax.psum_scatter(gpad, axis, tiled=True) / denom
+                new_ef = None
+                gshard = lax.psum_scatter(gpad * v, axis, tiled=True) / denom
             # my param shard + my optimizer shard -> updated shard
             my = lax.axis_index(axis)
             pshard = lax.dynamic_slice_in_dim(
@@ -166,7 +193,12 @@ class Zero1DPTrainer:
             # all-gather the updated shards back to full replicated params
             new_flat = lax.all_gather(new_shard, axis, tiled=True)
             loss_avg = lax.psum(loss * v, axis) / denom
-            return new_flat, new_opt, loss_avg, contributors
+            if ef is None:
+                return new_flat, new_opt, loss_avg, contributors
+            return new_flat, new_opt, new_ef, loss_avg, contributors
+
+        def step(flat_params, opt_state, x, y, valid):
+            return compute(flat_params, opt_state, None, x, y, valid)
 
         data_spec = P(axis)
         self._step = jax.jit(
@@ -183,6 +215,32 @@ class Zero1DPTrainer:
             ),
             donate_argnums=(0, 1),
         )
+        if error_feedback:
+            # per-device residual of the compressed reduce-scatter, padded
+            # to the shard geometry (same layout as the wire vector);
+            # materialized ON DEVICE — at ZeRO scale the global buffer is
+            # n x model-size, far too big to stream from host as zeros
+            self._ef = jax.jit(
+                lambda: jnp.zeros((n, self._padded), jnp.float32),
+                out_shardings=NamedSharding(mesh, P(axis)),
+            )()
+
+            def step_ef(flat_params, opt_state, ef, x, y, valid):
+                return compute(flat_params, opt_state, ef, x, y, valid)
+
+            self._step_ef = jax.jit(
+                jax.shard_map(
+                    step_ef,
+                    mesh=mesh,
+                    in_specs=(
+                        P(), self._opt_specs, data_spec, data_spec,
+                        data_spec, data_spec,
+                    ),
+                    out_specs=(P(), self._opt_specs, data_spec, P(), P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1, 2),
+            )
 
         def eval_correct(flat_params, x, y):
             logits = model_apply(unravel(flat_params.reshape(-1)[:count]), x)
@@ -249,10 +307,17 @@ class Zero1DPTrainer:
                 return arr
             return arr.reshape(-1)[:count]
 
-        return {
+        state = {
             "flat_params": self.get_flat_params(),
             "opt_state": jax.tree.map(unpad, self.opt_state),
         }
+        if self.error_feedback:
+            # mesh-size-independent form: the SUM over devices is what the
+            # collective is still owed; restore splits it evenly (same
+            # cross-mesh strategy as checkpoint._restore_ef)
+            ef = np.asarray(jax.device_get(self._ef))
+            state["ef_sum"] = ef.sum(axis=0)[:count]
+        return state
 
     def checkpoint_template(self) -> dict:
         """Abstract (shape/dtype-only) form of :meth:`checkpoint_state` for
@@ -266,10 +331,13 @@ class Zero1DPTrainer:
                 return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
             return jax.ShapeDtypeStruct((count,), leaf.dtype)
 
-        return {
+        state = {
             "flat_params": jax.ShapeDtypeStruct((count,), jnp.float32),
             "opt_state": jax.tree.map(tmpl, self.opt_state),
         }
+        if self.error_feedback:
+            state["ef_sum"] = jax.ShapeDtypeStruct((count,), jnp.float32)
+        return state
 
     def restore_checkpoint_state(self, state: dict) -> None:
         """Re-place restored (unpadded) state on this trainer's mesh: flat
@@ -298,6 +366,22 @@ class Zero1DPTrainer:
         self.opt_state = jax.tree.map(
             reshard, state["opt_state"], self._opt_specs
         )
+        if self.error_feedback:
+            if "ef_sum" in state:
+                ef_sum = np.asarray(state["ef_sum"], np.float32)
+                per = np.tile(ef_sum / self.n_devices, (self.n_devices, 1))
+                per = np.pad(per, ((0, 0), (0, pad)))
+                self._ef = jax.device_put(
+                    per, NamedSharding(self.mesh, P(self.axis))
+                )
+            else:
+                # the checkpoint carries no residual (e.g. written by a
+                # non-EF trainer): a stale live residual would inject the
+                # PREVIOUS run's withheld gradients into this one — reset
+                self._ef = jax.jit(
+                    lambda: jnp.zeros_like(self._ef),
+                    out_shardings=NamedSharding(self.mesh, P(self.axis)),
+                )()
 
     # -- stepping --------------------------------------------------------------
 
@@ -310,9 +394,16 @@ class Zero1DPTrainer:
         valid_arr = normalize_valid(valid, self.n_devices)
         xd, yd = self._place_batch(x, y)
         vd = jax.device_put(valid_arr, self._data_sharding)
-        self.flat_params, self.opt_state, loss, cnt = self._step(
-            self.flat_params, self.opt_state, xd, yd, vd
-        )
+        if self.error_feedback:
+            (
+                self.flat_params, self.opt_state, self._ef, loss, cnt,
+            ) = self._step_ef(
+                self.flat_params, self.opt_state, self._ef, xd, yd, vd
+            )
+        else:
+            self.flat_params, self.opt_state, loss, cnt = self._step(
+                self.flat_params, self.opt_state, xd, yd, vd
+            )
         self.step_num += 1
         return TrainStepMetrics(
             step=self.step_num, loss=float(loss), contributors=float(cnt)

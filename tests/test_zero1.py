@@ -161,6 +161,83 @@ def test_zero1_checkpoint_remesh_restore(tmp_path, line8):
     )
 
 
+class TestZero1ErrorFeedback:
+    """EF over the bf16 reduce-scatter: the residual is purely local
+    (each device knows what the cast withheld), so EF costs no extra
+    collective; DPTrainer's contract otherwise (c = g + e, send cast(c*v),
+    e' = c - sent — a masked device banks its whole gradient)."""
+
+    def _mk(self, mesh, ef=True):
+        # same optimizer as _make so EF-vs-f32 compares only the wire
+        return Zero1DPTrainer(
+            MLP(hidden=(32,), classes=10),
+            mesh,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.adam(1e-3),
+            seed=0,
+            compress="bf16",
+            error_feedback=ef,
+        )
+
+    def test_trains_and_stays_close_to_f32(self, line8):
+        t_f32 = _make(Zero1DPTrainer, line8)
+        t_ef = self._mk(line8)
+        ds = data.mnist_like()
+        h = []
+        for x, y in ds.batches(64, 10):
+            t_f32.train_step(x, y)
+            h.append(t_ef.train_step(x, y))
+        assert h[-1].loss < h[0].loss
+        # adam vs adam drift dominated by bf16 dust, bounded like DPTrainer
+        drift = np.abs(t_ef.get_flat_params() - t_f32.get_flat_params()).max()
+        scale = np.abs(t_f32.get_flat_params()).max()
+        assert drift / scale < 2e-2
+        assert float(np.abs(np.asarray(t_ef._ef)).max()) > 0
+
+    def test_masked_device_banks_whole_gradient(self, line8):
+        t = self._mk(line8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 7.0
+        ef = np.asarray(t._ef)
+        masked_norm = np.linalg.norm(ef[3])
+        other = max(np.linalg.norm(ef[i]) for i in range(8) if i != 3)
+        assert masked_norm > 50 * other, (masked_norm, other)
+
+    def test_requires_bf16(self, line8):
+        with pytest.raises(ValueError, match="error_feedback"):
+            Zero1DPTrainer(
+                MLP(hidden=(32,), classes=10),
+                line8,
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                error_feedback=True,
+            )
+
+    def test_checkpoint_roundtrip_and_remesh(self, tmp_path, line8):
+        from akka_allreduce_tpu.train import TrainerCheckpointer
+
+        t = self._mk(line8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[2] = 0.0
+        t.train_step(x, y, valid)
+        ef_sum = np.asarray(t._ef).sum(axis=0)[: t.param_count]
+        with TrainerCheckpointer(tmp_path / "z1ef") as ckpt:
+            assert ckpt.save(t)
+            fresh = self._mk(line_mesh(4))  # re-mesh 8 -> 4
+            ckpt.restore(fresh)
+        np.testing.assert_array_equal(
+            fresh.get_flat_params(), t.get_flat_params()
+        )
+        # the owed residual SUM is preserved across the re-mesh
+        fresh_sum = np.asarray(fresh._ef).sum(axis=0)[: fresh.param_count]
+        np.testing.assert_allclose(fresh_sum, ef_sum, rtol=1e-6, atol=1e-7)
+
+
 def test_zero1_bf16_wire_close_to_f32(line8):
     a = _make(Zero1DPTrainer, line8)
     b = _make(Zero1DPTrainer, line8, compress="bf16")
