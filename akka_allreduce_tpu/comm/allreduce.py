@@ -388,10 +388,9 @@ def _compress_seg(seg: jax.Array, mode: str) -> tuple[jax.Array, jax.Array]:
     """
     if mode == "bf16":
         return seg.astype(jnp.bfloat16), jnp.ones((), jnp.float32)
-    amax = jnp.max(jnp.abs(seg))
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
-    q = jnp.clip(jnp.round(seg / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+    from akka_allreduce_tpu.ops.ring import int8_quantize
+
+    return int8_quantize(seg)
 
 
 def _decompress_seg(payload: jax.Array, scale: jax.Array, mode: str) -> jax.Array:
@@ -419,7 +418,9 @@ def ring_allreduce_sum(
     accumulation stays float32. Partial sums are re-quantized per hop, so the
     error grows ~linearly in ring length — the standard compressed-ring
     trade. The reduced result is quantized ONCE more for the gather phase (on
-    the owner too), so every device returns bit-identical output.
+    the owner too), so every device returns bit-identical output under bf16;
+    under int8 the per-hop scale round trip ((127·scale)/127 in f32) drifts
+    the last bit, so devices agree to ~1 ulp, not bit-exactly.
     """
     n = axis_size
     if n == 1:
@@ -534,11 +535,10 @@ def build_threshold_allreduce(
         raise ValueError("ring schedules reduce over exactly one axis")
     if compress not in (None, "bf16", "int8"):
         raise ValueError(f"unknown compress mode {compress!r}")
-    if compress == "int8" and schedule != "ring":
+    if compress == "int8" and schedule not in ("ring", "pallas_ring"):
         raise ValueError(
-            "int8 compression needs per-hop scales: only the explicit ring "
-            "schedule carries them (psum/butterfly sum on the wire; the "
-            "pallas_ring kernel stages bf16 hops only)"
+            "int8 compression needs per-hop scales: only the ring schedules "
+            "carry them (psum/butterfly sum on the wire)"
         )
 
     spec_in = P(axis_names if len(axis_names) > 1 else axis_names[0])

@@ -32,8 +32,21 @@ _DEF_SEG_ROWS = 512  # per-step transfer: 512*128 fp32 = 256 KB
 _LOGICAL = pltpu.DeviceIdType.LOGICAL
 
 
-def _ring_kernel(n: int, axis_name: str, compress: bool, x_ref, out_ref,
-                 *scratch):
+def int8_quantize(seg: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-segment max-abs int8 quantization: ``(q, scale)``.
+
+    THE int8 wire formula — shared by this kernel and the XLA ring
+    (comm.allreduce._compress_seg), whose drift-equivalence the tests
+    assert; an all-zero segment maps to scale 1 so dequantize never
+    divides by zero."""
+    amax = jnp.max(jnp.abs(seg))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(seg / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _ring_kernel(n: int, axis_name: str, compress: str | None, x_ref,
+                 out_ref, *scratch):
     """One bucket: (n*seg_rows, LANE) in VMEM -> allreduced same shape.
 
     Unified reduce-scatter + all-gather loop, 2(n-1) steps. Step s:
@@ -47,13 +60,22 @@ def _ring_kernel(n: int, axis_name: str, compress: bool, x_ref, out_ref,
     zero by kernel end.
 
     ``compress``: every hop's wire payload rides bfloat16 (half the ICI
-    bytes) staged through ``send_buf``; the VMEM accumulator stays f32.
-    Semantics mirror comm.allreduce.ring_allreduce_sum(compress="bf16"):
-    partial sums re-quantize per RS hop, and the reduced segment is
-    quantized ONCE more before the gather phase — on the owner's copy too —
-    so every device returns bit-identical output.
+    bytes) or int8 with a per-segment max-abs scale (a quarter; the scale
+    travels as a tiny second DMA), staged through ``send_buf``; the VMEM
+    accumulator stays f32. Semantics mirror
+    comm.allreduce.ring_allreduce_sum(compress=...): partial sums
+    re-quantize per RS hop, and the reduced segment is quantized ONCE more
+    before the gather phase — on the owner's copy too — so every device
+    returns bit-identical output under bf16 (re-casting a bf16-representable
+    value is lossless) and ulp-identical under int8 (each AG hop's
+    scale = (127·scale)/127 round trip drifts the last f32 bit; the XLA
+    int8 ring drifts identically).
     """
-    if compress:
+    scale_send = scale_recv = scale_send_sem = scale_recv_sem = None
+    if compress == "int8":
+        (recv_buf, send_buf, scale_recv, scale_send, send_sem, recv_sem,
+         scale_send_sem, scale_recv_sem, cap_sem) = scratch
+    elif compress == "bf16":
         recv_buf, send_buf, send_sem, recv_sem, cap_sem = scratch
     else:
         (recv_buf, send_sem, recv_sem, cap_sem), send_buf = scratch, None
@@ -81,27 +103,39 @@ def _ring_kernel(n: int, axis_name: str, compress: bool, x_ref, out_ref,
         recv_idx = lax.rem(jnp.where(rs, my - s - 1, my - sp) + 2 * n, n)
         slot = lax.rem(s, 2)
 
-        if compress:
+        quantize = int8_quantize
+
+        if compress is not None:
             # entering the gather phase: quantize the OWNED reduced segment
             # (seg (my+1) % n, the first AG send) in place, so the owner's
             # copy equals what every peer will reconstruct from the wire
             @pl.when(s == n - 1)
             def _():
                 own = pl.ds(lax.rem(my + 1, n) * seg_rows, seg_rows)
-                out_ref[own] = (
-                    out_ref[own].astype(jnp.bfloat16).astype(out_ref.dtype)
-                )
+                if compress == "bf16":
+                    out_ref[own] = (
+                        out_ref[own].astype(jnp.bfloat16).astype(out_ref.dtype)
+                    )
+                else:
+                    q, scale = quantize(out_ref[own])
+                    out_ref[own] = q.astype(out_ref.dtype) * scale
 
         @pl.when(s >= 2)
         def _():
             pltpu.semaphore_wait(cap_sem, 1)
 
         src_slice = pl.ds(send_idx * seg_rows, seg_rows)
-        if compress:
-            # stage the hop payload as bf16: the DMA then moves half the
-            # bytes; the previous send from this slot completed at step s-2
-            # (rdma.wait() blocks on send completion), so the write is safe
-            send_buf[slot] = out_ref[src_slice].astype(send_buf.dtype)
+        if compress is not None:
+            # stage the hop payload compressed: the DMA then moves half
+            # (bf16) or a quarter (int8) of the bytes; the previous send
+            # from this slot completed at step s-2 (rdma.wait() blocks on
+            # send completion), so the write is safe
+            if compress == "bf16":
+                send_buf[slot] = out_ref[src_slice].astype(send_buf.dtype)
+            else:
+                q, scale = quantize(out_ref[src_slice])
+                send_buf[slot] = q
+                scale_send[slot] = jnp.full((1, LANE), scale, jnp.float32)
             src_ref = send_buf.at[slot]
         else:
             src_ref = out_ref.at[src_slice]
@@ -114,19 +148,37 @@ def _ring_kernel(n: int, axis_name: str, compress: bool, x_ref, out_ref,
             device_id_type=_LOGICAL,
         )
         rdma.start()
+        if compress == "int8":
+            scale_rdma = pltpu.make_async_remote_copy(
+                src_ref=scale_send.at[slot],
+                dst_ref=scale_recv.at[slot],
+                send_sem=scale_send_sem.at[slot],
+                recv_sem=scale_recv_sem.at[slot],
+                device_id=right,
+                device_id_type=_LOGICAL,
+            )
+            scale_rdma.start()
+            scale_rdma.wait()
         # wait() blocks on BOTH our send completing and the symmetric
         # incoming copy from the left neighbor landing in recv_buf[slot]
         rdma.wait()
 
         dst = pl.ds(recv_idx * seg_rows, seg_rows)
+        if compress == "int8":
+            recv_val = (
+                recv_buf[slot].astype(out_ref.dtype)
+                * scale_recv[slot][0, 0]
+            )
+        else:
+            recv_val = recv_buf[slot].astype(out_ref.dtype)
 
         @pl.when(rs)
         def _():
-            out_ref[dst] = out_ref[dst] + recv_buf[slot].astype(out_ref.dtype)
+            out_ref[dst] = out_ref[dst] + recv_val
 
         @pl.when(jnp.logical_not(rs))
         def _():
-            out_ref[dst] = recv_buf[slot].astype(out_ref.dtype)
+            out_ref[dst] = recv_val
 
         # slot consumed: left neighbor may overwrite it (their step s+2)
         @pl.when(s <= total_steps - 3)
@@ -164,19 +216,18 @@ def pallas_ring_allreduce_sum(
     the wrong signal when a TPU plugin is present but the mesh is a virtual
     CPU one — compiled-mode Pallas would then lower onto CPU and fail.
 
-    ``compress="bf16"`` stages every hop through a bfloat16 send buffer —
-    half the wire bytes, f32 VMEM accumulation (see ``_ring_kernel``).
+    ``compress`` stages every hop through a compressed send buffer —
+    ``"bf16"`` halves the wire bytes, ``"int8"`` quarters them with a
+    per-segment max-abs scale riding a tiny second DMA; f32 VMEM
+    accumulation either way (see ``_ring_kernel``).
     ``collective_id`` must be UNIQUE among collective Pallas kernels alive
     in one program; compose-with-another-kernel callers pass their own.
     """
     n = axis_size
     if n == 1:
         return x
-    if compress not in (None, "bf16"):
-        raise ValueError(
-            f"pallas_ring compress supports only 'bf16', got {compress!r} "
-            "(int8 per-hop scales are not implemented in the kernel)"
-        )
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}")
     if interpret is None:
         from akka_allreduce_tpu.ops._platform import interpret_default
 
@@ -192,17 +243,26 @@ def pallas_ring_allreduce_sum(
     else:
         interp = False
 
-    wire = jnp.bfloat16 if compress == "bf16" else x.dtype
+    wire = {"bf16": jnp.bfloat16, "int8": jnp.int8, None: x.dtype}[compress]
     scratch = [pltpu.VMEM((2, seg_rows, LANE), wire)]  # recv slots
-    if compress == "bf16":
+    if compress is not None:
         scratch.append(pltpu.VMEM((2, seg_rows, LANE), wire))  # send staging
+    if compress == "int8":
+        # per-segment scales: one f32 each, padded to a lane tile
+        scratch.append(pltpu.VMEM((2, 1, LANE), jnp.float32))  # scale recv
+        scratch.append(pltpu.VMEM((2, 1, LANE), jnp.float32))  # scale send
     scratch += [
         pltpu.SemaphoreType.DMA((2,)),  # send
         pltpu.SemaphoreType.DMA((2,)),  # recv
-        pltpu.SemaphoreType.REGULAR,  # capacity (back-pressure)
     ]
+    if compress == "int8":
+        scratch += [
+            pltpu.SemaphoreType.DMA((2,)),  # scale send
+            pltpu.SemaphoreType.DMA((2,)),  # scale recv
+        ]
+    scratch.append(pltpu.SemaphoreType.REGULAR)  # capacity (back-pressure)
     call = pl.pallas_call(
-        functools.partial(_ring_kernel, n, axis_name, compress == "bf16"),
+        functools.partial(_ring_kernel, n, axis_name, compress),
         out_shape=jax.ShapeDtypeStruct((n * seg_rows, LANE), x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
