@@ -38,8 +38,10 @@ class BandwidthReport:
     iters: int
     mean_s: float
     min_s: float
+    median_s: float
     bus_gbps_mean: float
     bus_gbps_best: float
+    bus_gbps_median: float  # the robust headline (bench.py's estimator ethos)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -115,6 +117,7 @@ def measure_allreduce(
 
     mean_s = float(np.mean(times))
     min_s = float(np.min(times))
+    median_s = float(np.median(times))
     return BandwidthReport(
         num_floats=num_floats,
         n_devices=n,
@@ -122,6 +125,8 @@ def measure_allreduce(
         iters=iters,
         mean_s=mean_s,
         min_s=min_s,
+        median_s=median_s,
         bus_gbps_mean=bus_bandwidth_gbps(n, nbytes, mean_s),
         bus_gbps_best=bus_bandwidth_gbps(n, nbytes, min_s),
+        bus_gbps_median=bus_bandwidth_gbps(n, nbytes, median_s),
     )
