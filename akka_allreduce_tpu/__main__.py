@@ -104,15 +104,22 @@ def _cmd_bench(argv: list[str]) -> int:
     return 0
 
 
-def _train_flags(p: argparse.ArgumentParser) -> None:
-    _add_mesh_flags(p)
+def _basic_train_flags(p: argparse.ArgumentParser) -> None:
+    """The shared core every DP training CLI carries — train-zero1 uses
+    exactly this subset, so its defaults can never drift from train-mlp's
+    (the advertised numerical equivalence depends on them)."""
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch", type=int, default=64, help="global batch size")
     p.add_argument("--lr", type=float, default=0.1)
-    p.add_argument("--bucket", type=int, default=None, help="grad bucket (elements)")
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+
+
+def _train_flags(p: argparse.ArgumentParser) -> None:
+    _add_mesh_flags(p)
+    _basic_train_flags(p)
+    p.add_argument("--bucket", type=int, default=None, help="grad bucket (elements)")
     p.add_argument(
         "--profile-dir",
         default=None,
@@ -337,13 +344,8 @@ def _cmd_train_zero1(argv: list[str]) -> int:
         "with the same optimizer — tests/test_zero1.py)",
     )
     p.add_argument("--devices", type=int, default=None, help="1D mesh size")
-    p.add_argument("--steps", type=int, default=30)
-    p.add_argument("--batch", type=int, default=64, help="global batch size")
-    p.add_argument("--lr", type=float, default=0.1)
+    _basic_train_flags(p)
     p.add_argument("--hidden", type=int, nargs="+", default=[128])
-    p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
-    p.add_argument("--checkpoint-dir", default=None)
-    p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument(
         "--compress",
         choices=("bf16",),

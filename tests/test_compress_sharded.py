@@ -104,6 +104,34 @@ class TestLongContextCompress:
                 data_seq_model_mesh(2, 2, 2), compress="int8", **self.KW
             )
 
+    def test_bf16_with_ulysses_attention(self, lm_batches):
+        """compress is orthogonal to the attention schedule: same oracle
+        with the Ulysses all-to-all core instead of the ring."""
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+
+        mesh = data_seq_mesh(2, 4)
+        kw = dict(self.KW, seq_impl="ulysses")
+        t0 = LongContextTrainer(mesh, **kw)
+        t1 = LongContextTrainer(mesh, compress="bf16", **kw)
+        batches = [(x[:4], y[:4]) for x, y in lm_batches]
+        _run_pair(t0, t1, batches, t0.dp)
+
+    def test_overlap_with_ulysses_attention(self, lm_batches):
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+
+        mesh = data_seq_mesh(2, 4)
+        kw = dict(self.KW, seq_impl="ulysses")
+        t0 = LongContextTrainer(mesh, **kw)
+        t1 = LongContextTrainer(mesh, overlap=True, **kw)
+        x, y = lm_batches[0]
+        for _ in range(3):
+            m0 = t0.train_step(x[:4], y[:4])
+            m1 = t1.train_step(x[:4], y[:4])
+            assert abs(m0.loss - m1.loss) < 1e-5
+        np.testing.assert_allclose(
+            t1.get_flat_params(), t0.get_flat_params(), rtol=1e-5, atol=1e-6
+        )
+
 
 class TestMoECompress:
     KW = dict(

@@ -107,6 +107,27 @@ class TestElasticDPTrainer:
         m = t.train_step(*next(iter(ds.batches(24, 1, seed_offset=5))))
         assert m.contributors == 6.0 and np.isfinite(m.loss)
 
+    def test_remesh_with_compressed_overlapped_trainer(self):
+        """trainer_kwargs forward to the rebuilt DPTrainer: a re-mesh must
+        preserve the compress/overlap configuration, not silently rebuild a
+        plain trainer."""
+        t, now = elastic(compress="bf16", overlap=True)
+        assert t.trainer.compress == "bf16" and t.trainer.overlap
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(32, 1)))
+        for n in range(4):
+            t.heartbeat(n)
+        t.train_step(x, y)
+        for _ in range(10):
+            for n in range(3):
+                t.heartbeat(n)
+            now["t"] += 1.0
+        assert t.poll()
+        # the generation-1 trainer kept the wire configuration
+        assert t.trainer.compress == "bf16" and t.trainer.overlap
+        m = t.train_step(*next(iter(ds.batches(24, 1, seed_offset=7))))
+        assert m.contributors == 6.0 and np.isfinite(m.loss)
+
     def test_late_joiner_rejoins_mesh(self):
         t, now = elastic(n_nodes=3)
         ds = data.mnist_like()
