@@ -50,6 +50,18 @@ def _make(mesh, **kw):
     )
 
 
+def _bf16_all_reduces(txt: str) -> list[tuple[str, str]]:
+    """(replica_groups, tensor type) of each bf16 all_reduce in StableHLO —
+    the ONE copy of the fragile MLIR text pattern in this module."""
+    ops = re.findall(
+        r'"stablehlo\.all_reduce".*?replica_groups = dense<(\[\[.*?\]\])>'
+        r".*?\}\) : \(tensor<([^>]*)>",
+        txt,
+        re.S,
+    )
+    return [(g, t) for g, t in ops if "bf16" in t]
+
+
 def _bf16_all_reduce_shapes(trainer, x, y) -> list[str]:
     """Tensor types of bf16 all_reduce ops in the step's emitted StableHLO."""
     xd, yd = trainer._place_batch(x, y)
@@ -59,10 +71,7 @@ def _bf16_all_reduce_shapes(trainer, x, y) -> list[str]:
     txt = trainer._step.lower(
         trainer.params, trainer.opt_state, xd, yd, vd
     ).as_text()
-    ops = re.findall(
-        r'"stablehlo\.all_reduce".*?\}\) : \(tensor<([^>]*)>', txt, re.S
-    )
-    return [t for t in ops if "bf16" in t]
+    return [t for _, t in _bf16_all_reduces(txt)]
 
 
 class TestOverlapNumerics:
@@ -193,6 +202,47 @@ class TestShardedTrainerOverlap:
         p0 = flatten_pytree(t0.params)[0]
         p1 = flatten_pytree(t1.params)[0]
         assert np.abs(p1 - p0).max() / np.abs(p0).max() < 1e-2
+
+    def test_tp_reduce_axes_classes_in_stablehlo(self):
+        """On the DP x SP x TP mesh, overlap+bf16 must emit per-leaf bf16
+        collectives in TWO replica-group classes: replicated leaves reduce
+        over all 8 devices, TP-sharded leaves only over data x seq (groups
+        that fix the model coordinate) — the reduce-axes classes of
+        backward_tree_sync, visible in the emitted IR."""
+        import optax
+
+        from akka_allreduce_tpu.comm.allreduce import spec_axes
+        from akka_allreduce_tpu.parallel import data_seq_model_mesh
+        from akka_allreduce_tpu.train import LongContextTrainer
+
+        mesh = data_seq_model_mesh(2, 2, 2)
+        t = LongContextTrainer(
+            mesh, overlap=True, compress="bf16", vocab=16, d_model=32,
+            n_heads=4, n_layers=1, seq_len=32, optimizer=optax.sgd(1e-2),
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        tok, lab = next(ds.batches(4, 1))
+        xd, yd = t._place(tok, lab)
+        vd = jax.device_put(
+            np.ones((t.dp,), np.float32), t._valid_sharding
+        )
+        txt = t._step.lower(t.params, t.opt_state, xd, yd, vd).as_text()
+        bf16 = _bf16_all_reduces(txt)
+        all8 = [g for g, _ in bf16 if g == "[[0, 1, 2, 3, 4, 5, 6, 7]]"]
+        partial = [g for g, _ in bf16 if g != "[[0, 1, 2, 3, 4, 5, 6, 7]]"]
+        # leaf census from the trainer's own specs
+        from jax.sharding import PartitionSpec as P
+
+        spec_leaves = jax.tree.leaves(
+            t._param_specs, is_leaf=lambda s: isinstance(s, P)
+        )
+        n_replicated = sum(1 for s in spec_leaves if not spec_axes(s))
+        n_tp = len(spec_leaves) - n_replicated
+        assert n_tp > 0  # the mesh really shards something
+        assert len(all8) == n_replicated, (len(all8), n_replicated)
+        assert len(partial) == n_tp, (len(partial), n_tp)
+        # TP groups fix the model coordinate: 2 groups of 4 on this mesh
+        assert all("], [" in g for g in partial), partial
 
     def test_long_context_chain_overlap(self):
         import optax
