@@ -24,6 +24,18 @@ import sys
 import time
 
 
+def _add_wire_dtype_flag(p: argparse.ArgumentParser) -> None:
+    """TCP wire compression for the host data plane (cluster masters only —
+    the knob is distributed to every node via Welcome)."""
+    p.add_argument(
+        "--wire-dtype",
+        choices=("f32", "f16"),
+        default="f32",
+        help="float width of Scatter/ReduceBlock payloads on the TCP wire; "
+        "f16 halves the network bytes (accumulation stays f32)",
+    )
+
+
 def _add_sharded_compress_flag(p: argparse.ArgumentParser) -> None:
     """--compress/--overlap for the sharded-param trainers (train-lm/-moe/-pp)."""
     p.add_argument(
@@ -561,6 +573,7 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=1.0, help="interval (s)")
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
+    _add_wire_dtype_flag(p)
     args = p.parse_args(argv)
     return _run_cluster_master(args)
 
@@ -584,7 +597,11 @@ def _run_cluster_master(args) -> int:
 
     cfg = AllreduceConfig(
         threshold=ThresholdConfig(args.th, args.th, args.th),
-        metadata=MetaDataConfig(data_size=args.size, max_chunk_size=args.chunk),
+        metadata=MetaDataConfig(
+            data_size=args.size,
+            max_chunk_size=args.chunk,
+            wire_dtype=getattr(args, "wire_dtype", "f32"),
+        ),
         line_master=LineMasterConfig(round_window=2, max_rounds=args.rounds),
         master=MasterConfig(
             node_num=args.nodes,
@@ -764,6 +781,7 @@ def _cmd_train_cluster_master(argv: list[str]) -> int:
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=0.5, help="interval (s)")
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
+    _add_wire_dtype_flag(p)
     args = p.parse_args(argv)
     args.size = _cluster_trainer(args, 0.1).param_count
     print(f"model: {args.size} params -> data_size {args.size}", flush=True)

@@ -70,6 +70,11 @@ class MetaDataConfig:
 
     data_size: int
     max_chunk_size: int = 262_144
+    # "f16" halves the TCP bytes of every Scatter/ReduceBlock payload (the
+    # host data plane's analog of the XLA paths' bf16 wire); accumulation
+    # stays float32 — the cast happens at the socket, both directions.
+    # Distributed via Welcome like every other knob, so nodes inherit it.
+    wire_dtype: str = "f32"
 
     def __post_init__(self) -> None:
         if self.data_size <= 0:
@@ -77,6 +82,10 @@ class MetaDataConfig:
         if self.max_chunk_size <= 0:
             raise ValueError(
                 f"max_chunk_size must be positive, got {self.max_chunk_size}"
+            )
+        if self.wire_dtype not in ("f32", "f16"):
+            raise ValueError(
+                f"wire_dtype must be 'f32' or 'f16', got {self.wire_dtype!r}"
             )
 
     def block_size(self, peer_size: int) -> int:

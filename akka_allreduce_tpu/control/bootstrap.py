@@ -82,6 +82,7 @@ class MasterProcess:
         # be answered with a Shutdown instead of silently orphaning it
         self._superseded: dict[int, tuple[int, cl.Endpoint]] = {}
         self.transport = RemoteTransport(host, port)
+        self.transport.wire_f16 = config.metadata.wire_dtype == "f16"
         self.transport.register("master", self._on_cluster_msg)
         self.transport.register_prefix("line_master", self.grid.handle_for_line)
         self.transport.set_prefix_route("worker", self._worker_endpoint)
@@ -538,6 +539,10 @@ class NodeProcess:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
         self.config = AllreduceConfig.from_json(msg.config_json)
+        # the wire-compression knob arrives with the config, like every
+        # other knob: payloads we send from now on ride at the configured
+        # width (decode is stateless — the flag travels per frame)
+        self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
         self.node_id = msg.node_id
         dims = self.config.master.dimensions
         self.node = AllreduceNode(

@@ -66,6 +66,10 @@ class RemoteTransport:
         # fault injection (the reference tests by omitting messages,
         # SURVEY.md §5): return True to swallow an outgoing envelope
         self.drop_filter: Callable[[Envelope], bool] | None = None
+        # wire compression (MetaDataConfig.wire_dtype == "f16"): float
+        # payloads cross the socket at half width; local deliveries and the
+        # decode side are unaffected (the flag travels in the frame)
+        self.wire_f16 = False
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -157,7 +161,7 @@ class RemoteTransport:
             log.warning("no route for %s; dropping", env.dest)
             self.dropped += 1
             return
-        frame = wire.encode_frame(env.dest, env.msg)
+        frame = wire.encode_frame(env.dest, env.msg, f16=self.wire_f16)
         # One reconnect-and-retry: a cached connection whose peer restarted
         # fails on the first write after the restart — that staleness is this
         # transport's problem, not the control plane's. A failure on a FRESH

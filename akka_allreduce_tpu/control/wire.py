@@ -58,9 +58,27 @@ def _unpack_str(buf: memoryview, off: int) -> tuple[str, int]:
     return bytes(buf[off : off + n]).decode("utf-8"), off + n
 
 
-def _pack_floats(value: np.ndarray) -> tuple[bytes, memoryview]:
+# Top bit of the u32 element count flags a float16 payload (the wire-
+# compression mode, MetaDataConfig.wire_dtype): the f32 format is unchanged
+# byte for byte, and the flag costs nothing. Decode always hands the engine
+# float32 — compression lives entirely on the wire.
+_F16_FLAG = 0x8000_0000
+
+
+_F16_MAX = np.float32(65504.0)  # float16's finite range
+
+
+def _pack_floats(value: np.ndarray, f16: bool = False) -> tuple[bytes, memoryview]:
     """(length prefix, payload view) — the view is copied exactly once, by the
-    final frame join, instead of once per concatenation level."""
+    final frame join, instead of once per concatenation level. ``f16`` casts
+    the payload to float16 for the wire, SATURATING at ±65504: a silent cast
+    would turn out-of-range elements into inf and poison every downstream
+    f32 accumulation (unlike bf16, float16 trades range for mantissa)."""
+    if f16:
+        arr = np.clip(
+            np.asarray(value, dtype=np.float32), -_F16_MAX, _F16_MAX
+        ).astype("<f2")
+        return _U32.pack(arr.size | _F16_FLAG), memoryview(arr).cast("B")
     arr = np.ascontiguousarray(value, dtype="<f4")
     return _U32.pack(arr.size), memoryview(arr).cast("B")
 
@@ -68,16 +86,21 @@ def _pack_floats(value: np.ndarray) -> tuple[bytes, memoryview]:
 def _unpack_floats(buf: memoryview, off: int) -> tuple[np.ndarray, int]:
     (n,) = _U32.unpack_from(buf, off)
     off += 4
+    if n & _F16_FLAG:
+        n &= ~_F16_FLAG
+        half = np.frombuffer(buf, dtype="<f2", count=n, offset=off)
+        # engine sees f32 only; the astype is the decompression copy
+        return half.astype(np.float32), off + 2 * n
     arr = np.frombuffer(buf, dtype="<f4", count=n, offset=off)
     return arr, off + 4 * n
 
 
-def encode(msg: Any) -> bytes:
+def encode(msg: Any, *, f16: bool = False) -> bytes:
     """Message -> ``[tag][body]`` bytes."""
-    return b"".join(_encode_parts(msg))
+    return b"".join(_encode_parts(msg, f16))
 
 
-def _encode_parts(msg: Any) -> list:
+def _encode_parts(msg: Any, f16: bool = False) -> list:
     """Message -> list of buffer segments (bytes / memoryviews).
 
     Payload-carrying messages keep the float array as a zero-copy view so the
@@ -90,7 +113,7 @@ def _encode_parts(msg: Any) -> list:
     if tag == 1:
         return [head, struct.pack("<q", msg.round_num)]
     if tag == 2:
-        n, payload = _pack_floats(msg.value)
+        n, payload = _pack_floats(msg.value, f16)
         return [
             head,
             struct.pack(
@@ -100,7 +123,7 @@ def _encode_parts(msg: Any) -> list:
             payload,
         ]
     if tag == 3:
-        n, payload = _pack_floats(msg.value)
+        n, payload = _pack_floats(msg.value, f16)
         return [
             head,
             struct.pack(
@@ -221,13 +244,14 @@ def decode(data: bytes | memoryview) -> Any:
     raise ValueError(f"unknown wire tag {tag}")
 
 
-def encode_frame(dest: str, msg: Any) -> bytes:
+def encode_frame(dest: str, msg: Any, *, f16: bool = False) -> bytes:
     """Framed envelope: ``[u32 len][u16 dest_len][dest][tag][body]``.
 
     Built with a single ``join`` over header + payload segments — the float
-    payload is copied exactly once, here, on its way to the socket.
+    payload is copied exactly once, here, on its way to the socket. ``f16``
+    sends float payloads at half width (decode side is automatic).
     """
-    parts = [b"", _pack_str(dest), *_encode_parts(msg)]
+    parts = [b"", _pack_str(dest), *_encode_parts(msg, f16)]
     body_len = sum(len(p) for p in parts)
     parts[0] = _U32.pack(body_len)
     return b"".join(parts)

@@ -72,6 +72,40 @@ def test_wire_frame_roundtrip():
     assert dest == "worker:12" and msg == StartAllreduce(3)
 
 
+def test_wire_f16_payload_roundtrip_and_byte_halving():
+    """MetaDataConfig.wire_dtype="f16": float payloads cross the socket at
+    half width; decode always hands the engine float32 (within f16 eps of
+    the original), and control messages are byte-identical either way."""
+    rng = np.random.default_rng(1)
+    value = rng.standard_normal(4096).astype(np.float32)
+    sb = ScatterBlock(value, 1, 2, 3, 4)
+    full = wire.encode_frame("worker:1", sb)
+    half = wire.encode_frame("worker:1", sb, f16=True)
+    assert len(half) < 0.55 * len(full)
+    _, decoded = wire.decode_frame_body(memoryview(half)[4:])
+    assert decoded.value.dtype == np.float32
+    np.testing.assert_allclose(decoded.value, value, rtol=1e-3, atol=1e-4)
+    rb = ReduceBlock(value, 1, 0, 3, 4, count=5)
+    _, rb2 = wire.decode_frame_body(
+        memoryview(wire.encode_frame("worker:0", rb, f16=True))[4:]
+    )
+    assert rb2.count == 5 and rb2.value.dtype == np.float32
+    np.testing.assert_allclose(rb2.value, value, rtol=1e-3, atol=1e-4)
+    # control messages (no float payload) are unchanged byte for byte
+    ctl = StartAllreduce(3)
+    assert wire.encode_frame("w", ctl) == wire.encode_frame("w", ctl, f16=True)
+    # out-of-f16-range values saturate instead of becoming inf (a silent
+    # inf would poison every downstream accumulation)
+    big = np.array([1e6, -1e6, 3.0], np.float32)
+    _, sat = wire.decode_frame_body(
+        memoryview(
+            wire.encode_frame("w", ScatterBlock(big, 0, 1, 0, 0), f16=True)
+        )[4:]
+    )
+    assert np.isfinite(sat.value).all()
+    np.testing.assert_allclose(sat.value[:2], [65504.0, -65504.0])
+
+
 def test_wire_rejects_unknown():
     with pytest.raises(TypeError):
         wire.encode(object())
@@ -88,10 +122,14 @@ def test_endpoint_parse():
 # --- cluster fixtures ---------------------------------------------------------
 
 
-def _config(n_nodes, *, dims=1, max_rounds=4, size=1000, th=1.0, hb=0.05):
+def _config(
+    n_nodes, *, dims=1, max_rounds=4, size=1000, th=1.0, hb=0.05, wire="f32"
+):
     return AllreduceConfig(
         threshold=ThresholdConfig(th, th, th),
-        metadata=MetaDataConfig(data_size=size, max_chunk_size=128),
+        metadata=MetaDataConfig(
+            data_size=size, max_chunk_size=128, wire_dtype=wire
+        ),
         line_master=LineMasterConfig(round_window=2, max_rounds=max_rounds),
         master=MasterConfig(
             node_num=n_nodes,
@@ -314,6 +352,32 @@ def test_threshold_completion_under_tcp_message_loss():
             rtol=1e-5,
             atol=1e-6,
         )
+
+    asyncio.run(run())
+
+
+def test_cluster_rounds_with_f16_wire():
+    """End-to-end compressed cluster: the master distributes wire_dtype=f16
+    via Welcome, every node's transport sends half-width payloads, and the
+    allreduce average stays within f16 quantization of the exact mean —
+    the host data plane's analog of the XLA paths' bf16 wire."""
+
+    async def run():
+        h = _Harness(_config(3, max_rounds=4, wire="f16"), 3)
+        try:
+            await h.start(3)
+            await h.master.run_until_done(timeout=30.0)
+            # the knob arrived with the config on every node
+            assert all(n.transport.wire_f16 for n in h.nodes.values())
+            assert h.master.transport.wire_f16
+        finally:
+            await h.stop()
+        out = h.outputs[0][-1]
+        assert out.count.min() == 3  # all contributions arrived
+        exact = np.mean(h.inputs[:3], axis=0)
+        scale = np.abs(exact).max()
+        err = np.abs(out.average() - exact).max() / scale
+        assert 0 < err < 2e-3, err  # lossy (so f16 really rode the wire)
 
     asyncio.run(run())
 
