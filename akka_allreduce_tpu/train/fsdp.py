@@ -1,0 +1,337 @@
+"""FSDP / ZeRO-3 LM trainer: params AND optimizer state sharded 1/n.
+
+Beyond-parity capability (the reference is DP-only, SURVEY.md §3), completing
+the ZeRO family next to ``Zero1DPTrainer``: stage 1 shards only the optimizer
+state; this shards the trunk *parameters* too, so per-device memory for the
+model's bulk is ``(params + moments)/n`` — the knob that lets a data-parallel
+group train models larger than one chip's HBM.
+
+Built the TPU way, on the same stacked-trunk substrate as the pipeline
+trainer: the transformer trunk's L layers stack into one params tree with a
+leading layer dim, and each trunk leaf ``(L, *S)`` is stored flattened and
+sharded ``(L, n, per)`` with ``P(None, 'data')`` — device d holds the d-th
+1/n slice of EVERY layer. The forward is a ``lax.scan`` over layers whose
+body ``all_gather``s ONE layer's shards into the full layer, applies the
+block, and discards the gathered copy — so a full layer is materialized only
+transiently. Autodiff does the rest: the transpose of a tiled ``all_gather``
+IS ``psum_scatter``, so each layer's gradient arrives reduce-scattered,
+shard-local, exactly ZeRO-3's gradient flow, with no hand-written collective.
+``remat=True`` additionally recomputes each layer on backward (one layer's
+activations + one layer's params live at a time — the full FSDP memory
+profile).
+
+Embed/head (the small edge leaves) stay replicated with the standard
+transpose-psum gradient, like every other trainer here. Threshold masking is
+per DP device, the same contributor semantics as DPTrainer.
+
+Checkpoints serialize the trunk UNSHARDED (gather-then-reshard at checkpoint
+scale, the ZeRO-1 discipline), so an n-device checkpoint restores onto any
+other device count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.models.transformer import Block
+from akka_allreduce_tpu.train.pipeline import _LMHead
+from akka_allreduce_tpu.train.trainer import TrainStepMetrics, normalize_valid
+
+
+def _shard_leaf(leaf: jax.Array, n: int) -> jax.Array:
+    """(L, *S) -> (L, n, per): flatten, pad to n equal slices per layer."""
+    flat = leaf.reshape(leaf.shape[0], -1)
+    per = -(-flat.shape[1] // n)
+    return jnp.pad(flat, ((0, 0), (0, per * n - flat.shape[1]))).reshape(
+        leaf.shape[0], n, per
+    )
+
+
+def _unshard_leaf(leaf: jax.Array, full_shape: tuple) -> jax.Array:
+    """(L, n, per) -> (L, *S): inverse of :func:`_shard_leaf`."""
+    size = int(np.prod(full_shape[1:]))
+    return leaf.reshape(leaf.shape[0], -1)[:, :size].reshape(full_shape)
+
+
+class FSDPLMTrainer:
+    """Fully-sharded data-parallel trainer for a decoder-only LM.
+
+    Args:
+      mesh: a 1-axis (data,) mesh — the shard group.
+      n_layers: trunk depth (the FSDP-sharded bulk).
+      remat: recompute each layer on backward (jax.checkpoint).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        vocab: int = 64,
+        d_model: int = 64,
+        n_heads: int = 4,
+        n_layers: int = 2,
+        seq_len: int = 64,
+        optimizer: optax.GradientTransformation | None = None,
+        learning_rate: float = 1e-2,
+        seed: int = 0,
+        compute_dtype=jnp.float32,
+        remat: bool = False,
+    ) -> None:
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"FSDP shards over ONE mesh axis, got {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_devices = n = int(mesh.shape[self.axis])
+        self.data_shards = n
+        self.seq_len = seq_len
+        self.vocab = vocab
+        self.n_layers = n_layers
+        self.tx = optimizer or optax.adam(learning_rate)
+
+        block = Block(n_heads=n_heads, compute_dtype=compute_dtype)
+        embed = nn.Embed(vocab, d_model, dtype=compute_dtype)
+        head = _LMHead(vocab, compute_dtype=compute_dtype)
+        rng = jax.random.PRNGKey(seed)
+        x0 = jnp.zeros((1, seq_len, d_model), jnp.float32)
+        tok0 = jnp.zeros((1, seq_len), jnp.int32)
+        layer_ps = [
+            block.init(jax.random.fold_in(rng, 1000 + i), x0)["params"]
+            for i in range(n_layers)
+        ]
+        trunk_full = jax.tree.map(lambda *ls: jnp.stack(ls), *layer_ps)
+        # static pytree of full trunk shapes, for the in-scan ungather
+        # (tuple leaves survive tree.map via flatten_up_to; never
+        # jax.tree.leaves this tree — the tuples would flatten into ints)
+        self._trunk_shapes = jax.tree.map(lambda l: l.shape, trunk_full)
+        trunk_count = int(sum(l.size for l in jax.tree.leaves(trunk_full)))
+        self.params = {
+            "embed": embed.init(jax.random.fold_in(rng, 1), tok0)["params"],
+            "trunk": jax.tree.map(lambda l: _shard_leaf(l, n), trunk_full),
+            "head": head.init(jax.random.fold_in(rng, 2), x0)["params"],
+        }
+        self.param_count = trunk_count + int(
+            sum(
+                np.prod(p.shape)
+                for k in ("embed", "head")
+                for p in jax.tree.leaves(self.params[k])
+            )
+        )
+        self.opt_state = self.tx.init(self.params)
+
+        def spec_for(path, leaf):
+            names = [
+                str(getattr(k, "key", getattr(k, "name", k))) for k in path
+            ]
+            if "trunk" in names and np.ndim(leaf) == 3:
+                return P(None, self.axis)
+            return P()
+
+        self._param_specs = jax.tree_util.tree_map_with_path(
+            spec_for, self.params
+        )
+        self._opt_specs = jax.tree_util.tree_map_with_path(
+            spec_for, self.opt_state
+        )
+        self.params = self._place(self.params, self._param_specs)
+        self.opt_state = self._place(self.opt_state, self._opt_specs)
+        self._replicated = NamedSharding(mesh, P())
+        self._data_sharding = NamedSharding(mesh, P(self.axis))
+        self._valid_sharding = self._data_sharding
+        self.step_num = 0
+
+        axis = self.axis
+        trunk_shapes = self._trunk_shapes
+        block_apply = block.apply
+        embed_apply = embed.apply
+        head_apply = head.apply
+        tx = self.tx
+
+        def step(params, opt_state, x, y, valid):
+            v = valid.reshape(())
+            contributors = lax.psum(v, axis)
+            tokens_local = jnp.float32(x.shape[0] * x.shape[1])
+            denom = jnp.maximum(lax.psum(v * tokens_local, axis), 1.0)
+
+            def masked_loss(p):
+                h = embed_apply({"params": p["embed"]}, x)
+
+                def body(carry, layer_shards):
+                    # gather ONE layer's params, apply, discard — the
+                    # all_gather's transpose is psum_scatter, so this
+                    # layer's grad comes back reduce-scattered shard-local
+                    layer_p = jax.tree.map(
+                        lambda s, shape: _unshard_leaf(
+                            lax.all_gather(
+                                s.reshape(-1), axis, tiled=True
+                            )[None],
+                            (1,) + shape[1:],
+                        )[0],
+                        layer_shards,
+                        trunk_shapes,
+                    )
+                    return block_apply({"params": layer_p}, carry), None
+
+                body_fn = jax.checkpoint(body) if remat else body
+                h, _ = lax.scan(body_fn, h, p["trunk"])
+                logits = head_apply({"params": p["head"]}, h)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                )
+                return ce.sum() * v / denom
+
+            loss, grads = jax.value_and_grad(masked_loss)(params)
+            loss_avg = lax.psum(loss, axis)  # masked, already /denom
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, loss_avg, contributors
+
+        data_spec = P(axis)
+        self._step = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(
+                    self._param_specs,
+                    self._opt_specs,
+                    data_spec,
+                    data_spec,
+                    data_spec,
+                ),
+                out_specs=(self._param_specs, self._opt_specs, P(), P()),
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    def _place(self, tree, specs):
+        """device_put every leaf onto its PartitionSpec over this mesh."""
+        return jax.device_put(
+            tree,
+            jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                specs,
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )
+
+    # -- stepping ------------------------------------------------------------
+
+    def _place_batch_tokens(self, tokens, labels):
+        if tokens.shape[0] % self.n_devices:
+            raise ValueError(
+                f"global batch {tokens.shape[0]} not divisible by "
+                f"{self.n_devices} devices"
+            )
+        if tokens.shape[1] != self.seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} != {self.seq_len}"
+            )
+        xd = jax.device_put(
+            np.asarray(tokens, np.int32), self._data_sharding
+        )
+        yd = jax.device_put(
+            np.asarray(labels, np.int32), self._data_sharding
+        )
+        return xd, yd
+
+    def train_step(
+        self,
+        tokens: np.ndarray,
+        labels: np.ndarray,
+        valid: Sequence[float] | None = None,
+    ) -> TrainStepMetrics:
+        """One step on a GLOBAL (batch, seq_len) token array; ``valid`` is
+        the per-device contributor mask."""
+        valid_arr = normalize_valid(valid, self.n_devices)
+        xd, yd = self._place_batch_tokens(tokens, labels)
+        vd = jax.device_put(valid_arr, self._valid_sharding)
+        self.params, self.opt_state, loss, cnt = self._step(
+            self.params, self.opt_state, xd, yd, vd
+        )
+        self.step_num += 1
+        return TrainStepMetrics(
+            step=self.step_num, loss=float(loss), contributors=float(cnt)
+        )
+
+    # -- gathered views (tests / checkpoint seam) ----------------------------
+
+    def gathered_params(self) -> dict:
+        """Full (unsharded) params pytree on the host — checkpoint scale."""
+        return self.checkpoint_state()["params"]
+
+    @property
+    def trunk_shard_elems(self) -> int:
+        """Per-device element count of the sharded trunk."""
+        return int(
+            sum(
+                l.shape[0] * l.shape[2]
+                for l in jax.tree.leaves(self.params["trunk"])
+            )
+        )
+
+    # -- checkpoint seam (mesh-size-independent, the ZeRO-1 discipline) ------
+
+    @staticmethod
+    def _is_params_container(t) -> bool:
+        """A dict mirroring the params layout (optax moments do) — its
+        trunk subtree holds the FSDP-sharded leaves."""
+        return isinstance(t, dict) and "trunk" in t
+
+    def checkpoint_state(self) -> dict:
+        """Mesh-size-independent: trunk leaves (params AND optimizer
+        moments) gather to their full shapes on the host (the ZeRO-1
+        gather-then-reshard discipline)."""
+
+        def unshard_trunk(container):
+            out = dict(container)
+            out["trunk"] = jax.tree.map(
+                lambda s, shape: np.asarray(
+                    _unshard_leaf(jnp.asarray(s), shape)
+                ),
+                container["trunk"],
+                self._trunk_shapes,
+            )
+            return out
+
+        to_host = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: np.asarray(jax.device_get(x)), t
+        )
+        params = unshard_trunk(to_host(self.params))
+        opt_state = jax.tree.map(
+            lambda t: unshard_trunk(t) if self._is_params_container(t) else t,
+            to_host(self.opt_state),
+            is_leaf=self._is_params_container,
+        )
+        return {"params": params, "opt_state": opt_state}
+
+    def restore_checkpoint_state(self, state: dict) -> None:
+        n = self.n_devices
+
+        def reshard_trunk(container):
+            out = dict(container)
+            out["trunk"] = jax.tree.map(
+                lambda full: _shard_leaf(jnp.asarray(full), n),
+                container["trunk"],
+            )
+            return out
+
+        self.params = self._place(
+            reshard_trunk(state["params"]), self._param_specs
+        )
+        opt_state = jax.tree.map(
+            lambda t: reshard_trunk(t) if self._is_params_container(t) else t,
+            state["opt_state"],
+            is_leaf=self._is_params_container,
+        )
+        self.opt_state = self._place(opt_state, self._opt_specs)
