@@ -410,6 +410,15 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     p.add_argument("--devices", type=int, default=None, help="1D mesh size")
     _basic_train_flags(p)
     p.set_defaults(lr=1e-2)  # adam on an LM: the MLP-SGD default 0.1 diverges
+    p.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel shards (FSDP x SP over a (data, seq) mesh; "
+        "params still shard over the WHOLE mesh)",
+    )
+    p.add_argument(
+        "--impl", choices=("ring", "ulysses"), default="ring",
+        help="attention schedule over the seq axis (with --sp > 1)",
+    )
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
@@ -424,24 +433,37 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     )
     args = p.parse_args(argv)
 
+    import jax
+
     from akka_allreduce_tpu.models import data
-    from akka_allreduce_tpu.parallel import line_mesh
+    from akka_allreduce_tpu.parallel import data_seq_mesh, line_mesh
     from akka_allreduce_tpu.train import FSDPLMTrainer
 
+    if args.sp > 1:
+        n = args.devices or len(jax.devices())
+        if n % args.sp:
+            p.error(
+                f"--sp {args.sp} does not divide the device count {n}; "
+                "devices would be silently idled"
+            )
+        mesh = data_seq_mesh(n // args.sp, args.sp)
+    else:
+        mesh = line_mesh(args.devices)
     trainer = FSDPLMTrainer(
-        line_mesh(args.devices),
+        mesh,
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=args.heads,
         n_layers=args.layers,
         seq_len=args.seq_len,
+        seq_impl=args.impl,
         learning_rate=args.lr,
         remat=args.remat,
     )
     print(
         f"FSDP: {trainer.param_count / 1e3:.1f}K params, trunk shard "
-        f"{trainer.trunk_shard_elems} elems/device on "
-        f"{trainer.n_devices} devices"
+        f"{trainer.trunk_shard_elems} elems/device, mesh "
+        f"dp={trainer.dp} x sp={trainer.sp}"
     )
     ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
     return _run_training(trainer, ds, args, label="fsdp_lm")

@@ -67,8 +67,13 @@ class FSDPLMTrainer:
     """Fully-sharded data-parallel trainer for a decoder-only LM.
 
     Args:
-      mesh: a 1-axis (data,) mesh — the shard group.
+      mesh: a 1-axis (data,) mesh, or a 2-axis (data, seq) mesh — FSDP x SP,
+        the modern long-context recipe: params shard over the WHOLE mesh
+        (dp*sp slices) while ring/Ulysses attention shards the sequence over
+        ``seq``.
       n_layers: trunk depth (the FSDP-sharded bulk).
+      seq_impl: attention schedule over the seq axis ("ring" | "ulysses"),
+        used when the mesh has one.
       remat: recompute each layer on backward (jax.checkpoint).
     """
 
@@ -81,33 +86,49 @@ class FSDPLMTrainer:
         n_heads: int = 4,
         n_layers: int = 2,
         seq_len: int = 64,
+        seq_impl: str = "ring",
         optimizer: optax.GradientTransformation | None = None,
         learning_rate: float = 1e-2,
         seed: int = 0,
         compute_dtype=jnp.float32,
         remat: bool = False,
     ) -> None:
-        if len(mesh.axis_names) != 1:
+        if len(mesh.axis_names) not in (1, 2):
             raise ValueError(
-                f"FSDP shards over ONE mesh axis, got {mesh.axis_names}"
+                f"FSDP needs a (data[, seq]) mesh, got {mesh.axis_names}"
             )
         self.mesh = mesh
-        self.axis = mesh.axis_names[0]
-        self.n_devices = n = int(mesh.shape[self.axis])
-        self.data_shards = n
+        self.axes = tuple(mesh.axis_names)
+        self.data_axis = self.axes[0]
+        self.seq_axis = self.axes[1] if len(self.axes) == 2 else None
+        self.dp = int(mesh.shape[self.data_axis])
+        self.sp = int(mesh.shape[self.seq_axis]) if self.seq_axis else 1
+        self.n_devices = n = self.dp * self.sp
+        self.data_shards = self.dp
+        if seq_len % self.sp:
+            raise ValueError(
+                f"{seq_len=} not divisible by seq shards {self.sp}"
+            )
         self.seq_len = seq_len
         self.vocab = vocab
         self.n_layers = n_layers
         self.tx = optimizer or optax.adam(learning_rate)
 
-        block = Block(n_heads=n_heads, compute_dtype=compute_dtype)
+        block = Block(
+            n_heads=n_heads,
+            compute_dtype=compute_dtype,
+            seq_axis=self.seq_axis if self.sp > 1 else None,
+            seq_impl=seq_impl,
+        )
         embed = nn.Embed(vocab, d_model, dtype=compute_dtype)
         head = _LMHead(vocab, compute_dtype=compute_dtype)
         rng = jax.random.PRNGKey(seed)
-        x0 = jnp.zeros((1, seq_len, d_model), jnp.float32)
-        tok0 = jnp.zeros((1, seq_len), jnp.int32)
+        # init with the DENSE twin (param shapes are T- and axis-independent)
+        init_block = Block(n_heads=n_heads, compute_dtype=compute_dtype)
+        x0 = jnp.zeros((1, seq_len // self.sp, d_model), jnp.float32)
+        tok0 = jnp.zeros((1, seq_len // self.sp), jnp.int32)
         layer_ps = [
-            block.init(jax.random.fold_in(rng, 1000 + i), x0)["params"]
+            init_block.init(jax.random.fold_in(rng, 1000 + i), x0)["params"]
             for i in range(n_layers)
         ]
         trunk_full = jax.tree.map(lambda *ls: jnp.stack(ls), *layer_ps)
@@ -135,7 +156,9 @@ class FSDPLMTrainer:
                 str(getattr(k, "key", getattr(k, "name", k))) for k in path
             ]
             if "trunk" in names and np.ndim(leaf) == 3:
-                return P(None, self.axis)
+                # shard dim 1 over the WHOLE mesh (data-major, matching the
+                # tuple-axis all_gather order in the scan body)
+                return P(None, self.axes)
             return P()
 
         self._param_specs = jax.tree_util.tree_map_with_path(
@@ -147,11 +170,18 @@ class FSDPLMTrainer:
         self.params = self._place(self.params, self._param_specs)
         self.opt_state = self._place(self.opt_state, self._opt_specs)
         self._replicated = NamedSharding(mesh, P())
-        self._data_sharding = NamedSharding(mesh, P(self.axis))
-        self._valid_sharding = self._data_sharding
+        batch_spec = (
+            P(self.data_axis, self.seq_axis)
+            if self.seq_axis
+            else P(self.data_axis)
+        )
+        self._data_sharding = NamedSharding(mesh, batch_spec)
+        self._valid_sharding = NamedSharding(mesh, P(self.data_axis))
         self.step_num = 0
 
-        axis = self.axis
+        axes = self.axes
+        data_axis = self.data_axis
+        seq_axis = self.seq_axis
         trunk_shapes = self._trunk_shapes
         block_apply = block.apply
         embed_apply = embed.apply
@@ -159,22 +189,29 @@ class FSDPLMTrainer:
         tx = self.tx
 
         def step(params, opt_state, x, y, valid):
-            v = valid.reshape(())
-            contributors = lax.psum(v, axis)
+            v0 = valid.reshape(())
+            v = v0
+            if seq_axis is not None:
+                # the mask is per DP replica row; mark it varying on seq so
+                # the all-axes psums below are well-typed (LongContext's
+                # discipline)
+                v = lax.pcast(v, seq_axis, to="varying")
+            contributors = lax.psum(v0, data_axis)
             tokens_local = jnp.float32(x.shape[0] * x.shape[1])
-            denom = jnp.maximum(lax.psum(v * tokens_local, axis), 1.0)
+            denom = jnp.maximum(lax.psum(v * tokens_local, axes), 1.0)
 
             def masked_loss(p):
                 h = embed_apply({"params": p["embed"]}, x)
 
                 def body(carry, layer_shards):
-                    # gather ONE layer's params, apply, discard — the
-                    # all_gather's transpose is psum_scatter, so this
-                    # layer's grad comes back reduce-scattered shard-local
+                    # gather ONE layer's params over the WHOLE mesh, apply,
+                    # discard — the all_gather's transpose is psum_scatter,
+                    # so this layer's grad comes back reduce-scattered
+                    # shard-local
                     layer_p = jax.tree.map(
                         lambda s, shape: _unshard_leaf(
                             lax.all_gather(
-                                s.reshape(-1), axis, tiled=True
+                                s.reshape(-1), axes, tiled=True
                             )[None],
                             (1,) + shape[1:],
                         )[0],
@@ -192,12 +229,12 @@ class FSDPLMTrainer:
                 return ce.sum() * v / denom
 
             loss, grads = jax.value_and_grad(masked_loss)(params)
-            loss_avg = lax.psum(loss, axis)  # masked, already /denom
+            loss_avg = lax.psum(loss, axes)  # masked, already /denom
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, loss_avg, contributors
 
-        data_spec = P(axis)
+        data_spec = batch_spec
         self._step = jax.jit(
             jax.shard_map(
                 step,
@@ -207,7 +244,7 @@ class FSDPLMTrainer:
                     self._opt_specs,
                     data_spec,
                     data_spec,
-                    data_spec,
+                    P(data_axis),
                 ),
                 out_specs=(self._param_specs, self._opt_specs, P(), P()),
             ),
@@ -228,10 +265,10 @@ class FSDPLMTrainer:
     # -- stepping ------------------------------------------------------------
 
     def _place_batch_tokens(self, tokens, labels):
-        if tokens.shape[0] % self.n_devices:
+        if tokens.shape[0] % self.dp:
             raise ValueError(
                 f"global batch {tokens.shape[0]} not divisible by "
-                f"{self.n_devices} devices"
+                f"dp={self.dp}"
             )
         if tokens.shape[1] != self.seq_len:
             raise ValueError(
@@ -252,8 +289,8 @@ class FSDPLMTrainer:
         valid: Sequence[float] | None = None,
     ) -> TrainStepMetrics:
         """One step on a GLOBAL (batch, seq_len) token array; ``valid`` is
-        the per-device contributor mask."""
-        valid_arr = normalize_valid(valid, self.n_devices)
+        the per-DP-replica-row contributor mask, shape (dp,)."""
+        valid_arr = normalize_valid(valid, self.dp)
         xd, yd = self._place_batch_tokens(tokens, labels)
         vd = jax.device_put(valid_arr, self._valid_sharding)
         self.params, self.opt_state, loss, cnt = self._step(
