@@ -431,6 +431,14 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         "one layer's gathered params live at a time — the full FSDP memory "
         "profile",
     )
+    p.add_argument(
+        "--compress",
+        choices=("bf16",),
+        default=None,
+        help="run the per-layer param all_gather (and its reduce-scatter "
+        "transpose) in bf16 — half of FSDP's collective bytes; master "
+        "params/moments stay f32",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -459,6 +467,7 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         seq_impl=args.impl,
         learning_rate=args.lr,
         remat=args.remat,
+        compress=args.compress,
     )
     print(
         f"FSDP: {trainer.param_count / 1e3:.1f}K params, trunk shard "

@@ -92,11 +92,17 @@ class FSDPLMTrainer:
         seed: int = 0,
         compute_dtype=jnp.float32,
         remat: bool = False,
+        compress: str | None = None,
     ) -> None:
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError(
                 f"FSDP needs a (data[, seq]) mesh, got {mesh.axis_names}"
             )
+        if compress not in (None, "bf16"):
+            raise ValueError(
+                f"compress must be None or 'bf16', got {compress!r}"
+            )
+        self.compress = compress
         self.mesh = mesh
         self.axes = tuple(mesh.axis_names)
         self.data_axis = self.axes[0]
@@ -203,20 +209,25 @@ class FSDPLMTrainer:
             def masked_loss(p):
                 h = embed_apply({"params": p["embed"]}, x)
 
+                def gather_leaf(s, shape):
+                    # gather ONE layer's shard over the WHOLE mesh — the
+                    # all_gather's transpose is psum_scatter, so this
+                    # layer's grad comes back reduce-scattered shard-local.
+                    # compress="bf16" runs the gather at half width; its
+                    # transpose then reduce-scatters the grads in bf16 too
+                    # (FSDP's collectives ARE its bandwidth cost), while
+                    # the stored master params and moments stay f32.
+                    flat = s.reshape(-1)
+                    if compress == "bf16":
+                        flat = flat.astype(jnp.bfloat16)
+                    full = lax.all_gather(flat, axes, tiled=True)
+                    if compress == "bf16":
+                        full = full.astype(s.dtype)
+                    return _unshard_leaf(full[None], (1,) + shape[1:])[0]
+
                 def body(carry, layer_shards):
-                    # gather ONE layer's params over the WHOLE mesh, apply,
-                    # discard — the all_gather's transpose is psum_scatter,
-                    # so this layer's grad comes back reduce-scattered
-                    # shard-local
                     layer_p = jax.tree.map(
-                        lambda s, shape: _unshard_leaf(
-                            lax.all_gather(
-                                s.reshape(-1), axes, tiled=True
-                            )[None],
-                            (1,) + shape[1:],
-                        )[0],
-                        layer_shards,
-                        trunk_shapes,
+                        gather_leaf, layer_shards, trunk_shapes
                     )
                     return block_apply({"params": layer_p}, carry), None
 

@@ -147,6 +147,24 @@ def test_remat_matches_plain(line8):
     )
 
 
+def test_bf16_gathers_close_to_f32(line8):
+    """compress="bf16": the per-layer all_gather (and its reduce-scatter
+    transpose) ride bf16 — half of FSDP's collective bytes — while master
+    params/moments stay f32. The run must track the f32 run within bf16
+    quantization over several steps, and actually differ (so the cast
+    really happened on the wire path)."""
+    t0 = _mk(line8)
+    t1 = _mk(line8, compress="bf16")
+    ds = data.lm_copy_task(32, vocab=16)
+    for x, y in ds.batches(8, 5):
+        m0 = t0.train_step(x, y)
+        m1 = t1.train_step(x, y)
+    assert np.isfinite(m1.loss)
+    p0, p1 = _flat(t0.gathered_params()), _flat(t1.gathered_params())
+    drift = np.abs(p1 - p0).max() / np.abs(p0).max()
+    assert 0 < drift < 1e-2, drift
+
+
 def test_rejects_3d_mesh():
     import jax as _jax
 
