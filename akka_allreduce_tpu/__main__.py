@@ -439,6 +439,14 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         "transpose) in bf16 — half of FSDP's collective bytes; master "
         "params/moments stay f32",
     )
+    p.add_argument(
+        "--prefetch",
+        action="store_true",
+        help="software-pipeline the gathers: layer k+1's all_gather issues "
+        "before layer k's compute so the latency-hiding scheduler can "
+        "overlap them (same math, one extra gathered layer live; "
+        "excludes --remat)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -468,6 +476,7 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         learning_rate=args.lr,
         remat=args.remat,
         compress=args.compress,
+        prefetch=args.prefetch,
     )
     print(
         f"FSDP: {trainer.param_count / 1e3:.1f}K params, trunk shard "

@@ -93,6 +93,7 @@ class FSDPLMTrainer:
         compute_dtype=jnp.float32,
         remat: bool = False,
         compress: str | None = None,
+        prefetch: bool = False,
     ) -> None:
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError(
@@ -102,7 +103,17 @@ class FSDPLMTrainer:
             raise ValueError(
                 f"compress must be None or 'bf16', got {compress!r}"
             )
+        if prefetch and remat:
+            raise ValueError(
+                "prefetch and remat do not compose: the prefetched gathered "
+                "layer rides the scan CARRY, and scan saves every "
+                "iteration's carry as a backward residual — all L gathered "
+                "layers would stay resident, defeating exactly the memory "
+                "profile remat buys; pick one (prefetch = bandwidth "
+                "overlap, remat = memory)"
+            )
         self.compress = compress
+        self.prefetch = prefetch
         self.mesh = mesh
         self.axes = tuple(mesh.axis_names)
         self.data_axis = self.axes[0]
@@ -225,14 +236,54 @@ class FSDPLMTrainer:
                         full = full.astype(s.dtype)
                     return _unshard_leaf(full[None], (1,) + shape[1:])[0]
 
-                def body(carry, layer_shards):
-                    layer_p = jax.tree.map(
-                        gather_leaf, layer_shards, trunk_shapes
-                    )
-                    return block_apply({"params": layer_p}, carry), None
+                if prefetch:
+                    # Software-pipelined parameter prefetch (the FSDP form
+                    # of SURVEY §8.4 overlap): iteration k issues layer
+                    # k+1's all_gather BEFORE computing layer k, and the
+                    # two have no data dependence — the latency-hiding
+                    # scheduler can run next layer's gather behind this
+                    # layer's compute. A plain scan-over-xs serializes them
+                    # (a layer's gather can only start in its own
+                    # iteration). Same math; the trade is the gathered
+                    # layer riding the scan carry (hence the remat guard in
+                    # __init__). The scan covers n_l - 1 iterations and the
+                    # last layer applies AFTER it, so no iteration gathers
+                    # a layer it then discards.
+                    trunk = p["trunk"]
+                    n_l = jax.tree.leaves(trunk)[0].shape[0]
 
-                body_fn = jax.checkpoint(body) if remat else body
-                h, _ = lax.scan(body_fn, h, p["trunk"])
+                    def gather_layer(i):
+                        return jax.tree.map(
+                            lambda s, shape: gather_leaf(
+                                lax.dynamic_index_in_dim(
+                                    s, i, 0, keepdims=False
+                                ),
+                                shape,
+                            ),
+                            trunk,
+                            trunk_shapes,
+                        )
+
+                    def body(carry, i):
+                        hh, cur = carry
+                        nxt = gather_layer(i + 1)
+                        hh = block_apply({"params": cur}, hh)
+                        return (hh, nxt), None
+
+                    (h, last), _ = lax.scan(
+                        body, (h, gather_layer(0)), jnp.arange(n_l - 1)
+                    )
+                    h = block_apply({"params": last}, h)
+                else:
+
+                    def body(carry, layer_shards):
+                        layer_p = jax.tree.map(
+                            gather_leaf, layer_shards, trunk_shapes
+                        )
+                        return block_apply({"params": layer_p}, carry), None
+
+                    body_fn = jax.checkpoint(body) if remat else body
+                    h, _ = lax.scan(body_fn, h, p["trunk"])
                 logits = head_apply({"params": p["head"]}, h)
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, y

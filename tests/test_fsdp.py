@@ -147,6 +147,42 @@ def test_remat_matches_plain(line8):
     )
 
 
+def test_prefetch_matches_plain(line8):
+    """prefetch=True software-pipelines the per-layer gathers (layer k+1's
+    all_gather issues before layer k's compute, no data dependence — the
+    scheduler can overlap them). The math is THE SAME; only compile-time
+    fusion differs (the last layer applies outside the scan), so the runs
+    agree to reassociation ulps."""
+    from akka_allreduce_tpu.parallel import data_seq_mesh
+
+    t0 = _mk(line8)
+    t1 = _mk(line8, prefetch=True)
+    ds = data.lm_copy_task(32, vocab=16)
+    valid = np.ones(8, np.float32)
+    valid[3] = 0.0
+    for i, (x, y) in enumerate(ds.batches(8, 3)):
+        v = valid if i == 1 else None
+        m0 = t0.train_step(x, y, v)
+        m1 = t1.train_step(x, y, v)
+        assert abs(m0.loss - m1.loss) < 1e-6, (m0.loss, m1.loss)
+    np.testing.assert_allclose(
+        _flat(t0.gathered_params()), _flat(t1.gathered_params()),
+        rtol=1e-5, atol=1e-7,
+    )
+    # composition: FSDP x SP + bf16 gathers + prefetch compiles and steps
+    t2 = FSDPLMTrainer(
+        data_seq_mesh(2, 4), optimizer=optax.sgd(1e-2), seed=0,
+        prefetch=True, compress="bf16", **KW,
+    )
+    x, y = next(ds.batches(8, 1))
+    m = t2.train_step(x, y, [1.0, 0.0])
+    assert m.contributors == 1.0 and np.isfinite(m.loss)
+    # prefetch + remat is rejected loudly: the carried gathered layer
+    # becomes a per-iteration scan residual, defeating remat's point
+    with pytest.raises(ValueError, match="prefetch and remat"):
+        _mk(line8, prefetch=True, remat=True)
+
+
 def test_bf16_gathers_close_to_f32(line8):
     """compress="bf16": the per-layer all_gather (and its reduce-scatter
     transpose) ride bf16 — half of FSDP's collective bytes — while master
