@@ -1,0 +1,332 @@
+"""MFU benchmark: slope-timed on-device training chains + a FLOP model.
+
+VERDICT r2 #1: the compute side of the framework gets the same measurement
+honesty as the reduce kernel (bench.py). Each workload runs its trainer's
+``train_chain`` (zero host I/O inside the loop), times it as the difference
+between a short and a long chain dispatch (constant tunnel RTT/dispatch
+overhead cancels; both lengths pre-compiled), and reports model-FLOPs
+utilization against the chip's dense bf16 peak
+(``utils/benchmarking.device_peak_flops``).
+
+Conventions (see utils/benchmarking.py): model FLOPs exclude remat
+recompute (with ``--remat`` the printed MFU is the true model-work
+fraction, not the hardware-busy fraction), attention counts causal-halved
+score/value matmuls, MoE counts ACTIVE params only, ResNet uses the
+nominal SAME-padding conv count (XLA skips edge-padding MACs, so tiny
+images can overstate utilization by the padding share — <5 % at the
+sizes used here).
+
+Flagship config (``--workload lm`` defaults): d_model 2048, 16 heads
+(head_dim 128 = one MXU lane tile), 8 layers, seq 2048, batch 8, bf16
+compute, flash attention — 404M params, sized so params + adam moments
+(f32) + activations fill a 16 GB v5e without remat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _chain_mfu_record(
+    name: str,
+    timed,
+    flops_per_step: float,
+    *,
+    lo: int = 2,
+    hi: int = 10,
+    outer: int = 4,
+    n_devices: int = 1,
+    extra: dict | None = None,
+) -> dict:
+    """Time ``timed(steps)`` chains at two lengths, return the JSON record."""
+    import jax
+
+    from akka_allreduce_tpu.utils.benchmarking import (
+        device_peak_flops,
+        median_slope,
+        mfu,
+    )
+
+    t0 = time.perf_counter()
+    timed(lo)
+    timed(hi)  # compile BOTH lengths before any timing pair
+    compile_s = time.perf_counter() - t0
+    est = median_slope(timed, lo, hi, outer=outer, warmup=False)
+    sec = est.seconds_per_iter
+    u = mfu(flops_per_step, sec, device_peak_flops(), n_devices=n_devices)
+    metric = f"{name}_mfu"
+    if est.noisy():
+        metric += "_NOISY"
+    rec = {
+        "metric": metric,
+        "value": round(u, 4) if u is not None else None,
+        "unit": "mfu",
+        "tflops_per_step": round(flops_per_step / 1e12, 3),
+        "tflops_per_s": round(flops_per_step / sec / 1e12, 2),
+        "ms_per_step": round(sec * 1e3, 2),
+        "spread_pct": est.spread_pct,
+        "compile_s": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    rec.update(extra or {})
+    return rec
+
+
+def run_lm(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from akka_allreduce_tpu.models.data import SyntheticCopyLM
+    from akka_allreduce_tpu.parallel import data_seq_mesh
+    from akka_allreduce_tpu.train import LongContextTrainer
+    from akka_allreduce_tpu.utils.benchmarking import transformer_train_flops
+
+    heads = args.heads or max(1, args.d_model // 128)
+    mesh = data_seq_mesh(args.dp, args.sp)
+    trainer = LongContextTrainer(
+        mesh,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_heads=heads,
+        n_layers=args.layers,
+        seq_len=args.seq_len,
+        compute_dtype=jnp.bfloat16,
+        remat=args.remat,
+        learning_rate=1e-3,
+    )
+    rows = max(1, args.batch // trainer.dp)
+    batch = rows * trainer.dp
+    sampler = SyntheticCopyLM(args.seq_len, vocab=args.vocab).device_sampler()
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        trainer.train_chain(sampler, steps, rows)
+        jax.block_until_ready(trainer.params)
+        return time.perf_counter() - t0
+
+    flops = transformer_train_flops(
+        n_params=trainer.param_count,
+        batch=batch,
+        seq=args.seq_len,
+        d_model=args.d_model,
+        n_layers=args.layers,
+    )
+    return _chain_mfu_record(
+        "lm",
+        timed,
+        flops,
+        n_devices=trainer.n_devices,
+        extra={
+            "params_m": round(trainer.param_count / 1e6, 1),
+            "d_model": args.d_model,
+            "n_layers": args.layers,
+            "seq_len": args.seq_len,
+            "batch": batch,
+            "remat": args.remat,
+            "compute_dtype": "bf16",
+        },
+    )
+
+
+def run_mlp(args) -> dict:
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.models import MLP, data
+    from akka_allreduce_tpu.parallel import line_mesh
+    from akka_allreduce_tpu.train import DPTrainer
+    from akka_allreduce_tpu.utils.benchmarking import dense_train_flops
+
+    # MXU-shaped MLP: wide hidden layers so the matmuls are the story
+    hidden = tuple(args.hidden)
+    trainer = DPTrainer(
+        MLP(hidden=hidden, classes=10),
+        line_mesh(),
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        learning_rate=0.1,
+    )
+    per_dev = max(1, args.batch // trainer.n_devices)
+    batch = per_dev * trainer.n_devices
+    sampler = data.mnist_like().device_sampler()
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        losses, _ = trainer.train_chain(
+            sampler, steps, per_dev, fetch_metrics=False
+        )
+        jax.device_get(jax.numpy.ravel(losses)[:1])
+        return time.perf_counter() - t0
+
+    return _chain_mfu_record(
+        "mlp",
+        timed,
+        dense_train_flops(trainer.param_count, batch),
+        lo=20,
+        hi=2020,
+        n_devices=trainer.n_devices,
+        extra={
+            "params_m": round(trainer.param_count / 1e6, 3),
+            "hidden": list(hidden),
+            "batch": batch,
+        },
+    )
+
+
+def run_resnet(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.models import ResNet50, data
+    from akka_allreduce_tpu.models.resnet import resnet_fwd_flops
+    from akka_allreduce_tpu.parallel import line_mesh
+    from akka_allreduce_tpu.train import DPTrainer
+
+    model = ResNet50(classes=args.classes, compute_dtype=jnp.bfloat16)
+    trainer = DPTrainer(
+        model,
+        line_mesh(),
+        example_input=np.zeros(
+            (1, args.image_size, args.image_size, 3), np.float32
+        ),
+        learning_rate=0.1,
+    )
+    per_dev = max(1, args.batch // trainer.n_devices)
+    batch = per_dev * trainer.n_devices
+    ds = data.SyntheticClassification(
+        (args.image_size, args.image_size, 3), args.classes, seed=0
+    )
+    sampler = ds.device_sampler()
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        losses, _ = trainer.train_chain(
+            sampler, steps, per_dev, fetch_metrics=False
+        )
+        jax.device_get(jax.numpy.ravel(losses)[:1])
+        return time.perf_counter() - t0
+
+    flops = 3.0 * resnet_fwd_flops(model, args.image_size, batch)
+    # sub-ms steps on the real chip: the hi chain must put seconds of
+    # on-device signal against the tunnel's ~0.1 s RTT jitter
+    return _chain_mfu_record(
+        "resnet",
+        timed,
+        flops,
+        lo=20,
+        hi=2020,
+        n_devices=trainer.n_devices,
+        extra={
+            "params_m": round(trainer.param_count / 1e6, 1),
+            "image_size": args.image_size,
+            "batch": batch,
+            "compute_dtype": "bf16",
+        },
+    )
+
+
+def run_moe(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from akka_allreduce_tpu.models import data
+    from akka_allreduce_tpu.train import MoETrainer
+    from akka_allreduce_tpu.utils.benchmarking import (
+        moe_active_params,
+        transformer_train_flops,
+    )
+
+    heads = args.heads or max(1, args.d_model // 128)
+    devs = jax.devices()
+    mesh = jax.make_mesh((1,), ("data",), devices=devs[:1]) if len(
+        devs
+    ) == 1 else jax.make_mesh((len(devs),), ("data",), devices=devs)
+    trainer = MoETrainer(
+        mesh,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_heads=heads,
+        n_layers=args.layers,
+        n_experts=args.experts,
+        seq_len=args.seq_len,
+        router_topk=args.topk,
+        learning_rate=1e-3,
+        compute_dtype=jnp.bfloat16,
+    )
+    rows = max(1, args.batch // trainer.n_devices)
+    batch = rows * trainer.n_devices
+    sampler = data.lm_copy_task(args.seq_len, vocab=args.vocab).device_sampler()
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        trainer.train_chain(sampler, steps, rows_per_device=rows)
+        jax.block_until_ready(trainer.params)
+        return time.perf_counter() - t0
+
+    active = moe_active_params(trainer.params, args.topk, args.experts)
+    flops = transformer_train_flops(
+        n_params=active,
+        batch=batch,
+        seq=args.seq_len,
+        d_model=args.d_model,
+        n_layers=args.layers,
+    )
+    return _chain_mfu_record(
+        "moe",
+        timed,
+        flops,
+        n_devices=trainer.n_devices,
+        extra={
+            "params_m": round(trainer.param_count / 1e6, 1),
+            "active_params_m": round(active / 1e6, 1),
+            "experts": args.experts,
+            "topk": args.topk,
+            "d_model": args.d_model,
+            "n_layers": args.layers,
+            "seq_len": args.seq_len,
+            "batch": batch,
+            "compute_dtype": "bf16",
+        },
+    )
+
+
+WORKLOADS = {
+    "lm": run_lm,
+    "mlp": run_mlp,
+    "resnet": run_resnet,
+    "moe": run_moe,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "bench-mfu",
+        description="slope-timed on-device MFU for the training workloads "
+        "(one JSON line; flagship = lm)",
+    )
+    p.add_argument("--workload", choices=sorted(WORKLOADS), default="lm")
+    p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument("--d-model", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--heads", type=int, default=None, help="default d/128")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dp", type=int, default=None)
+    p.add_argument("--sp", type=int, default=None)
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--hidden", type=int, nargs="+", default=[2048, 2048])
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--topk", type=int, choices=(1, 2), default=1)
+    args = p.parse_args(argv)
+    rec = WORKLOADS[args.workload](args)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -300,6 +300,19 @@ def config3_mlp_step(steps: int = 20, batch_per_device: int = 16) -> dict:
     device_step_ms = chain_est.seconds_per_iter * 1e3
     chain_loss_last = float(np.asarray(jax.device_get(last_losses[0]))[-1])
 
+    from akka_allreduce_tpu.utils.benchmarking import (
+        dense_train_flops,
+        device_peak_flops,
+        mfu,
+    )
+
+    u = mfu(
+        dense_train_flops(trainer.param_count, batch),
+        chain_est.seconds_per_iter,
+        device_peak_flops(),
+        n_devices=trainer.n_devices,
+    )
+
     return _record(
         3,
         "mlp_mnist_dp_sgd",
@@ -308,6 +321,7 @@ def config3_mlp_step(steps: int = 20, batch_per_device: int = 16) -> dict:
         global_batch=batch,
         step_ms=round(dt * 1e3, 2),
         device_step_ms=round(device_step_ms, 3),
+        mfu=round(u, 4) if u is not None else None,
         device_step_spread_pct=(
             chain_est.spread_pct if math.isfinite(chain_est.spread_pct) else None
         ),

@@ -11,10 +11,13 @@ payloads are zero-copy views on decode (``np.frombuffer``).
 
 from __future__ import annotations
 
+import logging
 import struct
 from typing import Any
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from akka_allreduce_tpu.control import cluster as cl
 from akka_allreduce_tpu.protocol import (
@@ -67,17 +70,44 @@ _F16_FLAG = 0x8000_0000
 
 _F16_MAX = np.float32(65504.0)  # float16's finite range
 
+#: total payload elements saturated at ±65504 by f16 wire casts in this
+#: process — saturation silently alters out-of-range values, so operators
+#: need a signal (ADVICE r2); read it via ``f16_clip_count()``
+_f16_clipped = 0
+_f16_clip_warned = False
+
+
+def f16_clip_count() -> int:
+    """Elements the f16 wire mode has saturated since process start."""
+    return _f16_clipped
+
+
+def _note_clipped(n: int) -> None:
+    global _f16_clipped, _f16_clip_warned
+    _f16_clipped += n
+    if not _f16_clip_warned:
+        _f16_clip_warned = True
+        _log.warning(
+            "f16 wire mode saturated %d out-of-range payload element(s) at "
+            "+-65504; values were altered on the wire (further saturation "
+            "is counted, not logged — wire.f16_clip_count())",
+            n,
+        )
+
 
 def _pack_floats(value: np.ndarray, f16: bool = False) -> tuple[bytes, memoryview]:
     """(length prefix, payload view) — the view is copied exactly once, by the
     final frame join, instead of once per concatenation level. ``f16`` casts
     the payload to float16 for the wire, SATURATING at ±65504: a silent cast
     would turn out-of-range elements into inf and poison every downstream
-    f32 accumulation (unlike bf16, float16 trades range for mantissa)."""
+    f32 accumulation (unlike bf16, float16 trades range for mantissa).
+    Saturation is counted and warned once (``f16_clip_count``)."""
     if f16:
-        arr = np.clip(
-            np.asarray(value, dtype=np.float32), -_F16_MAX, _F16_MAX
-        ).astype("<f2")
+        arr32 = np.asarray(value, dtype=np.float32)
+        clipped = int(np.count_nonzero(np.abs(arr32) > _F16_MAX))
+        if clipped:
+            _note_clipped(clipped)
+        arr = np.clip(arr32, -_F16_MAX, _F16_MAX).astype("<f2")
         return _U32.pack(arr.size | _F16_FLAG), memoryview(arr).cast("B")
     arr = np.ascontiguousarray(value, dtype="<f4")
     return _U32.pack(arr.size), memoryview(arr).cast("B")

@@ -100,3 +100,38 @@ def ResNet50(classes: int = 1000, compute_dtype=jnp.float32) -> ResNet:
     return ResNet(
         stage_sizes=(3, 4, 6, 3), classes=classes, compute_dtype=compute_dtype
     )
+
+
+def resnet_fwd_flops(
+    model: ResNet, image_size: int, batch: int = 1
+) -> float:
+    """Analytic forward matmul/conv FLOPs of one batch through ``model`` at
+    ``image_size`` x ``image_size`` inputs (2 FLOPs per MAC; norms and
+    elementwise ops excluded — they are not MXU work). Multiply by 3 for a
+    train step. Mirrors ``ResNet.__call__``'s architecture exactly so the
+    MFU accounting (utils/benchmarking.py) never needs an XLA compile.
+    """
+    total = 0.0
+
+    def conv(cin, cout, k, h, w):
+        nonlocal total
+        total += 2.0 * k * k * cin * cout * h * w * batch
+
+    h = -(-image_size // 2)  # stem 7x7 stride 2, SAME-ish padding
+    conv(3, model.width, 7, h, h)
+    h = -(-h // 2)  # maxpool stride 2
+    cin = model.width
+    for stage, n_blocks in enumerate(model.stage_sizes):
+        f = model.width * (2**stage)
+        for block in range(n_blocks):
+            stride = 2 if stage > 0 and block == 0 else 1
+            h_out = -(-h // stride)
+            conv(cin, f, 1, h, h)  # 1x1 reduce (input spatial)
+            conv(f, f, 3, h_out, h_out)  # 3x3 (strided)
+            conv(f, 4 * f, 1, h_out, h_out)  # 1x1 expand
+            if cin != 4 * f or stride != 1:
+                conv(cin, 4 * f, 1, h_out, h_out)  # projection shortcut
+            cin = 4 * f
+            h = h_out
+    total += 2.0 * cin * model.classes * batch  # final Dense
+    return total

@@ -148,15 +148,29 @@ def local_attention(
         )
     if _flash_ok(q, k, q_offset, k_offset):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
             flash_attention,
         )
 
+        # The kernel's DEFAULT 128-row tiling runs ~10 TF/s on v5e at the
+        # flagship shape (B8 H16 T2048 D128) — each tiny grid step re-reads
+        # its K/V slabs from HBM. 512x512 blocks hit 191 TF/s (measured
+        # sweep, BENCHMARKS.md "attention kernel tuning": 128->39.8ms,
+        # 256->14.2, 512->2.15, 1024->6.3 per fwd+bwd layer), i.e. the MXU
+        # matmul plateau. flash_shapes_ok guarantees T % 512 == 0.
+        b = 512
+        bs = BlockSizes(
+            block_q=b, block_k_major=b, block_k=b, block_b=1,
+            block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
+            block_q_dkv=b, block_k_major_dq=b, block_k_dq=b, block_q_dq=b,
+        )
         out = flash_attention(
             q.transpose(0, 2, 1, 3),  # (B, H, T, D) kernel layout
             k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3),
             causal=causal,
             sm_scale=scale,
+            block_sizes=bs,
         )
         return out.transpose(0, 2, 1, 3).astype(q.dtype)
     return blockwise_attention(

@@ -168,6 +168,16 @@ class TrainerCheckpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def _saved_top_keys(self, step: int) -> set | None:
+        """Top-level keys of the saved tree (None if metadata unavailable).
+        Lets restore reconcile OPTIONAL template keys with what the
+        checkpoint actually carries (ADVICE r2: an EF/non-EF or version-key
+        difference must not surface as a generic Orbax tree mismatch)."""
+        try:
+            return set(self._mgr.item_metadata(step).keys())
+        except Exception:
+            return None
+
     def restore(self, trainer, step: int | None = None) -> int:
         """Restore trainer state in place; returns the restored step number."""
         step = self.latest_step() if step is None else step
@@ -181,9 +191,34 @@ class TrainerCheckpointer:
             )
             target = dict(template_fn())
             target["step"] = trainer.step_num
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(target)
-            )
+            saved = self._saved_top_keys(step)
+            optional = getattr(trainer, "checkpoint_optional_keys", frozenset())
+            if saved is not None:
+                for k in optional:
+                    # keys newer builds always write (format_version, the
+                    # always-present ef_sum) may be absent from older
+                    # checkpoints; drop them from the target rather than
+                    # fail the whole restore on tree structure
+                    if k in target and k not in saved:
+                        target.pop(k)
+            try:
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(target)
+                )
+            except Exception as e:
+                if (
+                    "format_version" in optional
+                    and saved is not None
+                    and "format_version" not in saved
+                ):
+                    raise ValueError(
+                        f"checkpoint step {step} under {self.directory} "
+                        "predates this trainer's serialized format (no "
+                        "format_version key — e.g. the round-1 padded "
+                        "per-mesh ZeRO-1 layout) and cannot be loaded; "
+                        "re-save it from the build that wrote it"
+                    ) from e
+                raise
             trainer.step_num = int(restored.pop("step"))
             trainer.restore_checkpoint_state(restored)
             return trainer.step_num

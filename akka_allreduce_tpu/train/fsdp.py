@@ -414,6 +414,44 @@ class FSDPLMTrainer:
         )
         return {"params": params, "opt_state": opt_state}
 
+    def checkpoint_template(self) -> dict:
+        """Abstract (ShapeDtypeStruct-only) twin of :meth:`checkpoint_state`
+        for the restore target: without it, TrainerCheckpointer.restore
+        would gather the throwaway freshly-initialized full trunk AND both
+        adam moments to host just to learn the shapes (ADVICE r2)."""
+
+        def tmpl_container(container):
+            out = {
+                k: jax.tree.map(
+                    lambda l: jax.ShapeDtypeStruct(
+                        jnp.shape(l), jnp.asarray(l).dtype
+                    ),
+                    v,
+                )
+                for k, v in container.items()
+                if k != "trunk"
+            }
+            out["trunk"] = jax.tree.map(
+                lambda s, shape: jax.ShapeDtypeStruct(shape, s.dtype),
+                container["trunk"],
+                self._trunk_shapes,
+            )
+            return out
+
+        opt_state = jax.tree.map(
+            lambda t: (
+                tmpl_container(t)
+                if self._is_params_container(t)
+                else jax.ShapeDtypeStruct(jnp.shape(t), jnp.asarray(t).dtype)
+            ),
+            self.opt_state,
+            is_leaf=self._is_params_container,
+        )
+        return {
+            "params": tmpl_container(self.params),
+            "opt_state": opt_state,
+        }
+
     def restore_checkpoint_state(self, state: dict) -> None:
         n = self.n_devices
 

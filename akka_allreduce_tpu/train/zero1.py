@@ -36,6 +36,7 @@ around (HBM section of the design notes).
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Sequence
 
 import jax
@@ -53,6 +54,8 @@ from akka_allreduce_tpu.train.trainer import (
     normalize_valid,
     place_batch,
 )
+
+_log = logging.getLogger(__name__)
 
 
 class Zero1DPTrainer:
@@ -284,6 +287,14 @@ class Zero1DPTrainer:
 
     # -- checkpoint seam (TrainerCheckpointer's trainer-defined protocol) ----
 
+    #: serialized-format version: v2 = unpadded mesh-size-independent layout
+    #: with an always-present ef_sum (round-1 wrote padded per-mesh leaves
+    #: and no version key — restore identifies those explicitly)
+    _CKPT_FORMAT_VERSION = 2
+    #: template keys TrainerCheckpointer may drop when an OLDER checkpoint
+    #: lacks them (restore_checkpoint_state handles their absence)
+    checkpoint_optional_keys = frozenset({"format_version", "ef_sum"})
+
     def checkpoint_state(self) -> dict:
         """ZeRO-1 state doesn't fit the params/opt_state pytree shape the
         default checkpoint path assumes (weights are one padded flat vector,
@@ -308,6 +319,9 @@ class Zero1DPTrainer:
             return arr.reshape(-1)[:count]
 
         state = {
+            "format_version": np.asarray(
+                self._CKPT_FORMAT_VERSION, np.int32
+            ),
             "flat_params": self.get_flat_params(),
             "opt_state": jax.tree.map(unpad, self.opt_state),
         }
@@ -317,6 +331,12 @@ class Zero1DPTrainer:
             # cross-mesh strategy as checkpoint._restore_ef)
             ef = np.asarray(jax.device_get(self._ef))
             state["ef_sum"] = ef.sum(axis=0)[:count]
+        else:
+            # ALWAYS present so the tree structure is EF-independent: an
+            # EF-written checkpoint restores into a non-EF trainer and vice
+            # versa without an Orbax structure mismatch (ADVICE r2); a zero
+            # residual is exactly "nothing withheld"
+            state["ef_sum"] = np.zeros(count, np.float32)
         return state
 
     def checkpoint_template(self) -> dict:
@@ -331,13 +351,15 @@ class Zero1DPTrainer:
                 return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
             return jax.ShapeDtypeStruct((count,), leaf.dtype)
 
-        state = {
+        return {
+            "format_version": jax.ShapeDtypeStruct((), jnp.int32),
             "flat_params": jax.ShapeDtypeStruct((count,), jnp.float32),
             "opt_state": jax.tree.map(tmpl, self.opt_state),
+            # always requested; TrainerCheckpointer drops it (and
+            # format_version) from the target when an older checkpoint
+            # lacks it — see checkpoint_optional_keys
+            "ef_sum": jax.ShapeDtypeStruct((count,), jnp.float32),
         }
-        if self.error_feedback:
-            state["ef_sum"] = jax.ShapeDtypeStruct((count,), jnp.float32)
-        return state
 
     def restore_checkpoint_state(self, state: dict) -> None:
         """Re-place restored (unpadded) state on this trainer's mesh: flat
@@ -346,6 +368,13 @@ class Zero1DPTrainer:
         size at save time is irrelevant."""
         from akka_allreduce_tpu.train.checkpoint import place_on
 
+        version = int(np.asarray(state.pop("format_version", 2)))
+        if version > self._CKPT_FORMAT_VERSION:
+            raise ValueError(
+                f"ZeRO-1 checkpoint format v{version} is newer than this "
+                f"build's v{self._CKPT_FORMAT_VERSION}; upgrade the package "
+                "to restore it"
+            )
         count = self.param_count
         pad = self._padded - count
         self.set_flat_params(np.asarray(state["flat_params"]))
@@ -375,13 +404,19 @@ class Zero1DPTrainer:
                     per, NamedSharding(self.mesh, P(self.axis))
                 )
             else:
-                # the checkpoint carries no residual (e.g. written by a
-                # non-EF trainer): a stale live residual would inject the
-                # PREVIOUS run's withheld gradients into this one — reset
+                # an old checkpoint with no residual key: a stale live
+                # residual would inject the PREVIOUS run's withheld
+                # gradients into this one — reset
                 self._ef = jax.jit(
                     lambda: jnp.zeros_like(self._ef),
                     out_shardings=NamedSharding(self.mesh, P(self.axis)),
                 )()
+        elif "ef_sum" in state and np.any(np.asarray(state["ef_sum"])):
+            _log.warning(
+                "checkpoint carries a NONZERO error-feedback residual but "
+                "this trainer has error_feedback off: the withheld gradient "
+                "mass is dropped (enable error_feedback to apply it)"
+            )
 
     # -- stepping --------------------------------------------------------------
 

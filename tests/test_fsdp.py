@@ -133,6 +133,20 @@ def test_checkpoint_restores_across_mesh_sizes(tmp_path, line8):
     )
 
 
+def test_checkpoint_template_mirrors_state(line8):
+    """checkpoint_template is the ShapeDtypeStruct twin of checkpoint_state
+    (ADVICE r2): same tree structure, same shapes/dtypes, no device_get of
+    throwaway state — TrainerCheckpointer.restore builds its target from it."""
+    t = _mk(line8)
+    state = t.checkpoint_state()
+    tmpl = t.checkpoint_template()
+    assert jax.tree.structure(state) == jax.tree.structure(tmpl)
+    for s, m in zip(jax.tree.leaves(state), jax.tree.leaves(tmpl)):
+        assert isinstance(m, jax.ShapeDtypeStruct)
+        assert np.shape(s) == m.shape, (np.shape(s), m.shape)
+        assert np.asarray(s).dtype == m.dtype
+
+
 def test_remat_matches_plain(line8):
     t_r = _mk(line8, remat=True)
     t_p = _mk(line8)

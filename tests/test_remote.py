@@ -104,6 +104,13 @@ def test_wire_f16_payload_roundtrip_and_byte_halving():
     )
     assert np.isfinite(sat.value).all()
     np.testing.assert_allclose(sat.value[:2], [65504.0, -65504.0])
+    # saturation is not silent: the module-level counter advanced by the
+    # number of altered elements (ADVICE r2), and in-range sends don't move it
+    before = wire.f16_clip_count()
+    wire.encode_frame("w", ScatterBlock(big, 0, 1, 0, 0), f16=True)
+    assert wire.f16_clip_count() == before + 2
+    wire.encode_frame("w", ScatterBlock(value, 0, 1, 0, 0), f16=True)
+    assert wire.f16_clip_count() == before + 2
 
 
 def test_wire_rejects_unknown():

@@ -237,6 +237,45 @@ class TestZero1ErrorFeedback:
         fresh_sum = np.asarray(fresh._ef).sum(axis=0)[: fresh.param_count]
         np.testing.assert_allclose(fresh_sum, ef_sum, rtol=1e-6, atol=1e-7)
 
+    def test_ef_checkpoint_cross_restores_with_non_ef(
+        self, tmp_path, line8, caplog
+    ):
+        """The serialized tree is EF-independent (ef_sum always present,
+        ADVICE r2): an EF checkpoint restores into a non-EF trainer (the
+        residual is dropped with a warning) and a non-EF checkpoint
+        restores into an EF trainer (residual arrives zero = clean)."""
+        import logging
+
+        from akka_allreduce_tpu.train import TrainerCheckpointer
+
+        t_ef = self._mk(line8)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[2] = 0.0  # bank a nonzero residual on device 2
+        t_ef.train_step(x, y, valid)
+        with TrainerCheckpointer(tmp_path / "ef2plain") as ckpt:
+            assert ckpt.save(t_ef)
+            plain = _make(Zero1DPTrainer, line8)
+            with caplog.at_level(
+                logging.WARNING, logger="akka_allreduce_tpu.train.zero1"
+            ):
+                ckpt.restore(plain)
+        assert "error-feedback residual" in caplog.text
+        np.testing.assert_array_equal(
+            plain.get_flat_params(), t_ef.get_flat_params()
+        )
+
+        plain2 = _make(Zero1DPTrainer, line8)
+        plain2.train_step(x, y)
+        with TrainerCheckpointer(tmp_path / "plain2ef") as ckpt:
+            assert ckpt.save(plain2)
+            t_ef2 = self._mk(line8)
+            t_ef2.train_step(x, y, valid)  # dirty the live residual first
+            ckpt.restore(t_ef2)
+        # the restored residual is the checkpoint's (all-zero), not stale
+        assert float(np.abs(np.asarray(t_ef2._ef)).max()) == 0.0
+
 
 def test_zero1_bf16_wire_close_to_f32(line8):
     a = _make(Zero1DPTrainer, line8)
