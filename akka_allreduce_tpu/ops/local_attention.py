@@ -110,6 +110,28 @@ def flash_shapes_ok(t: int, d: int) -> bool:
     return t > _DENSE_MAX_T and t % 512 == 0 and d % 32 == 0
 
 
+def flash_vma_relax(
+    seq_len: int, head_dim: int, *, sp: int = 1, seq_impl: str = "ring"
+) -> bool:
+    """True when the Pallas flash kernel CAN dispatch inside a trainer's
+    step for this attention configuration on this backend. shard_map
+    callers must then set ``check_vma=False``: the kernel's outputs carry
+    no varying-axes annotation, so the static replication checker cannot
+    type them (the trainers' shared gate — LongContext/MoE/Pipeline/FSDP).
+
+    A FULL single-device attention runs at the whole ``seq_len`` when the
+    sequence is unsharded (``sp == 1``) or under Ulysses (the all-to-all
+    reassembles full T locally); ring attention never runs one, so flash
+    never dispatches there.
+    """
+    local_t = seq_len if (sp == 1 or seq_impl == "ulysses") else 0
+    return (
+        jax.default_backend() == "tpu"
+        and local_t > 0
+        and flash_shapes_ok(local_t, head_dim)
+    )
+
+
 def _flash_ok(q: jax.Array, k: jax.Array, q_offset, k_offset) -> bool:
     """Shape/placement gate for the Pallas TPU flash kernel."""
     from akka_allreduce_tpu.ops._platform import interpret_default

@@ -297,6 +297,14 @@ class FSDPLMTrainer:
             return new_params, new_opt, loss_avg, contributors
 
         data_spec = batch_spec
+        from akka_allreduce_tpu.ops.local_attention import flash_vma_relax
+
+        # with sp == 1 (or Ulysses) the blocks run FULL local attention, so
+        # the flash kernel can dispatch; its outputs carry no varying-axes
+        # annotation (same check_vma gate as LongContext/MoE/Pipeline)
+        self._check_vma = not flash_vma_relax(
+            seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
+        )
         self._step = jax.jit(
             jax.shard_map(
                 step,
@@ -309,6 +317,7 @@ class FSDPLMTrainer:
                     P(data_axis),
                 ),
                 out_specs=(self._param_specs, self._opt_specs, P(), P()),
+                check_vma=self._check_vma,
             ),
             donate_argnums=(0, 1),
         )

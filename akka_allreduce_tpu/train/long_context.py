@@ -247,14 +247,10 @@ class LongContextTrainer:
         # configuration (TPU backend + kernel-friendly shapes on a path that
         # runs a full local attention: sp==1, or Ulysses' local core);
         # everywhere else the check stays on — it is the static safety net.
-        from akka_allreduce_tpu.ops.local_attention import flash_shapes_ok
+        from akka_allreduce_tpu.ops.local_attention import flash_vma_relax
 
-        head_dim = d_model // n_heads
-        local_t = seq_len if (self.sp == 1 or seq_impl == "ulysses") else 0
-        self._check_vma = not overlap and not (
-            jax.default_backend() == "tpu"
-            and local_t > 0
-            and flash_shapes_ok(local_t, head_dim)
+        self._check_vma = not overlap and not flash_vma_relax(
+            seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
         )
         mapped = jax.shard_map(
             step,

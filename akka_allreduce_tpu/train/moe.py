@@ -261,6 +261,11 @@ class MoETrainer:
                 contributors,
             )
 
+        from akka_allreduce_tpu.ops.local_attention import flash_vma_relax
+
+        self._check_vma = not overlap and not flash_vma_relax(
+            seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
+        )
         mapped = jax.shard_map(
             step,
             mesh=mesh,
@@ -272,9 +277,10 @@ class MoETrainer:
                 P(self.data_axis),
             ),
             out_specs=(self._param_specs, self._opt_specs, P(), P(), P(), P()),
-            # the overlap custom_vjp erases varying-axes typing (same caveat
-            # as the comm layer's ring schedules); equivalence tests oracle
-            check_vma=not overlap,
+            # off when the overlap custom_vjp erases varying-axes typing OR
+            # the flash kernel can dispatch (outputs carry no vma —
+            # ops.local_attention.flash_vma_relax, LongContext's discipline)
+            check_vma=self._check_vma,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
@@ -365,8 +371,8 @@ class MoETrainer:
                 P(),
                 P(),
             ),
-            # same overlap custom_vjp caveat as the step's shard_map
-            check_vma=not self.overlap,
+            # same vma caveats as the step's shard_map (overlap / flash)
+            check_vma=self._check_vma,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 

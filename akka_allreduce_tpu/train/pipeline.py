@@ -295,6 +295,14 @@ class PipelineLMTrainer:
         batch_spec = P(self.data_axis)
         self._data_sharding = NamedSharding(mesh, batch_spec)
         self._valid_sharding = NamedSharding(mesh, P(self.data_axis))
+        from akka_allreduce_tpu.ops.local_attention import flash_vma_relax
+
+        # each stage runs FULL-sequence local attention, so the flash
+        # kernel can dispatch at kernel-friendly shapes; its outputs carry
+        # no vma annotation (same gate as LongContext/MoE)
+        self._check_vma = not overlap and not flash_vma_relax(
+            seq_len, d_model // n_heads
+        )
         mapped = jax.shard_map(
             step,
             mesh=mesh,
@@ -306,9 +314,9 @@ class PipelineLMTrainer:
                 P(self.data_axis),
             ),
             out_specs=(self._param_specs, self._opt_specs, P(), P()),
-            # the overlap custom_vjp erases varying-axes typing (same caveat
-            # as the comm layer's ring schedules); equivalence tests oracle
-            check_vma=not overlap,
+            # off under overlap (custom_vjp erases vma) or a flash
+            # dispatch (kernel outputs carry none) — see _check_vma above
+            check_vma=self._check_vma,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
         self._raw_step = step  # reused by train_chain's on-device loop
@@ -386,8 +394,8 @@ class PipelineLMTrainer:
                 P(self.data_axis),
             ),
             out_specs=(self._param_specs, self._opt_specs, P(), P()),
-            # same overlap custom_vjp caveat as the step's shard_map
-            check_vma=not self.overlap,
+            # same vma caveats as the step's shard_map (overlap / flash)
+            check_vma=self._check_vma,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 

@@ -127,7 +127,39 @@ def run_chain_cached(
 
 
 def place_batch(x, y, n_devices: int, data_sharding):
-    """Validate divisibility and place a global (x, y) batch on the mesh."""
+    """Validate divisibility and place an (x, y) batch on the mesh.
+
+    Single-process: ``x``/``y`` are the GLOBAL batch. Multiprocess (pod
+    runtime — the sharding's mesh spans OS processes): each process passes
+    its HOST-LOCAL rows and they assemble into one global sharded batch via
+    ``parallel.multihost.host_local_to_global`` — the pod form of the
+    reference's per-worker dataSource pull (SURVEY.md §4.4). train_step and
+    accuracy ride this seam on every trainer that uses it;
+    ``train_step_accum``'s microbatch layout does not (it guards).
+    """
+    if not data_sharding.is_fully_addressable:
+        # the mesh spans OS processes (a fully-local mesh — e.g. a
+        # single-device oracle inside a pod run — takes the plain path)
+        from akka_allreduce_tpu.parallel import multihost
+
+        mesh, spec = data_sharding.mesh, data_sharding.spec
+        pid = jax.process_index()
+        local_share = sum(
+            1 for d in mesh.devices.flat if d.process_index == pid
+        )
+        if local_share == 0 or x.shape[0] % local_share:
+            raise ValueError(
+                f"host-local batch {x.shape[0]} not divisible by this "
+                f"process's {local_share} mesh devices"
+            )
+        return (
+            multihost.host_local_to_global(
+                np.asarray(x, np.float32), mesh, spec
+            ),
+            multihost.host_local_to_global(
+                np.asarray(y, np.int32), mesh, spec
+            ),
+        )
     if x.shape[0] % n_devices:
         raise ValueError(
             f"global batch {x.shape[0]} not divisible by {n_devices}"
@@ -135,6 +167,30 @@ def place_batch(x, y, n_devices: int, data_sharding):
     x = jax.device_put(np.asarray(x, np.float32), data_sharding)
     y = jax.device_put(np.asarray(y, np.int32), data_sharding)
     return x, y
+
+
+def place_mask(valid_arr: np.ndarray, data_sharding):
+    """Place the GLOBAL per-device contributor mask on the mesh.
+
+    The mask is control-plane state every process agrees on (the membership
+    view), so callers always pass the full (n_devices,) array; on a pod
+    each process extracts the rows its local devices own before the
+    host-local -> global assembly.
+    """
+    if data_sharding.is_fully_addressable:
+        return jax.device_put(valid_arr, data_sharding)
+    from akka_allreduce_tpu.parallel import multihost
+
+    mesh = data_sharding.mesh
+    pid = jax.process_index()
+    local_idx = [
+        i
+        for i, d in enumerate(mesh.devices.flat)
+        if d.process_index == pid
+    ]
+    return multihost.host_local_to_global(
+        np.asarray(valid_arr)[local_idx], mesh, data_sharding.spec
+    )
 
 
 class DPTrainer:
@@ -423,10 +479,13 @@ class DPTrainer:
     def train_step(
         self, x: np.ndarray, y: np.ndarray, valid: Sequence[float] | None = None
     ) -> TrainStepMetrics:
-        """One DP step on a GLOBAL batch (first dim divisible by n_devices)."""
+        """One DP step. Single-process: ``x``/``y`` are the GLOBAL batch
+        (first dim divisible by n_devices). Pod runtime (mesh spans OS
+        processes): each process passes its HOST-LOCAL rows — see
+        ``place_batch``; ``valid`` stays GLOBAL (n_devices,) either way."""
         valid_arr = self._normalize_valid(valid)
         xd, yd = self._place_batch(x, y)
-        vd = jax.device_put(valid_arr, self._data_sharding)
+        vd = place_mask(valid_arr, self._data_sharding)
         if self.error_feedback:
             self.params, self.opt_state, self._ef, loss, cnt = self._step_ef(
                 self.params, self.opt_state, self._ef, xd, yd, vd
@@ -457,7 +516,10 @@ class DPTrainer:
     def accuracy(self, x: np.ndarray, y: np.ndarray) -> float:
         xd, yd = self._place_batch(x, y)
         hits = self._eval(self.params, xd, yd)
-        return float(hits) / x.shape[0]
+        # the hit count is psummed over ALL devices, so the denominator is
+        # the GLOBAL row count (xd is the assembled global array — on a pod
+        # x.shape[0] would be only this process's rows)
+        return float(hits) / xd.shape[0]
 
     # -- gradient accumulation (microbatching) -------------------------------
 
@@ -603,12 +665,20 @@ class DPTrainer:
             a = np.asarray(a)
             return a.reshape(n, micro, *a.shape[1:])
 
+        if not self._data_sharding.is_fully_addressable:
+            raise NotImplementedError(
+                "train_step_accum is single-controller only: the microbatch "
+                "rearrange places a (devices, accum*micro, ...) layout with "
+                "a plain device_put, which a pod mesh cannot accept; use "
+                "train_step (whose placement seam is pod-aware) per "
+                "microbatch instead"
+            )
         valid_arr = self._normalize_valid(valid)
         xd = jax.device_put(
             rearrange(np.asarray(x, np.float32)), self._data_sharding
         )
         yd = jax.device_put(rearrange(np.asarray(y, np.int32)), self._data_sharding)
-        vd = jax.device_put(valid_arr, self._data_sharding)
+        vd = place_mask(valid_arr, self._data_sharding)
         fn = self._accum_steps_fns[accum_steps]
         if self.error_feedback:
             self.params, self.opt_state, self._ef, loss, cnt = fn(

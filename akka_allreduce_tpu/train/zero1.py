@@ -53,6 +53,7 @@ from akka_allreduce_tpu.train.trainer import (
     default_classification_loss,
     normalize_valid,
     place_batch,
+    place_mask,
 )
 
 _log = logging.getLogger(__name__)
@@ -428,7 +429,7 @@ class Zero1DPTrainer:
     ) -> TrainStepMetrics:
         valid_arr = normalize_valid(valid, self.n_devices)
         xd, yd = self._place_batch(x, y)
-        vd = jax.device_put(valid_arr, self._data_sharding)
+        vd = place_mask(valid_arr, self._data_sharding)
         if self.error_feedback:
             (
                 self.flat_params, self.opt_state, self._ef, loss, cnt,
@@ -446,7 +447,8 @@ class Zero1DPTrainer:
 
     def accuracy(self, x: np.ndarray, y: np.ndarray) -> float:
         xd, yd = self._place_batch(x, y)
-        return float(self._eval(self.flat_params, xd, yd)) / x.shape[0]
+        # global hit count over the GLOBAL row count (pod: x is host-local)
+        return float(self._eval(self.flat_params, xd, yd)) / xd.shape[0]
 
     @property
     def optimizer_shard_elems(self) -> int:

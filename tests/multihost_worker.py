@@ -73,6 +73,70 @@ def main() -> None:
         range(num_processes)
     ), gathered
 
+    # ---- a TRAINING STEP that spans OS processes (VERDICT r3 #2) ----------
+    # DPTrainer and Zero1DPTrainer run on the global mesh: each process
+    # feeds its host-local batch rows (place_batch's pod path), the mask is
+    # global, and the result must match a single-device oracle trained on
+    # exactly the valid rows' samples (masked DP averaging == training on
+    # the unmasked subset when shards are equal-sized).
+    import optax
+
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.train import DPTrainer, Zero1DPTrainer
+
+    steps, per_dev = 3, 4
+    global_batch = n * per_dev
+    mask_t = np.ones((n,), np.float32)
+    mask_t[-1] = 0.0  # last device's replica drops out every step
+    ex = np.zeros((1, 8, 8, 1), np.float32)
+
+    def mk(cls):
+        return cls(
+            MLP(hidden=(16,), classes=4),
+            mesh,
+            example_input=ex,
+            optimizer=optax.sgd(0.1),
+            seed=7,
+        )
+
+    dp, z1 = mk(DPTrainer), mk(Zero1DPTrainer)
+    oracle_mesh = jax.make_mesh(
+        (1,), ("line",), devices=jax.local_devices()[:1]
+    )
+    oracle = DPTrainer(
+        MLP(hidden=(16,), classes=4),
+        oracle_mesh,
+        example_input=ex,
+        optimizer=optax.sgd(0.1),
+        seed=7,
+    )
+
+    rng = np.random.default_rng(42)
+    for s in range(steps):
+        xb = rng.standard_normal((global_batch, 8, 8, 1)).astype(np.float32)
+        yb = rng.integers(0, 4, size=(global_batch,)).astype(np.int32)
+        lo_r, hi_r = process_id * (global_batch // num_processes), (
+            process_id + 1
+        ) * (global_batch // num_processes)
+        m_dp = dp.train_step(xb[lo_r:hi_r], yb[lo_r:hi_r], mask_t)
+        m_z1 = z1.train_step(xb[lo_r:hi_r], yb[lo_r:hi_r], mask_t)
+        # oracle: train on ONLY the valid devices' rows, single device
+        keep = slice(0, (n - 1) * per_dev)
+        m_or = oracle.train_step(xb[keep], yb[keep])
+        assert m_dp.contributors == n - 1, m_dp
+        assert abs(m_dp.loss - m_or.loss) < 1e-5, (s, m_dp.loss, m_or.loss)
+        assert abs(m_z1.loss - m_or.loss) < 1e-5, (s, m_z1.loss, m_or.loss)
+
+    from akka_allreduce_tpu.binder.api import flatten_pytree
+
+    dp_flat = flatten_pytree(dp.params)[0]
+    or_flat = flatten_pytree(oracle.params)[0]
+    np.testing.assert_allclose(dp_flat, or_flat, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        z1.get_flat_params(), or_flat, rtol=1e-5, atol=1e-6
+    )
+    print(f"MULTIHOST_TRAIN_OK {process_id}", flush=True)
+
     print(f"MULTIHOST_OK {process_id}", flush=True)
 
 

@@ -112,3 +112,6 @@ def test_multiprocess_jax_distributed_cpu():
     for i, (rc, out) in enumerate(results):
         assert rc == 0, f"worker {i} rc={rc}:\n{out}"
         assert f"MULTIHOST_OK {i}" in out, f"worker {i} output:\n{out}"
+        # the cross-process TRAINING step (DPTrainer + ZeRO-1 on the global
+        # mesh vs the valid-subset single-device oracle) also ran
+        assert f"MULTIHOST_TRAIN_OK {i}" in out, f"worker {i} output:\n{out}"
