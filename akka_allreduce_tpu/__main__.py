@@ -40,10 +40,11 @@ def _add_sharded_compress_flag(p: argparse.ArgumentParser) -> None:
     """--compress/--overlap for the sharded-param trainers (train-lm/-moe/-pp)."""
     p.add_argument(
         "--compress",
-        choices=("bf16",),
+        choices=("bf16", "int8"),
         default=None,
-        help="gradient wire compression: the grad collective runs with a "
-        "bf16 payload (explicit grouped psum per sharding class)",
+        help="gradient wire compression: bf16 runs each sharding class's "
+        "grouped psum at half width; int8 rides the explicit ring "
+        "(per-segment scales) over each class's reduce axes at a quarter",
     )
     p.add_argument(
         "--overlap",
@@ -334,11 +335,6 @@ def _run_training(trainer, ds, args, *, label: str, flops_per_step=None) -> int:
     accum = getattr(args, "accum", 1)
     if accum < 1:
         raise SystemExit(f"--accum must be >= 1, got {accum}")
-    if accum > 1 and getattr(trainer, "compress", None) == "int8":
-        raise SystemExit(
-            "--compress int8 is not supported with --accum > 1 (the "
-            "accumulation path uses the fused psum collective)"
-        )
     t0 = time.perf_counter()
     losses = []
     with profile:

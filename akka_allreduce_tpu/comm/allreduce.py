@@ -157,11 +157,18 @@ def grouped_tree_psum(grads, specs, axis_names: Axes, wire_dtype=None):
     buffer per group, so the step issues one collective per distinct
     sharding class — never one psum per parameter leaf. ``wire_dtype``
     (e.g. ``jnp.bfloat16``) casts each group's payload for the collective,
-    halving ICI/DCN bytes; the result is cast back to the leaf dtype.
+    halving ICI/DCN bytes — or the string ``"int8"``, which runs each
+    group through the explicit int8 ring (quarter-width hops with
+    per-segment scales, :func:`ring_allreduce_sum`) over each of its
+    reduce axes in sequence; a multi-axis class pays one ring per axis,
+    re-quantizing between them (error compounds like a longer ring).
+    Results are always handed back in the leaf dtype.
 
     This is the sharded-param trainers' wire-compression path: the implicit
     autodiff psum (differentiating w.r.t. replicated params) cannot change
     its wire dtype, so compression requires :func:`localize_tree` + this.
+    int8 callers must relax ``check_vma`` on the enclosing shard_map (the
+    ring's ppermute loop erases varying-axes typing).
     """
     leaves, treedef = jax.tree.flatten(grads)
     spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
@@ -169,6 +176,14 @@ def grouped_tree_psum(grads, specs, axis_names: Axes, wire_dtype=None):
         raise ValueError(
             f"specs tree has {len(spec_leaves)} leaves, grads {len(leaves)}"
         )
+    # the trainers pass their `compress` string straight through: "bf16"
+    # maps to the half-width psum dtype here (ONE place owns the
+    # compress-mode vocabulary), "int8" selects the explicit ring
+    if wire_dtype == "bf16":
+        wire_dtype = jnp.bfloat16
+    int8 = isinstance(wire_dtype, str)
+    if int8 and wire_dtype != "int8":
+        raise ValueError(f"unknown wire mode {wire_dtype!r}")
     groups: dict = {}
     for i, s in enumerate(spec_leaves):
         reduce_over = tuple(a for a in axis_names if a not in spec_axes(s))
@@ -182,7 +197,17 @@ def grouped_tree_psum(grads, specs, axis_names: Axes, wire_dtype=None):
                 out[i] = leaves[i]
             continue
         flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-        if wire_dtype is not None and flat.dtype != wire_dtype:
+        if int8:
+            # the ring's hop decompression accumulates in f32; run the
+            # whole schedule there and hand back the leaf dtype (the
+            # bf16-psum branch below makes the same round trip)
+            total = flat.astype(jnp.float32)
+            for ax in reduce_over:
+                total = ring_allreduce_sum(
+                    total, ax, lax.axis_size(ax), compress="int8"
+                )
+            total = total.astype(flat.dtype)
+        elif wire_dtype is not None and flat.dtype != wire_dtype:
             total = lax.psum(
                 flat.astype(wire_dtype), reduce_over
             ).astype(flat.dtype)
@@ -329,12 +354,19 @@ def compressed_value_and_grad(
     return out, grouped_tree_psum(grads, specs, axis_names, wire_dtype)
 
 
-def validate_trainer_compress(compress: str | None) -> str | None:
+def validate_trainer_compress(
+    compress: str | None, *, overlap: bool = False
+) -> str | None:
     """Shared guard for the sharded-param trainers' ``compress`` knob."""
-    if compress not in (None, "bf16"):
+    if compress not in (None, "bf16", "int8"):
         raise ValueError(
-            f"compress must be None or 'bf16', got {compress!r} (int8 "
-            "needs the explicit ring's per-hop scales — DPTrainer only)"
+            f"compress must be None, 'bf16' or 'int8', got {compress!r}"
+        )
+    if compress == "int8" and overlap:
+        raise ValueError(
+            "overlap excludes compress='int8': the in-backward per-leaf "
+            "sync has no ring schedule to carry the per-segment scales "
+            "(same contract as DPTrainer)"
         )
     return compress
 

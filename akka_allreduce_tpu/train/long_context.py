@@ -88,7 +88,7 @@ class LongContextTrainer:
 
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
-        self.compress = validate_trainer_compress(compress)
+        self.compress = validate_trainer_compress(compress, overlap=overlap)
         self.overlap = overlap
 
         if len(mesh.axis_names) not in (2, 3):
@@ -222,16 +222,19 @@ class LongContextTrainer:
                     wire_dtype=wire_dtype,
                 )
                 lval = lval * v
-            elif compress == "bf16":
+            elif compress in ("bf16", "int8"):
                 # wire compression needs the explicit collective: one
-                # grouped bf16 psum per sharding class, counts/denominator
-                # staying f32 (comm.allreduce.compressed_value_and_grad)
+                # grouped collective per sharding class — bf16 psum at half
+                # width, or the explicit int8 ring at a quarter — with
+                # counts/denominator staying f32
+                # (comm.allreduce.compressed_value_and_grad)
                 from akka_allreduce_tpu.comm.allreduce import (
                     compressed_value_and_grad,
                 )
 
                 lval, gavg = compressed_value_and_grad(
-                    masked_loss_sum, params, param_specs, axis_names
+                    masked_loss_sum, params, param_specs, axis_names,
+                    wire_dtype=compress,
                 )
             else:
                 lval, gavg = jax.value_and_grad(masked_loss_sum)(params)
@@ -249,7 +252,7 @@ class LongContextTrainer:
         # everywhere else the check stays on — it is the static safety net.
         from akka_allreduce_tpu.ops.local_attention import flash_vma_relax
 
-        self._check_vma = not overlap and not flash_vma_relax(
+        self._check_vma = not overlap and compress != "int8" and not flash_vma_relax(
             seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
         )
         mapped = jax.shard_map(

@@ -84,7 +84,7 @@ class MoETrainer:
 
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
-        self.compress = validate_trainer_compress(compress)
+        self.compress = validate_trainer_compress(compress, overlap=overlap)
         self.overlap = overlap
 
         if len(mesh.axis_names) not in (1, 2, 3):
@@ -233,9 +233,10 @@ class MoETrainer:
                     unmasked_loss, params, param_specs, axis_names, v,
                     has_aux=True, wire_dtype=wire_dtype,
                 )
-            elif compress == "bf16":
-                # explicit grouped bf16 collective (see long_context.py);
-                # expert-sharded leaves reduce over data/seq only
+            elif compress in ("bf16", "int8"):
+                # explicit grouped collective (see long_context.py);
+                # expert-sharded leaves reduce over data/seq only; int8
+                # rides the explicit ring per reduce axis
                 from akka_allreduce_tpu.comm.allreduce import (
                     compressed_value_and_grad,
                 )
@@ -243,6 +244,7 @@ class MoETrainer:
                 (_, (ce, aux, dropped)), gavg = compressed_value_and_grad(
                     masked_loss, params, param_specs, axis_names,
                     has_aux=True,
+                    wire_dtype=compress,
                 )
             else:
                 (_, (ce, aux, dropped)), gavg = jax.value_and_grad(
@@ -263,7 +265,7 @@ class MoETrainer:
 
         from akka_allreduce_tpu.ops.local_attention import flash_vma_relax
 
-        self._check_vma = not overlap and not flash_vma_relax(
+        self._check_vma = not overlap and compress != "int8" and not flash_vma_relax(
             seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
         )
         mapped = jax.shard_map(

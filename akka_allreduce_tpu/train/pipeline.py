@@ -99,7 +99,7 @@ class PipelineLMTrainer:
             )
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
-        self.compress = validate_trainer_compress(compress)
+        self.compress = validate_trainer_compress(compress, overlap=overlap)
         self.overlap = overlap
         self.mesh = mesh
         self.data_axis, self.pipe_axis = mesh.axis_names
@@ -270,10 +270,11 @@ class PipelineLMTrainer:
                     unmasked_loss, params, param_specs, axis_names, v,
                     has_aux=True, wire_dtype=wire_dtype,
                 )
-            elif compress == "bf16":
-                # explicit grouped bf16 collective (see long_context.py);
+            elif compress in ("bf16", "int8"):
+                # explicit grouped collective (see long_context.py);
                 # trunk leaves (pipe-sharded) reduce over data only,
-                # embed/head over data x pipe
+                # embed/head over data x pipe; int8 rides the explicit
+                # ring per reduce axis
                 from akka_allreduce_tpu.comm.allreduce import (
                     compressed_value_and_grad,
                 )
@@ -281,6 +282,7 @@ class PipelineLMTrainer:
                 (_, ce_total), gavg = compressed_value_and_grad(
                     masked_loss, params, param_specs, axis_names,
                     has_aux=True,
+                    wire_dtype=compress,
                 )
             else:
                 (_, ce_total), gavg = jax.value_and_grad(
@@ -300,7 +302,7 @@ class PipelineLMTrainer:
         # each stage runs FULL-sequence local attention, so the flash
         # kernel can dispatch at kernel-friendly shapes; its outputs carry
         # no vma annotation (same gate as LongContext/MoE)
-        self._check_vma = not overlap and not flash_vma_relax(
+        self._check_vma = not overlap and compress != "int8" and not flash_vma_relax(
             seq_len, d_model // n_heads
         )
         mapped = jax.shard_map(

@@ -574,7 +574,20 @@ class DPTrainer:
             # EF (train_step semantics on the accumulated mean gradient)
             c = ef_fold(flat, ef)
             wire = jnp.bfloat16 if self.compress == "bf16" else None
-            if bucket is None:
+            if self.compress == "int8":
+                # quarter-width wire at scan end: ONE int8 ring pass over
+                # the accumulated mean gradient — the same explicit
+                # collective the plain step uses, amortized over the whole
+                # accumulation (VERDICT r3 #5a). Counts reuse the scalar
+                # psum; EF is structurally excluded (EF requires bf16).
+                total = ring_allreduce_sum(
+                    c * v.astype(c.dtype),
+                    axis_names[0],
+                    self.n_devices,
+                    compress="int8",
+                )
+                denom_el = jnp.maximum(scalar_cnt, 1.0)
+            elif bucket is None:
                 total, cnt = masked_psum(c, v, axis_names, wire_dtype=wire)
                 denom_el = jnp.maximum(cnt, 1.0)
             else:
@@ -599,6 +612,10 @@ class DPTrainer:
             return new_params, new_opt, new_ef, loss_avg, scalar_cnt
 
         data_spec = self._data_spec
+        # the int8 ring's ppermute loop erases varying-axes typing (same
+        # caveat as the comm layer's ring schedules); the f32-equivalence
+        # test is the oracle there
+        check_vma = self.compress != "int8"
         if ef_enabled:
             # compute already has the exact (params, opt, ef, x, y, valid)
             # signature; only the non-EF branch needs a wrapper to bind None
@@ -607,6 +624,7 @@ class DPTrainer:
                 mesh=self.mesh,
                 in_specs=(P(), P(), data_spec, data_spec, data_spec, data_spec),
                 out_specs=(P(), P(), data_spec, P(), P()),
+                check_vma=check_vma,
             )
             return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
@@ -618,6 +636,7 @@ class DPTrainer:
             mesh=self.mesh,
             in_specs=(P(), P(), data_spec, data_spec, data_spec),
             out_specs=(P(), P(), P(), P()),
+            check_vma=check_vma,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
@@ -641,11 +660,6 @@ class DPTrainer:
                 "leaf's gradient depends on the WHOLE accumulation scan, so "
                 "per-leaf collectives could never run behind the backward; "
                 "use the accumulation path without overlap"
-            )
-        if self.compress == "int8":
-            raise NotImplementedError(
-                "int8 grad sync is train_step/train_chain-only (the "
-                "accumulation path uses the fused psum collective)"
             )
         n = self.n_devices * accum_steps
         if x.shape[0] % n:

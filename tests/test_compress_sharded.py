@@ -48,16 +48,16 @@ def _drift(t_a, t_b) -> float:
     return float(np.abs(pa - pb).max() / np.abs(pa).max())
 
 
-def _run_pair(t_f32, t_bf16, batches, dp):
+def _run_pair(t_f32, t_comp, batches, dp, *, loss_tol=5e-3, drift_tol=1e-2):
     mask = np.ones((dp,), np.float32)
     mask[-1] = 0.0
     for i, (x, y) in enumerate(batches):
         v = mask if i == 2 else None
         m0 = t_f32.train_step(x, y, v)
-        m1 = t_bf16.train_step(x, y, v)
+        m1 = t_comp.train_step(x, y, v)
         assert m0.contributors == m1.contributors
-        assert abs(m0.loss - m1.loss) < 5e-3 * max(1.0, abs(m0.loss))
-    assert _drift(t_f32, t_bf16) < 1e-2
+        assert abs(m0.loss - m1.loss) < loss_tol * max(1.0, abs(m0.loss))
+    assert _drift(t_f32, t_comp) < drift_tol
 
 
 def _stablehlo_bf16_all_reduces(step_jit, *args) -> tuple[int, int]:
@@ -98,10 +98,24 @@ class TestLongContextCompress:
         assert n_bf16 >= 2, (n_bf16, n_total)
         assert n_total > n_bf16  # f32 counts/denominators still present
 
-    def test_rejects_int8(self):
-        with pytest.raises(ValueError, match="compress"):
+    def test_int8_matches_f32_dp_sp_tp(self, lm_batches):
+        """int8 rides the explicit ring over each sharding class's reduce
+        axes (grouped_tree_psum, VERDICT r3 #5b): quarter-width wire, f32
+        run tracked within quantization tolerance, exact contributor
+        counts (masked step included)."""
+        mesh = data_seq_model_mesh(2, 2, 2)
+        t0 = LongContextTrainer(mesh, **self.KW)
+        t1 = LongContextTrainer(mesh, compress="int8", **self.KW)
+        batches = [(x[:4], y[:4]) for x, y in lm_batches]
+        _run_pair(t0, t1, batches, t0.dp, loss_tol=5e-2, drift_tol=0.1)
+
+    def test_int8_excludes_overlap(self):
+        with pytest.raises(ValueError, match="overlap"):
             LongContextTrainer(
-                data_seq_model_mesh(2, 2, 2), compress="int8", **self.KW
+                data_seq_model_mesh(2, 2, 2),
+                compress="int8",
+                overlap=True,
+                **self.KW,
             )
 
     def test_bf16_with_ulysses_attention(self, lm_batches):
@@ -145,6 +159,14 @@ class TestMoECompress:
         t1 = MoETrainer(mesh, compress="bf16", **self.KW)
         _run_pair(t0, t1, lm_batches, t0.dp)
 
+    def test_int8_matches_f32_dp_ep(self, lm_batches):
+        """Expert-sharded leaves ring over (data,) only; replicated leaves
+        over (data, expert) as two sequential rings (VERDICT r3 #5b)."""
+        mesh = jax.make_mesh((2, 2), ("data", "expert"))
+        t0 = MoETrainer(mesh, **self.KW)
+        t1 = MoETrainer(mesh, compress="int8", **self.KW)
+        _run_pair(t0, t1, lm_batches, t0.dp, loss_tol=5e-2, drift_tol=0.1)
+
     def test_bf16_wire_visible_in_stablehlo(self, lm_batches):
         mesh = jax.make_mesh((2, 2), ("data", "expert"))
         t = MoETrainer(mesh, compress="bf16", **self.KW)
@@ -172,6 +194,13 @@ class TestPipelineCompress:
         t1 = PipelineLMTrainer(mesh, compress="bf16", **self.KW)
         batches = [(x[:4], y[:4]) for x, y in lm_batches]
         _run_pair(t0, t1, batches, t0.dp)
+
+    def test_int8_matches_f32_dp_pp(self, lm_batches):
+        mesh = jax.make_mesh((2, 4), ("data", "pipe"))
+        t0 = PipelineLMTrainer(mesh, **self.KW)
+        t1 = PipelineLMTrainer(mesh, compress="int8", **self.KW)
+        batches = [(x[:4], y[:4]) for x, y in lm_batches]
+        _run_pair(t0, t1, batches, t0.dp, loss_tol=5e-2, drift_tol=0.1)
 
     def test_bf16_wire_visible_in_stablehlo(self, lm_batches):
         mesh = jax.make_mesh((2, 4), ("data", "pipe"))

@@ -470,14 +470,29 @@ class TestInt8GradSync:
         m = t.train_step(x, y, valid)
         assert m.contributors == 7.0 and np.isfinite(m.loss)
 
-    def test_int8_chain_works_accum_rejected(self, line8):
+    def test_int8_chain_works(self, line8):
         t = self._make(line8, "int8")
         ds = data.mnist_like()
         hist = t.train_chain(ds.device_sampler(), 3, 4)
         assert len(hist) == 3 and np.isfinite(hist[-1].loss)
-        x, y = next(iter(ds.batches(32, 1)))
-        with pytest.raises(NotImplementedError):
-            t.train_step_accum(x, y, accum_steps=2)
+
+    def test_int8_accum_close_to_f32_accum(self, line8):
+        """The accumulation path syncs the accumulated mean gradient through
+        ONE int8 ring pass at scan end (VERDICT r3 #5a) — same quantization
+        tolerance as the plain int8 step, exact contributor counts."""
+        t8 = self._make(line8, "int8", seed=1)
+        tf = self._make(line8, seed=1)
+        ds = data.mnist_like()
+        mask = np.ones(8, np.float32)
+        mask[3] = 0.0
+        for i, (x, y) in enumerate(ds.batches(64, 6)):
+            v = mask if i == 2 else None
+            m8 = t8.train_step_accum(x, y, 2, v)
+            mf = tf.train_step_accum(x, y, 2, v)
+            assert m8.contributors == mf.contributors
+            assert np.isfinite(m8.loss)
+        a, b = t8.get_flat_params(), tf.get_flat_params()
+        assert np.abs(a - b).max() / np.abs(b).max() < 0.1
 
     def test_int8_rejects_grid_mesh_and_ef(self, line8):
         from akka_allreduce_tpu.parallel import grid_mesh
