@@ -1121,11 +1121,6 @@ def _cmd_train_moe(argv: list[str]) -> int:
     )
     _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
-    if args.device_data and args.sp > 1:
-        p.error(
-            "--device-data is not supported with --sp > 1 (the chain "
-            "sampler has no per-seq-shard column slicing)"
-        )
 
     import jax
 
@@ -1172,12 +1167,16 @@ def _cmd_train_moe(argv: list[str]) -> int:
 
     t0 = time.perf_counter()
     if args.device_data:
-        rows = max(1, args.batch // trainer.n_devices)
-        eff_batch = rows * trainer.n_devices
+        # the chain draws one stream per (data, expert) COORDINATE — seq
+        # shards of a coordinate share its rows — so the global batch
+        # divides by dp*ep, not n_devices
+        coords = trainer.dp * trainer.ep
+        rows = max(1, args.batch // coords)
+        eff_batch = rows * coords
         if eff_batch != args.batch:
             print(
                 f"--device-data: global batch rounded {args.batch} -> "
-                f"{eff_batch} ({rows} rows/device)"
+                f"{eff_batch} ({rows} rows per data x expert coordinate)"
             )
         hist = trainer.train_chain(
             ds.device_sampler(), args.steps, rows_per_device=rows
@@ -1194,7 +1193,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         transformer_train_flops,
     )
 
-    eff = rows * trainer.n_devices if args.device_data else args.batch
+    eff = rows * trainer.dp * trainer.ep if args.device_data else args.batch
     perf = _mfu_fields(
         transformer_train_flops(
             n_params=moe_active_params(

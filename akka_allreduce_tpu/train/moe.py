@@ -335,19 +335,32 @@ class MoETrainer:
     def _build_chain(self, sampler, steps: int, rows_per_device: int):
         raw_step = self._raw_step
         data_axis, expert_axis = self.data_axis, self.expert_axis
+        seq_axis = self.seq_axis
+        t_local = self.seq_len // self.sp
 
         def chain(params, opt_state, key, valid):
-            # one independent stream per DEVICE: both mesh axes carry data
-            # rows for the dense parts, so each (data, expert) coordinate
-            # samples its own batch
+            # one independent stream per (data, expert) COORDINATE: both
+            # those axes carry data rows for the dense parts. On the 3-axis
+            # mesh the seq shards of a coordinate fold the SAME key — they
+            # must agree on the rows' tokens — and each slices its own
+            # T_local columns from the sampler's GLOBAL sequences
+            # (LongContextTrainer._build_chain's discipline)
             rkey = jax.random.fold_in(key, lax.axis_index(data_axis))
             if expert_axis is not None:
                 rkey = jax.random.fold_in(rkey, lax.axis_index(expert_axis))
+            s = lax.axis_index(seq_axis) if seq_axis is not None else None
 
             def body(carry, i):
                 p, o = carry
                 k = jax.random.fold_in(rkey, i)
                 x, y = sampler(k, rows_per_device)
+                if s is not None:
+                    x = lax.dynamic_slice_in_dim(
+                        x, s * t_local, t_local, axis=1
+                    )
+                    y = lax.dynamic_slice_in_dim(
+                        y, s * t_local, t_local, axis=1
+                    )
                 p, o, loss, aux, dropped, cnt = raw_step(p, o, x, y, valid)
                 return (p, o), (loss, aux, dropped, cnt)
 
@@ -387,18 +400,15 @@ class MoETrainer:
         valid: Sequence[float] | None = None,
         seed: int = 0,
     ) -> list[MoEStepMetrics]:
-        """Run ``steps`` DP x EP steps entirely on device in ONE dispatch.
+        """Run ``steps`` DP x EP (x SP) steps entirely on device in ONE
+        dispatch.
 
-        ``sampler`` is a traced ``(key, rows) -> (tokens, labels)`` (e.g.
-        ``SyntheticCopyLM.device_sampler``); each device draws its own
-        stream, so the loop does zero host I/O.
+        ``sampler`` is a traced ``(key, rows) -> (tokens, labels)``
+        producing GLOBAL (rows, seq_len) sequences (e.g.
+        ``SyntheticCopyLM.device_sampler``); each (data, expert) coordinate
+        draws its own stream and, on the 3-axis mesh, its seq shards slice
+        their local columns — zero host I/O either way.
         """
-        if self.sp > 1:
-            raise NotImplementedError(
-                "train_chain is not implemented for the (data, seq, expert) "
-                "mesh (the sampler would need per-seq-shard column slicing); "
-                "use train_step"
-            )
         from akka_allreduce_tpu.train.trainer import run_chain_cached
 
         losses, auxes, droppeds, cnts = run_chain_cached(

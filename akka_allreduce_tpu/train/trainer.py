@@ -586,7 +586,7 @@ class DPTrainer:
                     self.n_devices,
                     compress="int8",
                 )
-                denom_el = jnp.maximum(scalar_cnt, 1.0)
+                denom_el = denom  # per-element == scalar count (one ring)
             elif bucket is None:
                 total, cnt = masked_psum(c, v, axis_names, wire_dtype=wire)
                 denom_el = jnp.maximum(cnt, 1.0)
