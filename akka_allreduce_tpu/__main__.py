@@ -1245,6 +1245,15 @@ def _cmd_train_pp(argv: list[str]) -> int:
         help="rematerialize each layer on backward (jax.checkpoint): "
         "stage activation memory drops from layers_per_stage to 1 layer",
     )
+    p.add_argument(
+        "--schedule",
+        choices=("gpipe", "1f1b"),
+        default="gpipe",
+        help="pipeline schedule: gpipe holds O(microbatches) activations "
+        "in flight (AD through the tick scan); 1f1b interleaves each "
+        "micro's backward right behind its forward, holding O(stages) — "
+        "same numerics (tests/test_pipeline.py), the standard memory fix",
+    )
     _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
 
@@ -1270,11 +1279,13 @@ def _cmd_train_pp(argv: list[str]) -> int:
         remat=args.remat,
         compress=args.compress,
         overlap=args.overlap,
+        schedule=args.schedule,
     )
     print(
         f"PP params: {trainer.param_count / 1e6:.2f}M "
         f"({trainer.n_layers} layers), mesh dp={trainer.dp} x "
-        f"pp={trainer.stages}, {args.microbatches} microbatches"
+        f"pp={trainer.stages}, {args.microbatches} microbatches "
+        f"({args.schedule})"
     )
     if args.steps <= 0:
         return 0

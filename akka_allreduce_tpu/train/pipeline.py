@@ -8,17 +8,35 @@ is one jitted SPMD program.
 - The transformer trunk's L layers stack into one params tree with a leading
   layer dim, sharded ``P('pipe')``: each of the S stages holds L/S layers and
   runs them with a local ``lax.scan``.
-- GPipe-style execution is a second ``lax.scan`` over ``M + S - 1`` ticks:
-  every tick each stage applies its layers and hands its activation to the
-  next stage with ONE ``ppermute`` hop over the ``pipe`` axis (neighbor
-  traffic on the ICI torus). Stage 0 injects a fresh microbatch per tick;
-  the last stage peels off finished microbatches and accumulates the loss.
-  The (S-1)/(M+S-1) bubble is the standard GPipe trade.
+- GPipe-style execution (``schedule="gpipe"``) is a second ``lax.scan``
+  over ``M + S - 1`` ticks: every tick each stage applies its layers and
+  hands its activation to the next stage with ONE ``ppermute`` hop over the
+  ``pipe`` axis (neighbor traffic on the ICI torus). Stage 0 injects a
+  fresh microbatch per tick; the last stage peels off finished microbatches
+  and accumulates the loss. The (S-1)/(M+S-1) bubble is the standard GPipe
+  trade.
 - Autodiff differentiates straight through both scans: the reverse pass IS
   backward pipelining (cotangents ride the reverse ppermute), trunk
   gradients stay stage-local (the leaves enter shard_map device-varying on
   ``pipe``), and the replicated embed/head gradients are completed by the
-  same transpose-psum mechanism as every other trainer here.
+  same transpose-psum mechanism as every other trainer here. The memory
+  cost of that elegance: the scan saves every tick's carry for the reverse
+  pass, so each stage holds O(M) in-flight microbatch activations.
+- ``schedule="1f1b"`` (VERDICT r3 #4) hand-schedules forward AND backward
+  in one scan over ``M + 2S - 2`` ticks, so memory is O(S) instead of
+  O(M): forwards flow exactly like GPipe (micro f runs on stage s at tick
+  ``s + f``), while micro b's backward runs on stage s at tick
+  ``2(S-1) - s + b`` — the LAST stage backs up micro b in the same tick
+  that forwarded it, and cotangents hop one stage per tick on the reverse
+  ppermute. Each stage keeps only a ``2S - 1``-slot ring of pending stage
+  INPUTS (the static proof of the O(S) bound: the scan carry IS the live
+  state — no AD runs over the tick loop) and recomputes the stage forward
+  inside its backward tick's ``jax.vjp`` (the remat trade built in).
+  Gradients are accumulated per tick and completed by ONE explicit grouped
+  collective per sharding class (``comm.allreduce.grouped_tree_psum`` —
+  bf16/int8 wire compression compose unchanged); numerics match GPipe to
+  float reassociation (same per-micro terms, summed in tick order instead
+  of reverse-AD order).
 - Threshold masking: the contributor mask is per DP replica row, exactly as
   in DPTrainer/LongContextTrainer — a dropped row zeroes its contribution
   while the collective completes.
@@ -90,6 +108,7 @@ class PipelineLMTrainer:
         remat: bool = False,
         compress: str | None = None,
         overlap: bool = False,
+        schedule: str = "gpipe",
     ) -> None:
         from akka_allreduce_tpu.models.transformer import Block
 
@@ -97,10 +116,20 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"need a (data, pipe) mesh, got axes {mesh.axis_names}"
             )
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be gpipe or 1f1b, got {schedule!r}")
+        if schedule == "1f1b" and overlap:
+            raise ValueError(
+                "overlap excludes schedule='1f1b': its gradients are "
+                "hand-accumulated per tick (no backward pass for the "
+                "per-leaf sync to hook); 1f1b's grouped collective already "
+                "fires once at the end of the tick scan"
+            )
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
         self.compress = validate_trainer_compress(compress, overlap=overlap)
         self.overlap = overlap
+        self.schedule = schedule
         self.mesh = mesh
         self.data_axis, self.pipe_axis = mesh.axis_names
         self.dp = int(mesh.shape[self.data_axis])
@@ -194,7 +223,10 @@ class PipelineLMTrainer:
 
         fwd = [(i, (i + 1) % s_count) for i in range(s_count)]
 
-        def step(params, opt_state, x, y, valid):
+        def stage_context(x, valid):
+            """The prologue BOTH schedules share — any change to masking or
+            the loss denominator lands in one place, preserving the tested
+            GPipe/1F1B equivalence by construction."""
             s = lax.axis_index(pipe_axis)
             v0 = valid.reshape(())
             v = lax.pcast(v0, pipe_axis, to="varying")
@@ -205,12 +237,26 @@ class PipelineLMTrainer:
                     f"{m_count} microbatches"
                 )
             mb = b_local // m_count
-            tokens_local = jnp.float32(b_local * t_len)
-            is_last = (s == s_count - 1).astype(jnp.float32)
+            is_last = s == s_count - 1
             # only the last stage carries loss tokens; no double counting
             denom = jnp.maximum(
-                lax.psum(v * tokens_local * is_last, axis_names), 1.0
+                lax.psum(
+                    v
+                    * jnp.float32(b_local * t_len)
+                    * is_last.astype(jnp.float32),
+                    axis_names,
+                ),
+                1.0,
             )
+            return s, v0, v, mb, t_len, is_last, denom
+
+        def apply_update(params, opt_state, gavg):
+            updates, new_opt = tx.update(gavg, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        def step(params, opt_state, x, y, valid):
+            s, v0, v, mb, t_len, is_last_b, denom = stage_context(x, valid)
+            is_last = is_last_b.astype(jnp.float32)
 
             def pipeline_ce(p):
                 """The GPipe forward: this device's summed loss tokens
@@ -290,8 +336,148 @@ class PipelineLMTrainer:
                 )(params)
             loss_avg = lax.psum(ce_total * v * is_last / denom, axis_names)
             contributors = lax.psum(v0, data_axis)
-            updates, new_opt = tx.update(gavg, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            new_params, new_opt = apply_update(params, opt_state, gavg)
+            return new_params, new_opt, loss_avg, contributors
+
+        rev = [(i, (i - 1) % s_count) for i in range(s_count)]
+        # max pending stage inputs under the 1f1b schedule: stage s holds
+        # 2*(S-1-s) + 1 in-flight microbatches (forwards outpace backwards
+        # by exactly the cotangent round trip) — bounded by 2S-1, O(S) and
+        # M-independent. This ring IS the schedule's memory bound: no AD
+        # runs over the tick scan, so the carry is the whole live state.
+        ring_k = 2 * s_count - 1
+
+        def step_1f1b(params, opt_state, x, y, valid):
+            s, v0, v, mb, t_len, is_last, denom = stage_context(x, valid)
+            micro_tok = x.reshape(m_count, mb, t_len)
+            labels = y.reshape(m_count, mb, t_len)
+
+            def stage_all(trunk_local, head_p, inp, lbl):
+                """One stage's whole tick-work: blocks, then head+loss.
+                The single vjp point for BOTH cotangent paths — mid stages
+                seed d(out) with the received cotangent (d(ce)=0, so the
+                head contributes nothing), the last stage seeds d(ce)=1."""
+                out = run_stage(trunk_local, inp)
+                logits = head_apply({"params": head_p}, out)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, lbl
+                ).sum()
+                return out, ce
+
+            def tick(carry, t):
+                ring, act_rx, ct_rx, g_emb, g_trunk, g_head, ce_acc = carry
+                # ---- forward: micro f = t - s (GPipe pacing) ----
+                f = t - s
+                do_f = (f >= 0) & (f < m_count)
+                fc = jnp.clip(f, 0, m_count - 1)
+                tok_f = lax.dynamic_index_in_dim(
+                    micro_tok, fc, 0, keepdims=False
+                )
+                lbl_f = lax.dynamic_index_in_dim(
+                    labels, fc, 0, keepdims=False
+                )
+                emb_f = embed_apply({"params": params["embed"]}, tok_f)
+                inp = jnp.where(s == 0, emb_f, act_rx)
+                slot_f = jnp.mod(fc, ring_k)
+                prev = lax.dynamic_slice_in_dim(ring, slot_f, 1, axis=0)[0]
+                ring = lax.dynamic_update_slice_in_dim(
+                    ring, jnp.where(do_f, inp, prev)[None], slot_f, axis=0
+                )
+                out_f, ce_f = stage_all(
+                    params["trunk"], params["head"], inp, lbl_f
+                )
+                send = lax.ppermute(out_f, pipe_axis, fwd)
+                ce_acc = ce_acc + ce_f * (
+                    is_last & do_f
+                ).astype(jnp.float32)
+
+                # ---- backward: micro b = t - 2(S-1) + s ----
+                b = t - 2 * (s_count - 1) + s
+                do_b = (b >= 0) & (b < m_count)
+                do_bf = do_b.astype(jnp.float32)
+                bc = jnp.clip(b, 0, m_count - 1)
+                slot_b = jnp.mod(bc, ring_k)
+                inp_b = lax.dynamic_slice_in_dim(ring, slot_b, 1, axis=0)[0]
+                tok_b = lax.dynamic_index_in_dim(
+                    micro_tok, bc, 0, keepdims=False
+                )
+                lbl_b = lax.dynamic_index_in_dim(
+                    labels, bc, 0, keepdims=False
+                )
+                (out_b, _), vjp_fn = jax.vjp(
+                    lambda tr, hp, i: stage_all(tr, hp, i, lbl_b),
+                    params["trunk"],
+                    params["head"],
+                    inp_b,
+                )
+                ct_out = (
+                    jnp.where(is_last, jnp.zeros_like(out_b), ct_rx)
+                    * do_bf.astype(out_b.dtype)
+                )
+                ct_ce = is_last.astype(jnp.float32) * do_bf
+                d_trunk, d_head, d_inp = vjp_fn((ct_out, ct_ce))
+                # stage 0's d(input) is the embedding cotangent; everyone
+                # else forwards it down the reverse ring
+                d_emb_ct = jnp.where(s == 0, d_inp, jnp.zeros_like(d_inp))
+                _, evjp = jax.vjp(
+                    lambda ep: embed_apply({"params": ep}, tok_b),
+                    params["embed"],
+                )
+                (d_embp,) = evjp(d_emb_ct)
+                g_emb = jax.tree.map(jnp.add, g_emb, d_embp)
+                g_trunk = jax.tree.map(jnp.add, g_trunk, d_trunk)
+                g_head = jax.tree.map(jnp.add, g_head, d_head)
+                ct_send = lax.ppermute(d_inp, pipe_axis, rev)
+                return (
+                    ring, send, ct_send, g_emb, g_trunk, g_head, ce_acc,
+                ), None
+
+            act_dtype = jnp.dtype(compute_dtype)
+            d_dim = d_model
+            zeros_act = lax.pcast(
+                jnp.zeros((mb, t_len, d_dim), act_dtype),
+                axis_names,
+                to="varying",
+            )
+            g0 = jax.tree.map(
+                lambda p: lax.pcast(
+                    jnp.zeros_like(p), axis_names, to="varying"
+                ),
+                params,
+            )
+            carry0 = (
+                lax.pcast(
+                    jnp.zeros((ring_k, mb, t_len, d_dim), act_dtype),
+                    axis_names,
+                    to="varying",
+                ),
+                zeros_act,
+                zeros_act,
+                g0["embed"],
+                g0["trunk"],
+                g0["head"],
+                lax.pcast(jnp.float32(0.0), axis_names, to="varying"),
+            )
+            (_, _, _, g_emb, g_trunk, g_head, ce_total), _ = lax.scan(
+                tick, carry0, jnp.arange(m_count + 2 * s_count - 2)
+            )
+            grads = {"embed": g_emb, "trunk": g_trunk, "head": g_head}
+            scale = v / denom
+            grads = jax.tree.map(
+                lambda g: g * scale.astype(g.dtype), grads
+            )
+            # ONE explicit grouped collective per sharding class: trunk
+            # (pipe-sharded) reduces over data, embed/head over data x pipe
+            # — the same machinery as the compressed paths, so bf16/int8
+            # wire compression composes with 1f1b unchanged
+            from akka_allreduce_tpu.comm.allreduce import grouped_tree_psum
+
+            gavg = grouped_tree_psum(
+                grads, param_specs, axis_names, wire_dtype=compress
+            )
+            loss_avg = lax.psum(ce_total * v / denom, axis_names)
+            contributors = lax.psum(v0, data_axis)
+            new_params, new_opt = apply_update(params, opt_state, gavg)
             return new_params, new_opt, loss_avg, contributors
 
         batch_spec = P(self.data_axis)
@@ -301,12 +487,18 @@ class PipelineLMTrainer:
 
         # each stage runs FULL-sequence local attention, so the flash
         # kernel can dispatch at kernel-friendly shapes; its outputs carry
-        # no vma annotation (same gate as LongContext/MoE)
-        self._check_vma = not overlap and compress != "int8" and not flash_vma_relax(
-            seq_len, d_model // n_heads
+        # no vma annotation (same gate as LongContext/MoE); the 1f1b
+        # schedule's hand-rolled ppermute plumbing also erases vma (same
+        # caveat as the comm layer's rings — the GPipe-equivalence test is
+        # the oracle)
+        self._check_vma = (
+            not overlap
+            and compress != "int8"
+            and schedule != "1f1b"
+            and not flash_vma_relax(seq_len, d_model // n_heads)
         )
         mapped = jax.shard_map(
-            step,
+            step_1f1b if schedule == "1f1b" else step,
             mesh=mesh,
             in_specs=(
                 self._param_specs,
@@ -321,7 +513,8 @@ class PipelineLMTrainer:
             check_vma=self._check_vma,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
-        self._raw_step = step  # reused by train_chain's on-device loop
+        # reused by train_chain's on-device loop (either schedule)
+        self._raw_step = step_1f1b if schedule == "1f1b" else step
         self._replicated = NamedSharding(mesh, P())
         self._chains: dict = {}
 
