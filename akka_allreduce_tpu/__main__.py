@@ -471,6 +471,12 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         "params still shard over the WHOLE mesh)",
     )
     p.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel shards (FSDP x TP over a (data, model[, seq]) "
+        "mesh: attention heads / MLP hidden split Megatron-style over "
+        "`model` while each shard's slice still FSDP-shards 1/(dp*sp))",
+    )
+    p.add_argument(
         "--impl", choices=("ring", "ulysses"), default="ring",
         help="attention schedule over the seq axis (with --sp > 1)",
     )
@@ -502,6 +508,12 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         "overlap them (same math, one extra gathered layer live; "
         "excludes --remat)",
     )
+    p.add_argument(
+        "--device-data",
+        action="store_true",
+        help="sample token batches ON DEVICE inside one jitted chain "
+        "(no host I/O per step)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -510,13 +522,26 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     from akka_allreduce_tpu.parallel import data_seq_mesh, line_mesh
     from akka_allreduce_tpu.train import FSDPLMTrainer
 
-    if args.sp > 1:
-        n = args.devices or len(jax.devices())
-        if n % args.sp:
-            p.error(
-                f"--sp {args.sp} does not divide the device count {n}; "
-                "devices would be silently idled"
-            )
+    n = args.devices or len(jax.devices())
+    if n % (args.sp * args.tp):
+        p.error(
+            f"--sp {args.sp} x --tp {args.tp} does not divide the device "
+            f"count {n}; devices would be silently idled"
+        )
+    if args.tp > 1 and args.sp > 1:
+        # the canonical 3-axis layout (model innermost: TP's per-layer
+        # psums are the most latency-sensitive collectives)
+        from akka_allreduce_tpu.parallel import data_seq_model_mesh
+
+        mesh = data_seq_model_mesh(
+            n // (args.sp * args.tp), args.sp, args.tp
+        )
+    elif args.tp > 1:
+        mesh = jax.make_mesh(
+            (n // args.tp, args.tp), ("data", "model"),
+            devices=jax.devices()[:n],
+        )
+    elif args.sp > 1:
         mesh = data_seq_mesh(n // args.sp, args.sp)
     else:
         mesh = line_mesh(args.devices)
@@ -536,7 +561,7 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     print(
         f"FSDP: {trainer.param_count / 1e3:.1f}K params, trunk shard "
         f"{trainer.trunk_shard_elems} elems/device, mesh "
-        f"dp={trainer.dp} x sp={trainer.sp}"
+        f"dp={trainer.dp} x tp={trainer.tp} x sp={trainer.sp}"
     )
     ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
     from akka_allreduce_tpu.utils.benchmarking import transformer_train_flops

@@ -293,11 +293,75 @@ def run_moe(args) -> dict:
     )
 
 
+def run_fsdp(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from akka_allreduce_tpu.models.data import SyntheticCopyLM
+    from akka_allreduce_tpu.parallel import data_seq_mesh, line_mesh
+    from akka_allreduce_tpu.train import FSDPLMTrainer
+    from akka_allreduce_tpu.utils.benchmarking import transformer_train_flops
+
+    heads = args.heads or max(1, args.d_model // 128)
+    # honor the mesh flags the lm workload honors (FSDP x SP; a flat
+    # line mesh otherwise)
+    if (args.sp or 1) > 1:
+        mesh = data_seq_mesh(args.dp, args.sp)
+    elif args.dp:
+        mesh = line_mesh(args.dp)
+    else:
+        mesh = line_mesh()
+    trainer = FSDPLMTrainer(
+        mesh,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_heads=heads,
+        n_layers=args.layers,
+        seq_len=args.seq_len,
+        compute_dtype=jnp.bfloat16,
+        remat=args.remat,
+        learning_rate=1e-3,
+    )
+    rows = max(1, args.batch // trainer.dp)
+    batch = rows * trainer.dp
+    sampler = SyntheticCopyLM(args.seq_len, vocab=args.vocab).device_sampler()
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        trainer.train_chain(sampler, steps, rows)
+        jax.block_until_ready(trainer.params)
+        return time.perf_counter() - t0
+
+    flops = transformer_train_flops(
+        n_params=trainer.param_count,
+        batch=batch,
+        seq=args.seq_len,
+        d_model=args.d_model,
+        n_layers=args.layers,
+    )
+    return _chain_mfu_record(
+        "fsdp",
+        timed,
+        flops,
+        n_devices=trainer.n_devices,
+        extra={
+            "params_m": round(trainer.param_count / 1e6, 1),
+            "d_model": args.d_model,
+            "n_layers": args.layers,
+            "seq_len": args.seq_len,
+            "batch": batch,
+            "remat": args.remat,
+            "compute_dtype": "bf16",
+        },
+    )
+
+
 WORKLOADS = {
     "lm": run_lm,
     "mlp": run_mlp,
     "resnet": run_resnet,
     "moe": run_moe,
+    "fsdp": run_fsdp,
 }
 
 

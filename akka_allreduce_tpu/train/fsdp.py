@@ -63,6 +63,46 @@ def _unshard_leaf(leaf: jax.Array, full_shape: tuple) -> jax.Array:
     return leaf.reshape(leaf.shape[0], -1)[:, :size].reshape(full_shape)
 
 
+def _shard_leaf_tp(
+    leaf: jax.Array, n: int, tp: int, tp_dim: int
+) -> jax.Array:
+    """(L, *S) -> (L, tp, n, per) for a tensor-parallel trunk leaf: split
+    the Megatron-sharded dim (``tp_dim``, 0-based within the per-layer
+    shape) into ``tp`` slices, flatten each slice's remaining dims in
+    original order, and pad to ``n`` equal FSDP shards. Dim 1 shards over
+    ``model``, dim 2 over the gather (data[, seq]) axes — so each device
+    stores 1/(tp*n) of every layer and the in-scan all_gather over the
+    gather axes reassembles exactly this model shard's TP-LOCAL layer."""
+    length, s = leaf.shape[0], leaf.shape[1:]
+    loc = s[tp_dim] // tp
+    x = leaf.reshape(
+        *leaf.shape[: 1 + tp_dim], tp, loc, *s[tp_dim + 1 :]
+    )
+    x = jnp.moveaxis(x, 1 + tp_dim, 1)  # (L, tp, ...S with loc at tp_dim...)
+    flat = x.reshape(length, tp, -1)
+    per = -(-flat.shape[2] // n)
+    return jnp.pad(
+        flat, ((0, 0), (0, 0), (0, per * n - flat.shape[2]))
+    ).reshape(length, tp, n, per)
+
+
+def _unshard_leaf_tp(
+    leaf: jax.Array, full_shape: tuple, tp_dim: int
+) -> jax.Array:
+    """(L, tp, n, per) -> (L, *S): inverse of :func:`_shard_leaf_tp`."""
+    length = leaf.shape[0]
+    tp = leaf.shape[1]
+    s = full_shape[1:]
+    loc = s[tp_dim] // tp
+    local_s = s[:tp_dim] + (loc,) + s[tp_dim + 1 :]
+    size = int(np.prod(local_s))
+    x = leaf.reshape(length, tp, -1)[:, :, :size].reshape(
+        length, tp, *local_s
+    )
+    x = jnp.moveaxis(x, 1, 1 + tp_dim)
+    return x.reshape(full_shape)
+
+
 class FSDPLMTrainer:
     """Fully-sharded data-parallel trainer for a decoder-only LM.
 
@@ -95,9 +135,22 @@ class FSDPLMTrainer:
         compress: str | None = None,
         prefetch: bool = False,
     ) -> None:
-        if len(mesh.axis_names) not in (1, 2):
+        axes = tuple(mesh.axis_names)
+        # accepted meshes (by axis NAME — "model" selects Megatron TP, in
+        # ANY order after the leading data axis, so the repo's canonical
+        # data_seq_model_mesh layout with model innermost works too):
+        #   (data,) | (data, seq) | (data, model) | (data, {model, seq})
+        ok = (
+            len(axes) in (1, 2, 3)
+            and axes[0] not in ("model", "seq")
+            and set(axes[1:]) <= {"model", "seq"}
+            and len(set(axes)) == len(axes)
+        )
+        if not ok:
             raise ValueError(
-                f"FSDP needs a (data[, seq]) mesh, got {mesh.axis_names}"
+                "FSDP needs a (data[, model][, seq]) mesh — leading data "
+                "axis, then any of 'model' (Megatron TP) and 'seq' — got "
+                f"{axes}"
             )
         if compress not in (None, "bf16"):
             raise ValueError(
@@ -115,12 +168,18 @@ class FSDPLMTrainer:
         self.compress = compress
         self.prefetch = prefetch
         self.mesh = mesh
-        self.axes = tuple(mesh.axis_names)
-        self.data_axis = self.axes[0]
-        self.seq_axis = self.axes[1] if len(self.axes) == 2 else None
+        self.axes = axes
+        self.data_axis = axes[0]
+        self.model_axis = "model" if "model" in axes else None
+        self.seq_axis = "seq" if "seq" in axes else None
+        # params gather over every NON-model axis: each Megatron shard
+        # FSDP-shards (and re-gathers) only its own tp-local slice
+        self.gather_axes = tuple(a for a in axes if a != self.model_axis)
         self.dp = int(mesh.shape[self.data_axis])
         self.sp = int(mesh.shape[self.seq_axis]) if self.seq_axis else 1
-        self.n_devices = n = self.dp * self.sp
+        self.tp = int(mesh.shape[self.model_axis]) if self.model_axis else 1
+        self.n_devices = self.dp * self.sp * self.tp
+        n = self.dp * self.sp  # FSDP shards per tp-local slice
         self.data_shards = self.dp
         if seq_len % self.sp:
             raise ValueError(
@@ -136,6 +195,8 @@ class FSDPLMTrainer:
             compute_dtype=compute_dtype,
             seq_axis=self.seq_axis if self.sp > 1 else None,
             seq_impl=seq_impl,
+            model_axis=self.model_axis if self.tp > 1 else None,
+            tp_size=self.tp,
         )
         embed = nn.Embed(vocab, d_model, dtype=compute_dtype)
         head = _LMHead(vocab, compute_dtype=compute_dtype)
@@ -153,10 +214,49 @@ class FSDPLMTrainer:
         # (tuple leaves survive tree.map via flatten_up_to; never
         # jax.tree.leaves this tree — the tuples would flatten into ints)
         self._trunk_shapes = jax.tree.map(lambda l: l.shape, trunk_full)
+        # per-leaf Megatron dim (0-based within the per-layer shape; -1 =
+        # replicated across model — None would vanish as an empty pytree)
+        # from the SAME rule tp_param_specs uses, so the FSDP storage can
+        # never drift from the TP module's layout
+        if self.tp > 1:
+            from akka_allreduce_tpu.models.transformer import tp_param_specs
+
+            tp_specs = tp_param_specs(layer_ps[0], self.model_axis)
+            self._trunk_tp_dims = jax.tree.map(
+                lambda s: (
+                    s.index(self.model_axis) if self.model_axis in s else -1
+                ),
+                tp_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        else:
+            self._trunk_tp_dims = jax.tree.map(lambda _: -1, layer_ps[0])
+        tp = self.tp
+
+        def store_leaf(leaf, tp_dim):
+            if tp_dim < 0:
+                return _shard_leaf(leaf, n)
+            return _shard_leaf_tp(leaf, n, tp, tp_dim)
+
+        # local (this model shard's) per-layer shapes, for the in-scan
+        # ungather: the TP dim shrinks by tp on Megatron-sharded leaves
+        def local_shape(shape, tp_dim):
+            if tp_dim < 0:
+                return shape
+            s = list(shape)
+            s[1 + tp_dim] //= tp
+            return tuple(s)
+
+        self._trunk_local_shapes = jax.tree.map(
+            local_shape, self._trunk_shapes, self._trunk_tp_dims,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
         trunk_count = int(sum(l.size for l in jax.tree.leaves(trunk_full)))
         self.params = {
             "embed": embed.init(jax.random.fold_in(rng, 1), tok0)["params"],
-            "trunk": jax.tree.map(lambda l: _shard_leaf(l, n), trunk_full),
+            "trunk": jax.tree.map(
+                store_leaf, trunk_full, self._trunk_tp_dims
+            ),
             "head": head.init(jax.random.fold_in(rng, 2), x0)["params"],
         }
         self.param_count = trunk_count + int(
@@ -168,14 +268,21 @@ class FSDPLMTrainer:
         )
         self.opt_state = self.tx.init(self.params)
 
+        gather_axes = self.gather_axes
+
         def spec_for(path, leaf):
             names = [
                 str(getattr(k, "key", getattr(k, "name", k))) for k in path
             ]
+            if "trunk" in names and np.ndim(leaf) == 4:
+                # (L, tp, n, per): Megatron slice dim on `model`, FSDP
+                # shard dim jointly over the gather axes
+                return P(None, self.model_axis, gather_axes)
             if "trunk" in names and np.ndim(leaf) == 3:
-                # shard dim 1 over the WHOLE mesh (data-major, matching the
-                # tuple-axis all_gather order in the scan body)
-                return P(None, self.axes)
+                # (L, n, per): shard dim 1 over the gather axes (data-major,
+                # matching the tuple-axis all_gather order in the scan
+                # body); model-replicated when a model axis exists
+                return P(None, gather_axes)
             return P()
 
         self._param_specs = jax.tree_util.tree_map_with_path(
@@ -199,7 +306,11 @@ class FSDPLMTrainer:
         axes = self.axes
         data_axis = self.data_axis
         seq_axis = self.seq_axis
-        trunk_shapes = self._trunk_shapes
+        vary_axes = tuple(a for a in axes if a != data_axis)
+        g_axes = self.gather_axes
+        # the in-scan ungather targets THIS model shard's local layer
+        # shapes (the TP dim shrinks by tp on Megatron-sharded leaves)
+        trunk_shapes = self._trunk_local_shapes
         block_apply = block.apply
         embed_apply = embed.apply
         head_apply = head.apply
@@ -208,11 +319,13 @@ class FSDPLMTrainer:
         def step(params, opt_state, x, y, valid):
             v0 = valid.reshape(())
             v = v0
-            if seq_axis is not None:
-                # the mask is per DP replica row; mark it varying on seq so
-                # the all-axes psums below are well-typed (LongContext's
-                # discipline)
-                v = lax.pcast(v, seq_axis, to="varying")
+            for ax in vary_axes:
+                # the mask is per DP replica row; mark it varying on the
+                # seq/model axes so the all-axes psums below are well-typed
+                # (LongContext's discipline — under TP every model shard of
+                # a data coordinate computes the identical loss term, so
+                # the tp-fold factors cancel in the ratio)
+                v = lax.pcast(v, ax, to="varying")
             contributors = lax.psum(v0, data_axis)
             tokens_local = jnp.float32(x.shape[0] * x.shape[1])
             denom = jnp.maximum(lax.psum(v * tokens_local, axes), 1.0)
@@ -221,9 +334,11 @@ class FSDPLMTrainer:
                 h = embed_apply({"params": p["embed"]}, x)
 
                 def gather_leaf(s, shape):
-                    # gather ONE layer's shard over the WHOLE mesh — the
-                    # all_gather's transpose is psum_scatter, so this
-                    # layer's grad comes back reduce-scattered shard-local.
+                    # gather ONE layer's shard over the NON-model axes —
+                    # the all_gather's transpose is psum_scatter, so this
+                    # layer's grad comes back reduce-scattered shard-local
+                    # (Megatron-sharded leaves reassemble only their own
+                    # tp-local slice; their grads stay model-local too).
                     # compress="bf16" runs the gather at half width; its
                     # transpose then reduce-scatters the grads in bf16 too
                     # (FSDP's collectives ARE its bandwidth cost), while
@@ -231,7 +346,7 @@ class FSDPLMTrainer:
                     flat = s.reshape(-1)
                     if compress == "bf16":
                         flat = flat.astype(jnp.bfloat16)
-                    full = lax.all_gather(flat, axes, tiled=True)
+                    full = lax.all_gather(flat, g_axes, tiled=True)
                     if compress == "bf16":
                         full = full.astype(s.dtype)
                     return _unshard_leaf(full[None], (1,) + shape[1:])[0]
@@ -321,6 +436,8 @@ class FSDPLMTrainer:
             ),
             donate_argnums=(0, 1),
         )
+        self._raw_step = step  # reused by train_chain's on-device loop
+        self._chains: dict = {}
 
     def _place(self, tree, specs):
         """device_put every leaf onto its PartitionSpec over this mesh."""
@@ -372,6 +489,93 @@ class FSDPLMTrainer:
             step=self.step_num, loss=float(loss), contributors=float(cnt)
         )
 
+    # -- on-device training chain (no host I/O per step) ---------------------
+
+    def _build_chain(self, sampler, steps: int, rows_per_replica: int):
+        raw_step = self._raw_step
+        data_axis, seq_axis = self.data_axis, self.seq_axis
+        t_local = self.seq_len // self.sp
+
+        def chain(params, opt_state, key, valid):
+            # one stream per DP replica ROW: model/seq shards of a row fold
+            # the same data coordinate so they agree on its tokens; seq
+            # shards slice their own T_local columns (the LongContext
+            # chain's discipline)
+            rkey = jax.random.fold_in(key, lax.axis_index(data_axis))
+            s = lax.axis_index(seq_axis) if seq_axis is not None else None
+
+            def body(carry, i):
+                p, o = carry
+                k = jax.random.fold_in(rkey, i)
+                x, y = sampler(k, rows_per_replica)
+                if s is not None:
+                    x = lax.dynamic_slice_in_dim(
+                        x, s * t_local, t_local, axis=1
+                    )
+                    y = lax.dynamic_slice_in_dim(
+                        y, s * t_local, t_local, axis=1
+                    )
+                p, o, loss, cnt = raw_step(p, o, x, y, valid)
+                return (p, o), (loss, cnt)
+
+            (params, opt_state), (losses, cnts) = lax.scan(
+                body, (params, opt_state), jnp.arange(steps)
+            )
+            return params, opt_state, losses, cnts
+
+        mapped = jax.shard_map(
+            chain,
+            mesh=self.mesh,
+            in_specs=(
+                self._param_specs,
+                self._opt_specs,
+                P(),
+                P(self.data_axis),
+            ),
+            out_specs=(self._param_specs, self._opt_specs, P(), P()),
+            check_vma=self._check_vma,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_chain(
+        self,
+        sampler,
+        steps: int,
+        rows_per_replica: int,
+        *,
+        valid: Sequence[float] | None = None,
+        seed: int = 0,
+    ) -> list[TrainStepMetrics]:
+        """Run ``steps`` FSDP steps entirely on device in ONE dispatch.
+
+        ``sampler`` is a traced ``(key, rows) -> (tokens, labels)``
+        producing GLOBAL (rows, seq_len) sequences
+        (``SyntheticCopyLM.device_sampler``)."""
+        from akka_allreduce_tpu.train.trainer import run_chain_cached
+
+        losses, cnts = run_chain_cached(
+            self,
+            sampler,
+            steps,
+            rows_per_replica,
+            lambda: self._build_chain(sampler, steps, rows_per_replica),
+            valid,
+            self.dp,
+            self._valid_sharding,
+            seed,
+        )
+        out = []
+        for loss, cnt in zip(losses, cnts):
+            self.step_num += 1
+            out.append(
+                TrainStepMetrics(
+                    step=self.step_num,
+                    loss=float(loss),
+                    contributors=float(cnt),
+                )
+            )
+        return out
+
     # -- gathered views (tests / checkpoint seam) ----------------------------
 
     def gathered_params(self) -> dict:
@@ -380,10 +584,11 @@ class FSDPLMTrainer:
 
     @property
     def trunk_shard_elems(self) -> int:
-        """Per-device element count of the sharded trunk."""
+        """Per-device element count of the sharded trunk (layers x per-shard
+        slice — the last dim — for both the 3D and the TP 4D layout)."""
         return int(
             sum(
-                l.shape[0] * l.shape[2]
+                l.shape[0] * l.shape[-1]
                 for l in jax.tree.leaves(self.params["trunk"])
             )
         )
@@ -401,14 +606,19 @@ class FSDPLMTrainer:
         moments) gather to their full shapes on the host (the ZeRO-1
         gather-then-reshard discipline)."""
 
+        def unshard_leaf(s, shape, tp_dim):
+            s = jnp.asarray(s)
+            if tp_dim < 0:
+                return np.asarray(_unshard_leaf(s, shape))
+            return np.asarray(_unshard_leaf_tp(s, shape, tp_dim))
+
         def unshard_trunk(container):
             out = dict(container)
             out["trunk"] = jax.tree.map(
-                lambda s, shape: np.asarray(
-                    _unshard_leaf(jnp.asarray(s), shape)
-                ),
+                unshard_leaf,
                 container["trunk"],
                 self._trunk_shapes,
+                self._trunk_tp_dims,
             )
             return out
 
@@ -462,13 +672,20 @@ class FSDPLMTrainer:
         }
 
     def restore_checkpoint_state(self, state: dict) -> None:
-        n = self.n_devices
+        # checkpoints carry FULL (unsharded) trunk leaves, so restore
+        # reshards for THIS mesh's geometry — any (dp, sp, tp) combination
+        n = self.dp * self.sp
+
+        def reshard_leaf(full, tp_dim):
+            full = jnp.asarray(full)
+            if tp_dim < 0 or self.tp == 1:
+                return _shard_leaf(full, n)
+            return _shard_leaf_tp(full, n, self.tp, tp_dim)
 
         def reshard_trunk(container):
             out = dict(container)
             out["trunk"] = jax.tree.map(
-                lambda full: _shard_leaf(jnp.asarray(full), n),
-                container["trunk"],
+                reshard_leaf, container["trunk"], self._trunk_tp_dims
             )
             return out
 

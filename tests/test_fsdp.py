@@ -270,3 +270,98 @@ class TestFSDPxSP:
         _, t_sp = self._pair("ring")
         for leaf in jax.tree.leaves(t_sp.params["trunk"]):
             assert leaf.addressable_shards[0].data.shape[1] * 8 == leaf.shape[1]
+
+
+class TestFSDPTensorParallel:
+    """FSDP x TP (VERDICT r3 #7): the trunk's Megatron-sharded leaves store
+    (L, tp, n, per) — slice dim on `model`, FSDP shard dim on the gather
+    axes — so each model shard gathers only its own tp-local slice and the
+    block runs with tp_size-local heads/hidden + one psum per projection
+    pair. Oracle: lockstep with flat FSDP on the same global data."""
+
+    def test_tp_matches_flat_fsdp(self, line8):
+        t_tp = _mk(jax.make_mesh((4, 2), ("data", "model")))
+        t_fl = _mk(line8)
+        assert t_tp.tp == 2 and t_tp.dp == 4
+        ds = data.lm_copy_task(32, vocab=16)
+        for i in range(3):
+            x, y = next(ds.batches(8, 1, seed_offset=i))
+            # tp replica row 2 of 4 holds the same global rows as flat
+            # devices 4,5 — equivalent contributor masks
+            v_tp = [1, 1, 0, 1] if i == 1 else None
+            v_fl = [1, 1, 1, 1, 0, 0, 1, 1] if i == 1 else None
+            a = t_tp.train_step(x, y, v_tp)
+            b = t_fl.train_step(x, y, v_fl)
+            assert abs(a.loss - b.loss) < 1e-5, (i, a.loss, b.loss)
+        d = np.abs(
+            _flat(t_tp.gathered_params()) - _flat(t_fl.gathered_params())
+        ).max()
+        assert d < 1e-5, d
+
+    def test_tp_sp_composes(self):
+        """All three axes at once: (data, model, seq) — ring attention over
+        seq, Megatron psums over model, FSDP gathers over data x seq."""
+        t = _mk(
+            jax.make_mesh((2, 2, 2), ("data", "model", "seq")),
+            seq_impl="ring",
+        )
+        assert (t.dp, t.tp, t.sp) == (2, 2, 2)
+        ds = data.lm_copy_task(32, vocab=16)
+        losses = []
+        for i in range(6):
+            x, y = next(ds.batches(4, 1, seed_offset=i))
+            losses.append(t.train_step(x, y).loss)
+        assert all(np.isfinite(l) for l in losses)
+        assert np.mean(losses[-2:]) < losses[0] + 0.1  # training, not NaN
+
+    def test_tp_checkpoint_cross_mesh(self, tmp_path, line8):
+        """A TP-mesh checkpoint restores onto a flat mesh and vice versa:
+        the serialized trunk is FULL-shape (tp- and n-independent)."""
+        t_tp = _mk(jax.make_mesh((4, 2), ("data", "model")))
+        ds = data.lm_copy_task(32, vocab=16)
+        batches = [next(ds.batches(8, 1, seed_offset=i)) for i in range(4)]
+        for x, y in batches[:2]:
+            t_tp.train_step(x, y)
+        with TrainerCheckpointer(tmp_path / "fsdptp") as ckpt:
+            assert ckpt.save(t_tp)
+            t_fl = _mk(line_mesh(4))
+            assert ckpt.restore(t_fl) == 2
+        np.testing.assert_allclose(
+            _flat(t_fl.gathered_params()), _flat(t_tp.gathered_params()),
+            rtol=1e-6, atol=1e-7,
+        )
+        for x, y in batches[2:]:
+            m1 = t_tp.train_step(x, y)
+            m2 = t_fl.train_step(x, y)
+            assert abs(m1.loss - m2.loss) < 1e-5
+
+    def test_canonical_mesh_order_accepted(self):
+        """The repo's canonical data_seq_model_mesh order (model innermost
+        — TP psums on adjacent chips) works; axis NAMES select behavior."""
+        from akka_allreduce_tpu.parallel import data_seq_model_mesh
+
+        t = _mk(data_seq_model_mesh(2, 2, 2))
+        assert (t.dp, t.sp, t.tp) == (2, 2, 2)
+        ds = data.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(4, 1))
+        assert np.isfinite(t.train_step(x, y).loss)
+
+    def test_rejects_bad_axis_layout(self):
+        with pytest.raises(ValueError, match="leading data"):
+            _mk(jax.make_mesh((2, 4), ("model", "data")))
+
+
+def test_train_chain_on_device(line8):
+    """The zero-host-I/O chain (round 3): one stream per DP replica row,
+    seq shards slice their columns; runs on flat, x SP and x TP meshes."""
+    sampler = data.lm_copy_task(32, vocab=16).device_sampler()
+    for mesh in (
+        line8,
+        jax.make_mesh((4, 2), ("data", "model")),
+        jax.make_mesh((2, 2, 2), ("data", "model", "seq")),
+    ):
+        t = _mk(mesh)
+        hist = t.train_chain(sampler, steps=3, rows_per_replica=2)
+        assert len(hist) == 3
+        assert all(np.isfinite(h.loss) for h in hist)
+        assert hist[0].contributors == float(t.dp)
