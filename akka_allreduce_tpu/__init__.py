@@ -58,6 +58,7 @@ _LAZY_EXPORTS = {
     "data_seq_mesh": "akka_allreduce_tpu.parallel",
     "DPTrainer": "akka_allreduce_tpu.train",
     "ElasticDPTrainer": "akka_allreduce_tpu.train",
+    "ElasticTrainer": "akka_allreduce_tpu.train",
     "LongContextTrainer": "akka_allreduce_tpu.train",
     "ElasticClusterNode": "akka_allreduce_tpu.train",
     "Zero1DPTrainer": "akka_allreduce_tpu.train",

@@ -423,37 +423,72 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
     lost = nodes - 1
     survivors = [k for k in range(nodes) if k != lost]
     now = {"t": 0.0}
+    ds = data.mnist_like()
+
+    def remesh_cycle(elastic):
+        """Drop + late-joiner cycle on ``elastic``; returns the measured
+        (drop, rejoin) re-mesh+first-step latencies and the step metrics."""
+        x, y = next(iter(ds.batches(8 * elastic.n_devices, 1)))
+        elastic.train_step(x, y)  # compile generation 0
+
+        # dropout: the last node goes silent long enough for phi to accrue
+        # while the survivors keep heartbeating across the gap
+        for k in survivors:
+            elastic.heartbeat(k)
+        now["t"] += 60.0
+        for k in survivors:
+            elastic.heartbeat(k)
+        t0 = time.perf_counter()
+        dropped = elastic.poll()
+        x, y = next(
+            iter(ds.batches(8 * elastic.n_devices, 1, seed_offset=2))
+        )
+        m_drop = elastic.train_step(x, y)  # includes new-mesh compile
+        drop_s = time.perf_counter() - t0
+
+        # late joiner: the lost node heartbeats again -> membership grows
+        now["t"] += 1.0
+        elastic.heartbeat(lost)
+        t0 = time.perf_counter()
+        rejoined = elastic.poll()
+        x, y = next(
+            iter(ds.batches(8 * elastic.n_devices, 1, seed_offset=3))
+        )
+        m_join = elastic.train_step(x, y)
+        rejoin_s = time.perf_counter() - t0
+        return dropped, rejoined, drop_s, rejoin_s, m_drop, m_join
+
     trainer = ElasticDPTrainer(
         MLP(hidden=(16,), classes=10),
         assignment,
         example_input=np.zeros((1, 28, 28, 1), np.float32),
         clock=lambda: now["t"],
     )
-    ds = data.mnist_like()
-    x, y = next(iter(ds.batches(8 * trainer.n_devices, 1)))
-    trainer.train_step(x, y)  # compile generation 0
+    (
+        dropped_remesh, rejoin_remesh, drop_remesh_s, rejoin_remesh_s,
+        m_drop, m_join,
+    ) = remesh_cycle(trainer)
 
-    # dropout: the last node goes silent long enough for phi to accrue
-    # while the survivors keep heartbeating across the gap
-    for k in survivors:
-        trainer.heartbeat(k)
-    now["t"] += 60.0
-    for k in survivors:
-        trainer.heartbeat(k)
-    t0 = time.perf_counter()
-    dropped_remesh = trainer.poll()
-    x, y = next(iter(ds.batches(8 * trainer.n_devices, 1, seed_offset=2)))
-    m_drop = trainer.train_step(x, y)  # includes new-mesh compile
-    drop_remesh_s = time.perf_counter() - t0
+    # sharded-state variant (VERDICT r3 #3): ZeRO-1's 1/n optimizer shards
+    # survive the SAME cycle through the mesh-size-independent snapshot
+    # (Snapshot -> checkpoint_state -> reshard onto the new mesh)
+    import optax
 
-    # late joiner: the lost node heartbeats again -> membership grows back
-    now["t"] += 1.0
-    trainer.heartbeat(lost)
-    t0 = time.perf_counter()
-    rejoin_remesh = trainer.poll()
-    x, y = next(iter(ds.batches(8 * trainer.n_devices, 1, seed_offset=3)))
-    m_join = trainer.train_step(x, y)
-    rejoin_remesh_s = time.perf_counter() - t0
+    from akka_allreduce_tpu.train import ElasticTrainer, Zero1DPTrainer
+
+    def z1_factory(mesh):
+        return Zero1DPTrainer(
+            MLP(hidden=(16,), classes=10),
+            mesh,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.sgd(0.1),
+            seed=0,
+        )
+
+    z1 = ElasticTrainer(z1_factory, assignment, clock=lambda: now["t"])
+    (
+        z1_dropped, z1_rejoined, z1_drop_s, z1_rejoin_s, _, z1_join,
+    ) = remesh_cycle(z1)
 
     return _record(
         5,
@@ -473,6 +508,10 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
         rejoin_remesh_and_first_step_s=round(rejoin_remesh_s, 3),
         post_remesh_loss=round(m_drop.loss, 4),
         post_rejoin_loss=round(m_join.loss, 4),
+        zero1_remeshed=bool(z1_dropped) and bool(z1_rejoined),
+        zero1_drop_remesh_and_first_step_s=round(z1_drop_s, 3),
+        zero1_rejoin_remesh_and_first_step_s=round(z1_rejoin_s, 3),
+        zero1_post_rejoin_loss=round(z1_join.loss, 4),
         path="host_engine + xla_elastic",
     )
 

@@ -8,7 +8,10 @@ from akka_allreduce_tpu.train.checkpoint import (  # noqa: F401
 from akka_allreduce_tpu.train.cluster import ElasticClusterNode  # noqa: F401
 from akka_allreduce_tpu.train.zero1 import Zero1DPTrainer  # noqa: F401
 from akka_allreduce_tpu.train.fsdp import FSDPLMTrainer  # noqa: F401
-from akka_allreduce_tpu.train.elastic import ElasticDPTrainer  # noqa: F401
+from akka_allreduce_tpu.train.elastic import (  # noqa: F401
+    ElasticDPTrainer,
+    ElasticTrainer,
+)
 from akka_allreduce_tpu.train.long_context import (  # noqa: F401
     LongContextStepMetrics,
     LongContextTrainer,

@@ -92,15 +92,33 @@ class Snapshot:
     Held as numpy so it survives the death of the device mesh it came from:
     during elastic reconfiguration the old mesh's devices may be gone by the
     time we restore.
+
+    Trainers with the trainer-defined checkpoint protocol
+    (``checkpoint_state``/``restore_checkpoint_state`` — ZeRO-1, FSDP)
+    snapshot through it: their serialized form is mesh-size-INDEPENDENT, so
+    the same snapshot restores onto a mesh with a different device count —
+    exactly what the elastic re-mesh needs (VERDICT r3 #3). Pytree-state
+    trainers use the params/opt_state capture as before.
     """
 
-    params: Any  # pytree of np.ndarray
+    params: Any  # pytree of np.ndarray (pytree-state trainers)
     opt_state: Any  # pytree of np.ndarray / leaves
     step: int
     ef: Any = None  # error-feedback residual (n_devices, params) or None
+    custom: dict | None = None  # trainer-defined checkpoint_state() payload
 
     @classmethod
     def capture(cls, trainer) -> "Snapshot":
+        if hasattr(trainer, "checkpoint_state"):
+            state = jax.tree.map(
+                lambda x: np.asarray(x), dict(trainer.checkpoint_state())
+            )
+            return cls(
+                params=None,
+                opt_state=None,
+                step=trainer.step_num,
+                custom=state,
+            )
         host = lambda t: jax.tree.map(lambda x: np.asarray(x), t)
         ef = getattr(trainer, "_ef", None)
         return cls(
@@ -112,7 +130,20 @@ class Snapshot:
 
     def restore_into(self, trainer) -> None:
         """Place this snapshot into ``trainer``, honoring its sharding layout
-        (replicated for plain DP; per-leaf specs for TP/EP/PP trainers)."""
+        (replicated for plain DP; per-leaf specs for TP/EP/PP trainers;
+        the trainer-defined reshard for ZeRO-1/FSDP)."""
+        if self.custom is not None:
+            if not hasattr(trainer, "restore_checkpoint_state"):
+                raise TypeError(
+                    "snapshot was captured through a trainer-defined "
+                    "checkpoint protocol; the restore target has none"
+                )
+            # restore may mutate the dict (zero1 pops format_version) and
+            # the snapshot may be restored more than once — hand over a
+            # shallow copy
+            trainer.restore_checkpoint_state(dict(self.custom))
+            trainer.step_num = self.step
+            return
         p_sh, o_sh = state_shardings(trainer)
         trainer.params = place_on(self.params, p_sh)
         trainer.opt_state = place_on(self.opt_state, o_sh)

@@ -38,45 +38,50 @@ from akka_allreduce_tpu.train.trainer import DPTrainer, TrainStepMetrics
 log = logging.getLogger(__name__)
 
 
-class ElasticDPTrainer:
-    """DP trainer that re-meshes over the devices of live nodes.
+class ElasticTrainer:
+    """ANY trainer re-meshed over the devices of live nodes.
+
+    The generic form of the elastic cycle (VERDICT r3 #3): membership is a
+    node -> devices map, the failure detector marks nodes up/down, and on a
+    change the CURRENT trainer's state snapshots to host RAM, a NEW trainer
+    is built by ``trainer_factory`` over the live devices' mesh, and the
+    snapshot restores into it. Trainers with the trainer-defined checkpoint
+    protocol (ZeRO-1, FSDP) snapshot through their mesh-size-INDEPENDENT
+    serialization, so sharded optimizer/param state survives a device-count
+    change; pytree-state trainers (DP/TP/EP/PP) use the replicated-state
+    snapshot as before.
 
     Args:
-      model: flax module.
+      trainer_factory: ``mesh -> trainer``; called at construction and on
+        every re-mesh with the live devices' mesh.
       devices_by_node: node id -> that node's devices (disjoint). The mesh at
         any moment is the concatenation of live nodes' devices, in node order.
-      example_input: one device's worth of input for ``init``.
       mesh_factory: devices -> Mesh (default: 1D line; pass grid_mesh for the
         butterfly layout).
       detector: phi-accrual detector (default: Akka-like threshold 8).
       min_nodes: below this many live nodes, ``train_step`` refuses to run
         (the reference's th_allreduce floor applied to membership).
-      **trainer_kwargs: forwarded to DPTrainer (optimizer, bucket_size, ...).
     """
 
     def __init__(
         self,
-        model,
+        trainer_factory: Callable[[jax.sharding.Mesh], object],
         devices_by_node: Mapping[int, Sequence[jax.Device]],
-        example_input: np.ndarray,
         *,
         mesh_factory: Callable[..., jax.sharding.Mesh] = line_mesh,
         detector: PhiAccrualFailureDetector | None = None,
         min_nodes: int = 1,
         clock: Callable[[], float] = time.monotonic,
-        **trainer_kwargs,
     ) -> None:
         if not devices_by_node:
             raise ValueError("need at least one node")
-        self.model = model
+        self.trainer_factory = trainer_factory
         self.devices_by_node = {
             int(k): list(v) for k, v in devices_by_node.items()
         }
-        self.example_input = np.asarray(example_input)
         self.mesh_factory = mesh_factory
         self.min_nodes = min_nodes
         self.clock = clock
-        self.trainer_kwargs = trainer_kwargs
         self.monitor = HeartbeatMonitor(detector)
         self.generation = 0  # the config_id analog: bumps on every re-mesh
         self.remesh_events: list[MembershipEvent] = []
@@ -95,14 +100,9 @@ class ElasticDPTrainer:
             devs.extend(self.devices_by_node[node_id])
         return devs
 
-    def _build_trainer(self) -> DPTrainer:
+    def _build_trainer(self):
         mesh = self.mesh_factory(devices=self._live_devices())
-        return DPTrainer(
-            self.model,
-            mesh,
-            example_input=self.example_input,
-            **self.trainer_kwargs,
-        )
+        return self.trainer_factory(mesh)
 
     def heartbeat(self, node_id: int, now: float | None = None) -> None:
         """Record a node's heartbeat. An unknown node id is a late joiner."""
@@ -169,4 +169,46 @@ class ElasticDPTrainer:
         return self.trainer.train_step(x, y, valid)
 
     def get_flat_params(self) -> np.ndarray:
-        return self.trainer.get_flat_params()
+        if hasattr(self.trainer, "get_flat_params"):
+            return self.trainer.get_flat_params()
+        # FSDP exposes gathered_params() instead of a flat vector
+        from akka_allreduce_tpu.binder.api import flatten_pytree
+
+        return flatten_pytree(self.trainer.gathered_params())[0]
+
+
+class ElasticDPTrainer(ElasticTrainer):
+    """DP form of :class:`ElasticTrainer` (the original elastic cycle):
+    builds a :class:`DPTrainer` from ``model``/``example_input`` on every
+    re-mesh. Kept as the config-5 workhorse; ZeRO-1/FSDP go through
+    :class:`ElasticTrainer` with their own factory."""
+
+    def __init__(
+        self,
+        model,
+        devices_by_node: Mapping[int, Sequence[jax.Device]],
+        example_input: np.ndarray,
+        *,
+        mesh_factory: Callable[..., jax.sharding.Mesh] = line_mesh,
+        detector: PhiAccrualFailureDetector | None = None,
+        min_nodes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        **trainer_kwargs,
+    ) -> None:
+        example = np.asarray(example_input)
+
+        def factory(mesh):
+            return DPTrainer(
+                model, mesh, example_input=example, **trainer_kwargs
+            )
+
+        super().__init__(
+            factory,
+            devices_by_node,
+            mesh_factory=mesh_factory,
+            detector=detector,
+            min_nodes=min_nodes,
+            clock=clock,
+        )
+        self.model = model
+        self.example_input = example

@@ -211,3 +211,121 @@ class TestElasticDPTrainer:
         np.testing.assert_allclose(
             t.get_flat_params(), oracle.get_flat_params(), rtol=1e-6, atol=1e-7
         )
+
+
+class TestElasticShardedState:
+    """The elastic cycle for SHARDED-state trainers (VERDICT r3 #3): ZeRO-1
+    and FSDP re-mesh across a device-count change through their
+    mesh-size-independent serialization (Snapshot now routes through the
+    trainer-defined checkpoint protocol). Oracle: after every re-mesh the
+    elastic trainer must continue EXACTLY like a fresh trainer of the new
+    geometry restored from the same state — the re-mesh is
+    checkpoint-restore-equivalent, so numerics match continuation."""
+
+    def _nodes(self, per=2, n=4):
+        devs = jax.devices()
+        return {
+            i: devs[i * per : (i + 1) * per] for i in range(n)
+        }
+
+    def _cycle(self, elastic, factory, batch_for):
+        """Run the 4 -> 3 -> 4 node drop/late-joiner cycle; at each phase,
+        lockstep-compare against a fresh mirror trainer built on the same
+        device set from the same snapshot."""
+        from akka_allreduce_tpu.parallel import line_mesh
+        from akka_allreduce_tpu.train.checkpoint import Snapshot
+
+        now = {"t": 0.0}
+        elastic.clock = lambda: now["t"]
+
+        def advance_and_heartbeat(alive):
+            for nid in alive:
+                elastic.heartbeat(nid)
+            now["t"] += 1.0
+
+        def mirror():
+            snap = Snapshot.capture(elastic.trainer)
+            m = factory(line_mesh(devices=elastic._live_devices()))
+            snap.restore_into(m)
+            return m
+
+        phases = [
+            (list(range(4)), 4),  # all up
+            ([0, 1, 2], 3),  # node 3 silent -> drop
+            (list(range(4)), 4),  # late joiner returns
+        ]
+        seed = 0
+        for alive, want_nodes in phases:
+            # several silent polls so the phi detector trips (or heals)
+            for _ in range(8):
+                advance_and_heartbeat(alive)
+                elastic.poll()
+            assert elastic.n_nodes == want_nodes, (alive, elastic.n_nodes)
+            m = mirror()
+            for _ in range(2):
+                x, y = batch_for(elastic.n_devices, seed)
+                seed += 1
+                a = elastic.train_step(x, y)
+                b = m.train_step(x, y)
+                assert abs(a.loss - b.loss) < 1e-6, (a.loss, b.loss)
+        assert elastic.generation == 2
+        return elastic
+
+    def test_zero1_drop_and_rejoin(self):
+        import optax
+
+        from akka_allreduce_tpu.train import ElasticTrainer, Zero1DPTrainer
+
+        ex = np.zeros((1, 28, 28, 1), np.float32)
+
+        def factory(mesh):
+            return Zero1DPTrainer(
+                MLP(hidden=(32,), classes=10),
+                mesh,
+                example_input=ex,
+                optimizer=optax.sgd(0.1, momentum=0.9),
+                seed=0,
+            )
+
+        ds = data.mnist_like()
+
+        def batch_for(n_devices, seed):
+            return next(iter(ds.batches(n_devices * 4, 1, seed_offset=seed)))
+
+        e = ElasticTrainer(factory, self._nodes())
+        e = self._cycle(e, factory, batch_for)
+        # moments are sharded over the CURRENT 8-device mesh again
+        for leaf in jax.tree.leaves(e.trainer.opt_state):
+            if np.asarray(leaf).ndim > 0:
+                assert (
+                    leaf.addressable_shards[0].data.shape[0] * 8
+                    == leaf.shape[0]
+                )
+
+    def test_fsdp_drop_and_rejoin(self):
+        import optax
+
+        from akka_allreduce_tpu.train import ElasticTrainer, FSDPLMTrainer
+
+        def factory(mesh):
+            return FSDPLMTrainer(
+                mesh,
+                vocab=16,
+                d_model=32,
+                n_heads=4,
+                n_layers=2,
+                seq_len=32,
+                optimizer=optax.sgd(1e-2),
+                seed=0,
+            )
+
+        ds = data.lm_copy_task(32, vocab=16)
+
+        def batch_for(n_devices, seed):
+            return next(ds.batches(n_devices, 1, seed_offset=seed))
+
+        e = ElasticTrainer(factory, self._nodes())
+        e = self._cycle(e, factory, batch_for)
+        # trunk re-sharded 1/8 on the restored full mesh
+        for leaf in jax.tree.leaves(e.trainer.params["trunk"]):
+            assert leaf.addressable_shards[0].data.shape[1] * 8 == leaf.shape[1]
