@@ -848,6 +848,12 @@ def _cmd_cluster_node(argv: list[str]) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--node-id", type=int, default=-1, help="-1 = master assigns")
     p.add_argument("--data-seed", type=int, default=None, help="payload RNG seed")
+    p.add_argument(
+        "--metrics-out", default=None,
+        help="JSONL path for the node's per-stage protocol timing "
+        "(fields encode/socket_write/decode/handler — where the wire "
+        "budget goes)",
+    )
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
 
@@ -893,11 +899,32 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             await node.stop()
         dt = time.perf_counter() - state["t0"]
         mbs = state["flushes"] * size * 4 / max(dt, 1e-9) / 1e6
+        stages = dict(node.transport.stage_seconds)
+        accounted = sum(stages.values())
+        stage_note = ", ".join(
+            f"{k}={v:.3f}s" for k, v in stages.items()
+        )
         print(
             f"node {nid} shut down ({reason}): {state['flushes']} rounds, "
             f"{mbs:.1f} MB/s reduced",
             flush=True,
         )
+        print(
+            f"node {nid} stage times over {dt:.2f}s wall: {stage_note} "
+            f"(accounted {accounted:.2f}s; the rest is event-loop wait "
+            "and peer I/O)",
+            flush=True,
+        )
+        if args.metrics_out:
+            from akka_allreduce_tpu.utils.metrics import MetricsLogger
+
+            m = MetricsLogger(args.metrics_out)
+            m.log_event(
+                kind="node_stage_times", node=nid, wall_s=round(dt, 3),
+                rounds=state["flushes"], mb_per_s=round(mbs, 1),
+                **{k: round(v, 4) for k, v in stages.items()},
+            )
+            m.close()
         return 0
 
     return asyncio.run(run())

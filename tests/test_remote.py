@@ -385,6 +385,11 @@ def test_cluster_rounds_with_f16_wire():
         scale = np.abs(exact).max()
         err = np.abs(out.average() - exact).max() / scale
         assert 0 < err < 2e-3, err  # lossy (so f16 really rode the wire)
+        # per-stage accounting accumulated on every leg (VERDICT r3 #8)
+        for n in h.nodes.values():
+            st = n.transport.stage_seconds
+            assert st["encode"] > 0 and st["handler"] > 0, st
+            assert st["decode"] > 0 and st["socket_write"] > 0, st
 
     asyncio.run(run())
 
