@@ -39,8 +39,11 @@ class TestCLI:
         ]
         assert main(args) == 0
         lines = [json.loads(l) for l in metrics.read_text().splitlines()]
-        assert [l["step"] for l in lines] == [1, 2]
-        assert all(l["contributors"] == 8.0 for l in lines)
+        # round 3: a train_summary record (tflops/mfu) follows the steps
+        steps = [l for l in lines if l.get("kind") == "train_step"]
+        assert [l["step"] for l in steps] == [1, 2]
+        assert all(l["contributors"] == 8.0 for l in steps)
+        assert any(l.get("kind") == "train_summary" for l in lines)
 
         assert main(args) == 0  # second run resumes from the checkpoint
         assert "resumed from step 2" in capsys.readouterr().out
@@ -56,8 +59,9 @@ class TestCLI:
         out = capsys.readouterr().out
         assert "dp=2 x sp=4" in out  # 8-device mesh factors to 2x4
         lines = [json.loads(l) for l in metrics.read_text().splitlines()]
-        assert [l["step"] for l in lines] == [1, 2]
-        assert all(l["contributors"] == 2.0 for l in lines)
+        steps = [l for l in lines if l.get("kind") == "train_step"]
+        assert [l["step"] for l in steps] == [1, 2]
+        assert all(l["contributors"] == 2.0 for l in steps)
 
     def test_elastic_demo(self, capsys):
         # the drop window must outlast the phi detector's suspicion ramp
