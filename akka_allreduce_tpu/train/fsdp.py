@@ -45,7 +45,12 @@ from jax.sharding import PartitionSpec as P
 
 from akka_allreduce_tpu.models.transformer import Block
 from akka_allreduce_tpu.train.pipeline import _LMHead
-from akka_allreduce_tpu.train.trainer import TrainStepMetrics, normalize_valid
+from akka_allreduce_tpu.train.trainer import (
+    TrainStepMetrics,
+    normalize_valid,
+    place_mask,
+    place_tokens,
+)
 
 
 def _shard_leaf(leaf: jax.Array, n: int) -> jax.Array:
@@ -453,22 +458,10 @@ class FSDPLMTrainer:
     # -- stepping ------------------------------------------------------------
 
     def _place_batch_tokens(self, tokens, labels):
-        if tokens.shape[0] % self.dp:
-            raise ValueError(
-                f"global batch {tokens.shape[0]} not divisible by "
-                f"dp={self.dp}"
-            )
-        if tokens.shape[1] != self.seq_len:
-            raise ValueError(
-                f"sequence length {tokens.shape[1]} != {self.seq_len}"
-            )
-        xd = jax.device_put(
-            np.asarray(tokens, np.int32), self._data_sharding
+        return place_tokens(
+            tokens, labels, self._data_sharding,
+            seq_len=self.seq_len, dp=self.dp,
         )
-        yd = jax.device_put(
-            np.asarray(labels, np.int32), self._data_sharding
-        )
-        return xd, yd
 
     def train_step(
         self,
@@ -480,7 +473,7 @@ class FSDPLMTrainer:
         the per-DP-replica-row contributor mask, shape (dp,)."""
         valid_arr = normalize_valid(valid, self.dp)
         xd, yd = self._place_batch_tokens(tokens, labels)
-        vd = jax.device_put(valid_arr, self._valid_sharding)
+        vd = place_mask(valid_arr, self._valid_sharding)
         self.params, self.opt_state, loss, cnt = self._step(
             self.params, self.opt_state, xd, yd, vd
         )

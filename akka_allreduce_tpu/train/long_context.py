@@ -276,17 +276,11 @@ class LongContextTrainer:
     # -- stepping ------------------------------------------------------------
 
     def _place(self, x, y):
-        if x.shape[0] % self.dp:
-            raise ValueError(
-                f"global batch {x.shape[0]} not divisible by dp={self.dp}"
-            )
-        if x.shape[1] != self.seq_len:
-            raise ValueError(
-                f"sequence length {x.shape[1]} != configured {self.seq_len}"
-            )
-        x = jax.device_put(np.asarray(x, np.int32), self._data_sharding)
-        y = jax.device_put(np.asarray(y, np.int32), self._data_sharding)
-        return x, y
+        from akka_allreduce_tpu.train.trainer import place_tokens
+
+        return place_tokens(
+            x, y, self._data_sharding, seq_len=self.seq_len, dp=self.dp
+        )
 
     def train_step(
         self,
@@ -299,11 +293,14 @@ class LongContextTrainer:
         ``valid``: per-DP-replica-row contributor mask of shape (dp,);
         None = all rows contribute.
         """
-        from akka_allreduce_tpu.train.trainer import normalize_valid
+        from akka_allreduce_tpu.train.trainer import (
+            normalize_valid,
+            place_mask,
+        )
 
         valid_arr = normalize_valid(valid, self.dp)
         xd, yd = self._place(tokens, labels)
-        vd = jax.device_put(valid_arr, self._valid_sharding)
+        vd = place_mask(valid_arr, self._valid_sharding)
         self.params, self.opt_state, loss, cnt = self._step(
             self.params, self.opt_state, xd, yd, vd
         )

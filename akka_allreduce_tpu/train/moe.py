@@ -300,7 +300,12 @@ class MoETrainer:
         """One step on a GLOBAL (batch, seq_len) token array; batch divisible
         by dp * ep. ``valid``: per-DP-replica-row mask of shape (dp,)."""
         row_shards = self.dp * self.ep  # rows shard over data x expert only
-        if tokens.shape[0] % row_shards:
+        if (
+            self._data_sharding.is_fully_addressable
+            and tokens.shape[0] % row_shards
+        ):
+            # pod runtime: callers pass HOST-LOCAL rows, so the global
+            # divisibility check belongs to place_tokens' seam, not here
             raise ValueError(
                 f"global batch {tokens.shape[0]} not divisible by "
                 f"{row_shards} row shards (data x expert)"
@@ -309,12 +314,18 @@ class MoETrainer:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} != {self.seq_len}"
             )
-        from akka_allreduce_tpu.train.trainer import normalize_valid
+        from akka_allreduce_tpu.train.trainer import (
+            normalize_valid,
+            place_mask,
+            place_tokens,
+        )
 
         valid_arr = normalize_valid(valid, self.dp)
-        xd = jax.device_put(np.asarray(tokens, np.int32), self._data_sharding)
-        yd = jax.device_put(np.asarray(labels, np.int32), self._data_sharding)
-        vd = jax.device_put(valid_arr, self._valid_sharding)
+        xd, yd = place_tokens(
+            tokens, labels, self._data_sharding,
+            seq_len=self.seq_len, dp=1,  # row divisibility checked above
+        )
+        vd = place_mask(valid_arr, self._valid_sharding)
         self.params, self.opt_state, loss, aux, dropped, cnt = self._step(
             self.params, self.opt_state, xd, yd, vd
         )

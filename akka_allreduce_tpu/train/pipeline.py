@@ -529,7 +529,11 @@ class PipelineLMTrainer:
         """One step on a GLOBAL (batch, seq_len) token array; batch divisible
         by dp * microbatches."""
         per_step = self.dp * self.microbatches
-        if tokens.shape[0] % per_step:
+        if (
+            self._data_sharding.is_fully_addressable
+            and tokens.shape[0] % per_step
+        ):
+            # pod runtime: callers pass HOST-LOCAL rows (place_tokens' seam)
             raise ValueError(
                 f"global batch {tokens.shape[0]} not divisible by "
                 f"dp*microbatches={per_step}"
@@ -538,12 +542,18 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} != {self.seq_len}"
             )
-        from akka_allreduce_tpu.train.trainer import normalize_valid
+        from akka_allreduce_tpu.train.trainer import (
+            normalize_valid,
+            place_mask,
+            place_tokens,
+        )
 
         valid_arr = normalize_valid(valid, self.dp)
-        xd = jax.device_put(np.asarray(tokens, np.int32), self._data_sharding)
-        yd = jax.device_put(np.asarray(labels, np.int32), self._data_sharding)
-        vd = jax.device_put(valid_arr, self._valid_sharding)
+        xd, yd = place_tokens(
+            tokens, labels, self._data_sharding,
+            seq_len=self.seq_len, dp=1,  # dp*microbatches checked above
+        )
+        vd = place_mask(valid_arr, self._valid_sharding)
         self.params, self.opt_state, loss, cnt = self._step(
             self.params, self.opt_state, xd, yd, vd
         )

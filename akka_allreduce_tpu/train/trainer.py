@@ -169,6 +169,40 @@ def place_batch(x, y, n_devices: int, data_sharding):
     return x, y
 
 
+def place_tokens(x, y, data_sharding, *, seq_len: int, dp: int):
+    """Token-LM twin of :func:`place_batch` (both arrays int32, batch rows
+    over the data axis, the seq dim over any seq axis in the spec).
+
+    Single-process: ``x``/``y`` are the GLOBAL (batch, seq_len) arrays.
+    Pod runtime (the sharding's mesh spans OS processes): each process
+    passes the HOST-LOCAL slice matching its devices' block of the
+    sharding — for the (data, seq) layouts used here, its DP rows' full
+    sequences when its devices cover whole replica rows.
+    """
+    if x.shape[1] != seq_len:
+        raise ValueError(f"sequence length {x.shape[1]} != {seq_len}")
+    if not data_sharding.is_fully_addressable:
+        from akka_allreduce_tpu.parallel import multihost
+
+        mesh, spec = data_sharding.mesh, data_sharding.spec
+        return (
+            multihost.host_local_to_global(
+                np.asarray(x, np.int32), mesh, spec
+            ),
+            multihost.host_local_to_global(
+                np.asarray(y, np.int32), mesh, spec
+            ),
+        )
+    if x.shape[0] % dp:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by dp={dp}"
+        )
+    return (
+        jax.device_put(np.asarray(x, np.int32), data_sharding),
+        jax.device_put(np.asarray(y, np.int32), data_sharding),
+    )
+
+
 def place_mask(valid_arr: np.ndarray, data_sharding):
     """Place the GLOBAL per-device contributor mask on the mesh.
 
@@ -181,15 +215,31 @@ def place_mask(valid_arr: np.ndarray, data_sharding):
         return jax.device_put(valid_arr, data_sharding)
     from akka_allreduce_tpu.parallel import multihost
 
-    mesh = data_sharding.mesh
+    arr = np.asarray(valid_arr)
+    # the sharding's own index map says which mask ROWS this process's
+    # devices hold (NOT one entry per device: on a multi-axis mesh several
+    # devices share a data row, and the mask length is the data extent)
     pid = jax.process_index()
-    local_idx = [
-        i
-        for i, d in enumerate(mesh.devices.flat)
+    imap = data_sharding.devices_indices_map(arr.shape)
+    starts = [
+        idx[0].start or 0
+        for d, idx in imap.items()
         if d.process_index == pid
     ]
+    stops = [
+        idx[0].stop if idx[0].stop is not None else arr.shape[0]
+        for d, idx in imap.items()
+        if d.process_index == pid
+    ]
+    if not starts:
+        # a clean error beats min()-of-empty followed by peers hanging in
+        # the collective (same contract as place_batch's 0-device message)
+        raise ValueError(
+            "this process owns no devices in the training mesh; a "
+            "zero-device participant cannot feed the pod collective"
+        )
     return multihost.host_local_to_global(
-        np.asarray(valid_arr)[local_idx], mesh, data_sharding.spec
+        arr[min(starts) : max(stops)], data_sharding.mesh, data_sharding.spec
     )
 
 

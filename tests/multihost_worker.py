@@ -137,6 +137,40 @@ def main() -> None:
     )
     print(f"MULTIHOST_TRAIN_OK {process_id}", flush=True)
 
+    # ---- the token LM on a (data, seq) mesh spanning processes ------------
+    # dp rows split across processes (each feeds its host-local rows via
+    # place_tokens' pod path); the 2-way seq axis lives INSIDE each
+    # process here, so each process passes its rows' FULL sequences.
+    from akka_allreduce_tpu.train import LongContextTrainer
+
+    lm_mesh = jax.make_mesh(
+        (num_processes * 2, 2), ("data", "seq"), devices=jax.devices()
+    )
+    lm = LongContextTrainer(
+        lm_mesh,
+        vocab=16,
+        d_model=32,
+        n_heads=4,
+        n_layers=1,
+        seq_len=32,
+        optimizer=optax.sgd(1e-2),
+        seed=3,
+    )
+    lrng = np.random.default_rng(7)
+    rows = lm.dp  # one row per data coordinate, batch = dp
+    for s in range(2):
+        tok = lrng.integers(0, 16, size=(rows, 32)).astype(np.int32)
+        lab = lrng.integers(0, 16, size=(rows, 32)).astype(np.int32)
+        rows_per_proc = rows // num_processes
+        lo = process_id * rows_per_proc
+        hi = lo + rows_per_proc
+        lmask = np.ones((lm.dp,), np.float32)
+        lmask[0] = 0.0
+        m = lm.train_step(tok[lo:hi], lab[lo:hi], lmask)
+        assert m.contributors == lm.dp - 1, m
+        assert np.isfinite(m.loss)
+    print(f"MULTIHOST_LM_OK {process_id}", flush=True)
+
     print(f"MULTIHOST_OK {process_id}", flush=True)
 
 
