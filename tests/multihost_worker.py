@@ -171,6 +171,46 @@ def main() -> None:
         assert np.isfinite(m.loss)
     print(f"MULTIHOST_LM_OK {process_id}", flush=True)
 
+    # ---- MoE (data, expert) and Pipeline (data, pipe) across processes ----
+    # the remaining token trainers ride the same place_tokens/place_mask
+    # seam; one masked step each proves the pod path end to end
+    from akka_allreduce_tpu.train import MoETrainer, PipelineLMTrainer
+
+    moe = MoETrainer(
+        jax.make_mesh((n // 2, 2), ("data", "expert"), devices=jax.devices()),
+        vocab=16, d_model=32, n_heads=4, n_layers=1, n_experts=2,
+        seq_len=16, optimizer=optax.sgd(1e-2), seed=4,
+    )
+    rows_global = moe.dp * moe.ep  # batch rows shard over data x expert
+    tok = lrng.integers(0, 16, size=(rows_global, 16)).astype(np.int32)
+    share = rows_global // num_processes
+    mmask = np.ones((moe.dp,), np.float32)
+    mmask[-1] = 0.0
+    mm = moe.train_step(
+        tok[process_id * share : (process_id + 1) * share],
+        tok[process_id * share : (process_id + 1) * share],
+        mmask,
+    )
+    assert mm.contributors == moe.dp - 1 and np.isfinite(mm.loss), mm
+
+    pp = PipelineLMTrainer(
+        jax.make_mesh((n // 2, 2), ("data", "pipe"), devices=jax.devices()),
+        vocab=16, d_model=32, n_heads=4, layers_per_stage=1,
+        microbatches=2, seq_len=16, optimizer=optax.sgd(1e-2), seed=5,
+    )
+    rows_global = pp.dp * pp.microbatches
+    tokp = lrng.integers(0, 16, size=(rows_global, 16)).astype(np.int32)
+    share = rows_global // num_processes
+    pmask = np.ones((pp.dp,), np.float32)
+    pmask[-1] = 0.0
+    pm = pp.train_step(
+        tokp[process_id * share : (process_id + 1) * share],
+        tokp[process_id * share : (process_id + 1) * share],
+        pmask,
+    )
+    assert pm.contributors == pp.dp - 1 and np.isfinite(pm.loss), pm
+    print(f"MULTIHOST_MOE_PP_OK {process_id}", flush=True)
+
     print(f"MULTIHOST_OK {process_id}", flush=True)
 
 
