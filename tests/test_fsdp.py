@@ -346,6 +346,19 @@ class TestFSDPTensorParallel:
         x, y = next(ds.batches(4, 1))
         assert np.isfinite(t.train_step(x, y).loss)
 
+    def test_tp_composes_with_compress_and_prefetch(self):
+        """The bf16 gathers and the software-pipelined prefetch both ride
+        the same gather_leaf path under TP (mixed 3D/4D trunk leaves)."""
+        mesh = jax.make_mesh((4, 2), ("data", "model"))
+        t0 = _mk(mesh)
+        t1 = _mk(mesh, compress="bf16", prefetch=True)
+        ds = data.lm_copy_task(32, vocab=16)
+        for i in range(2):
+            x, y = next(ds.batches(8, 1, seed_offset=i))
+            a = t0.train_step(x, y)
+            b = t1.train_step(x, y)
+            assert abs(a.loss - b.loss) < 5e-3, (a.loss, b.loss)
+
     def test_rejects_bad_axis_layout(self):
         with pytest.raises(ValueError, match="leading data"):
             _mk(jax.make_mesh((2, 4), ("model", "data")))
