@@ -487,10 +487,15 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     p.add_argument("--layers", type=int, default=2)
     p.add_argument(
         "--remat",
-        action="store_true",
-        help="recompute each layer on backward: one layer's activations AND "
-        "one layer's gathered params live at a time — the full FSDP memory "
-        "profile",
+        nargs="?",
+        const="full",
+        default=False,
+        choices=("full", "params"),
+        help="'full' (also bare --remat): recompute each layer on backward "
+        "— one layer's activations AND one layer's gathered params live at "
+        "a time, the full FSDP memory profile. 'params': drop the gathered "
+        "layers and re-gather on backward — matmul activations stay saved, "
+        "no matmul recompute (the ZeRO-3 sweet spot when activations fit)",
     )
     p.add_argument(
         "--compress",
@@ -1166,6 +1171,11 @@ def _cmd_train_moe(argv: list[str]) -> int:
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument(
+        "--dispatch", choices=("auto", "einsum", "scatter"), default="auto",
+        help="token->expert data movement: one-hot einsums or "
+        "scatter/gather (auto: scatter past ~4M one-hot elements)",
+    )
+    p.add_argument(
         "--device-data",
         action="store_true",
         help="sample batches ON DEVICE inside one jitted chain (no host "
@@ -1206,6 +1216,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         learning_rate=args.lr,
         compress=args.compress,
         overlap=args.overlap,
+        dispatch_impl=args.dispatch,
     )
     print(
         f"MoE params: {trainer.param_count / 1e6:.2f}M "

@@ -255,6 +255,7 @@ def run_moe(args) -> dict:
         router_topk=args.topk,
         learning_rate=1e-3,
         compute_dtype=jnp.bfloat16,
+        dispatch_impl=args.dispatch,
     )
     rows = max(1, args.batch // trainer.n_devices)
     batch = rows * trainer.n_devices
@@ -282,6 +283,7 @@ def run_moe(args) -> dict:
         extra={
             "params_m": round(trainer.param_count / 1e6, 1),
             "active_params_m": round(active / 1e6, 1),
+            "dispatch": args.dispatch,
             "experts": args.experts,
             "topk": args.topk,
             "d_model": args.d_model,
@@ -380,13 +382,26 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dp", type=int, default=None)
     p.add_argument("--sp", type=int, default=None)
-    p.add_argument("--remat", action="store_true")
+    p.add_argument(
+        "--remat",
+        nargs="?",
+        const="full",
+        default=False,
+        choices=("full", "params"),
+        help="'full' = recompute layers on backward; 'params' (FSDP only) "
+        "= re-gather params on backward, keep activations",
+    )
     p.add_argument("--hidden", type=int, nargs="+", default=[2048, 2048])
     p.add_argument("--image-size", type=int, default=64)
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--experts", type=int, default=8)
     p.add_argument("--topk", type=int, choices=(1, 2), default=1)
+    p.add_argument(
+        "--dispatch", choices=("auto", "einsum", "scatter"), default="auto"
+    )
     args = p.parse_args(argv)
+    if args.remat == "params" and args.workload != "fsdp":
+        p.error("--remat params is FSDP's regather mode; use --remat full")
     rec = WORKLOADS[args.workload](args)
     print(json.dumps(rec))
     return 0

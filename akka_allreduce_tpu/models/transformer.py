@@ -224,6 +224,7 @@ class MoEBlock(nn.Module):
     router_topk: int = 1  # 1 = Switch, 2 = GShard top-2
     seq_axis: str | None = None  # sequence-parallel axis (ring/Ulysses attn)
     seq_impl: str = "ring"
+    dispatch_impl: str = "auto"  # "einsum" | "scatter" | "auto" (ops.moe)
 
     @nn.compact
     def __call__(self, x):
@@ -266,6 +267,7 @@ class MoEBlock(nn.Module):
             expert_axis=self.expert_axis if self.ep_size > 1 else None,
             router_topk=self.router_topk,
             seq_axis=self.seq_axis,
+            dispatch_impl=self.dispatch_impl,
         )
         return x + y.reshape(x.shape), aux, dropped
 
@@ -287,6 +289,7 @@ class MoETransformerLM(nn.Module):
     router_topk: int = 1  # 1 = Switch, 2 = GShard top-2
     seq_axis: str | None = None  # sequence-parallel axis (ring/Ulysses attn)
     seq_impl: str = "ring"
+    dispatch_impl: str = "auto"  # "einsum" | "scatter" | "auto" (ops.moe)
 
     @nn.compact
     def __call__(self, tokens):
@@ -305,6 +308,7 @@ class MoETransformerLM(nn.Module):
                 router_topk=self.router_topk,
                 seq_axis=self.seq_axis,
                 seq_impl=self.seq_impl,
+                dispatch_impl=self.dispatch_impl,
             )(x)
             aux_total = aux_total + aux
             dropped_total = dropped_total + dropped

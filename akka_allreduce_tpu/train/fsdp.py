@@ -119,7 +119,17 @@ class FSDPLMTrainer:
       n_layers: trunk depth (the FSDP-sharded bulk).
       seq_impl: attention schedule over the seq axis ("ring" | "ulysses"),
         used when the mesh has one.
-      remat: recompute each layer on backward (jax.checkpoint).
+      remat: ``True`` (or ``"full"``) recomputes each layer on backward
+        (jax.checkpoint — one layer's activations at a time, maximum memory
+        savings, ~1 extra forward of FLOPs). ``"params"`` drops the
+        gathered full-layer params from the residuals and re-gathers them
+        on backward (``dots_saveable`` policy: matmul outputs — the
+        layer's real activations — stay saved; the gather chain and cheap
+        elementwise ops recompute). This is the ZeRO-3 sweet spot when
+        activations fit: without it the scan saves every iteration's
+        gathered layer (L full layers resident — the no-remat OOM), with
+        full remat the step pays ~25-30 % MFU for matmul recompute the
+        model didn't need.
     """
 
     def __init__(
@@ -136,10 +146,16 @@ class FSDPLMTrainer:
         learning_rate: float = 1e-2,
         seed: int = 0,
         compute_dtype=jnp.float32,
-        remat: bool = False,
+        remat: bool | str = False,
         compress: str | None = None,
         prefetch: bool = False,
     ) -> None:
+        if remat is True:
+            remat = "full"
+        if remat not in (False, "full", "params"):
+            raise ValueError(
+                f"remat must be False, True/'full', or 'params', got {remat!r}"
+            )
         axes = tuple(mesh.axis_names)
         # accepted meshes (by axis NAME — "model" selects Megatron TP, in
         # ANY order after the leading data axis, so the repo's canonical
@@ -354,7 +370,8 @@ class FSDPLMTrainer:
                     full = lax.all_gather(flat, g_axes, tiled=True)
                     if compress == "bf16":
                         full = full.astype(s.dtype)
-                    return _unshard_leaf(full[None], (1,) + shape[1:])[0]
+                    size = int(np.prod(shape[1:]))
+                    return full[:size].reshape(shape[1:])
 
                 if prefetch:
                     # Software-pipelined parameter prefetch (the FSDP form
@@ -402,7 +419,30 @@ class FSDPLMTrainer:
                         )
                         return block_apply({"params": layer_p}, carry), None
 
-                    body_fn = jax.checkpoint(body) if remat else body
+                    if remat == "full":
+                        body_fn = jax.checkpoint(body)
+                    elif remat == "params":
+                        # drop the gathered full layers from the residuals
+                        # and re-gather them on backward. Mechanism: an
+                        # ALLOWLIST policy (dots_saveable) — matmul outputs
+                        # (the layer's real activations) are saved, while
+                        # the gather chain (all_gather + reshapes, not
+                        # dots) is recomputed, i.e. the collective runs
+                        # twice. A blocklist policy
+                        # (save_anything_except_these_names) cannot express
+                        # this: the un-named twin the producing eqn emits
+                        # is itself saveable, so partial-eval just saves
+                        # that same-size copy and the regather buys
+                        # nothing (measured: temp bytes identical to
+                        # no-remat). Cheap elementwise chains (gelu,
+                        # layernorm) recompute alongside — that is
+                        # dots_saveable's standard trade.
+                        body_fn = jax.checkpoint(
+                            body,
+                            policy=jax.checkpoint_policies.dots_saveable,
+                        )
+                    else:
+                        body_fn = body
                     h, _ = lax.scan(body_fn, h, p["trunk"])
                 logits = head_apply({"params": p["head"]}, h)
                 ce = optax.softmax_cross_entropy_with_integer_labels(

@@ -76,6 +76,7 @@ class MoETrainer:
         compute_dtype=jnp.float32,
         compress: str | None = None,
         overlap: bool = False,
+        dispatch_impl: str = "auto",
     ) -> None:
         from akka_allreduce_tpu.models.transformer import (
             MoETransformerLM,
@@ -132,6 +133,7 @@ class MoETrainer:
             router_topk=router_topk,
             seq_axis=self.seq_axis if self.sp > 1 else None,
             seq_impl=seq_impl,
+            dispatch_impl=dispatch_impl,
         )
         self.tx = optimizer or optax.adam(learning_rate)
 
