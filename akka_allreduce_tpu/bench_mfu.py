@@ -53,6 +53,19 @@ def _chain_mfu_record(
     timed(lo)
     timed(hi)  # compile BOTH lengths before any timing pair
     compile_s = time.perf_counter() - t0
+    # fast steps need a longer chain: rescale hi so the DIFFERENTIAL
+    # (hi - lo) on-device signal reaches ~3 s and tunnel RTT jitter
+    # (~0.1 s) stays in the noise — the same discipline as
+    # median_slope's target_signal_s, but done here because train_chain's
+    # step count is a STATIC scan length (a new hi pays one more
+    # compile, folded into compile_s; median_slope's built-in rescale
+    # assumes a traced trip count)
+    rough = (timed(hi) - timed(lo)) / (hi - lo)
+    if rough > 0 and rough * (hi - lo) < 2.0:
+        hi = lo + min(int(round(3.0 / rough)), 100_000)
+        t1 = time.perf_counter()
+        timed(hi)  # compile the rescaled length
+        compile_s += time.perf_counter() - t1
     est = median_slope(timed, lo, hi, outer=outer, warmup=False)
     sec = est.seconds_per_iter
     u = mfu(flops_per_step, sec, device_peak_flops(), n_devices=n_devices)
