@@ -484,6 +484,10 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument(
+        "--kv-heads", type=int, default=None,
+        help="grouped-query attention: K/V heads (divides --heads; 1 = MQA)",
+    )
     p.add_argument("--layers", type=int, default=2)
     p.add_argument(
         "--remat",
@@ -555,6 +559,7 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=args.heads,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers,
         seq_len=args.seq_len,
         seq_impl=args.impl,
@@ -695,6 +700,12 @@ def _cmd_train_lm(argv: list[str]) -> int:
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument(
+        "--kv-heads", type=int, default=None,
+        help="grouped-query attention: K/V heads (divides --heads; 1 = "
+        "MQA). Under ring/Ulysses SP the compact K/V form crosses the "
+        "wire, shrinking per-step ICI bytes by heads/kv_heads",
+    )
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
     p.add_argument(
@@ -737,6 +748,7 @@ def _cmd_train_lm(argv: list[str]) -> int:
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=args.heads,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers,
         seq_len=args.seq_len,
         seq_impl=args.impl,
@@ -1169,6 +1181,10 @@ def _cmd_train_moe(argv: list[str]) -> int:
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument(
+        "--kv-heads", type=int, default=None,
+        help="grouped-query attention: K/V heads (divides --heads; 1 = MQA)",
+    )
     p.add_argument("--layers", type=int, default=2)
     p.add_argument(
         "--dispatch", choices=("auto", "einsum", "scatter"), default="auto",
@@ -1207,6 +1223,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=args.heads,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers,
         n_experts=args.experts,
         seq_len=args.seq_len,

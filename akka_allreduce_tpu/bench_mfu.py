@@ -59,10 +59,24 @@ def _chain_mfu_record(
     # median_slope's target_signal_s, but done here because train_chain's
     # step count is a STATIC scan length (a new hi pays one more
     # compile, folded into compile_s; median_slope's built-in rescale
-    # assumes a traced trip count)
-    rough = (timed(hi) - timed(lo)) / (hi - lo)
-    if rough > 0 and rough * (hi - lo) < 2.0:
-        hi = lo + min(int(round(3.0 / rough)), 100_000)
+    # assumes a traced trip count). The probe itself lives in the jittery
+    # regime it is sizing against, so take a median of 3 pairs; a
+    # non-positive median means the signal is still drowned — escalate
+    # by a bounded factor rather than silently keeping the bad hi
+    # (median_slope's own escalation rule).
+    import statistics
+
+    rough = statistics.median(
+        (timed(hi) - timed(lo)) / (hi - lo) for _ in range(3)
+    )
+    if rough <= 0:
+        new_hi = lo + (hi - lo) * 16
+    elif rough * (hi - lo) < 2.0:
+        new_hi = lo + min(int(round(3.0 / rough)), 100_000)
+    else:
+        new_hi = hi
+    if new_hi != hi:
+        hi = new_hi
         t1 = time.perf_counter()
         timed(hi)  # compile the rescaled length
         compile_s += time.perf_counter() - t1
@@ -104,10 +118,11 @@ def run_lm(args) -> dict:
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=heads,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers,
         seq_len=args.seq_len,
         compute_dtype=jnp.bfloat16,
-        remat=args.remat,
+        remat=bool(args.remat),
         learning_rate=1e-3,
     )
     rows = max(1, args.batch // trainer.dp)
@@ -331,6 +346,7 @@ def run_fsdp(args) -> dict:
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=heads,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers,
         seq_len=args.seq_len,
         compute_dtype=jnp.bfloat16,
@@ -392,6 +408,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--heads", type=int, default=None, help="default d/128")
+    p.add_argument(
+        "--kv-heads", type=int, default=None,
+        help="grouped-query attention K/V heads (lm/fsdp workloads)",
+    )
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dp", type=int, default=None)
     p.add_argument("--sp", type=int, default=None)

@@ -61,9 +61,15 @@ class Attention(nn.Module):
     sharded dims), the out-projection produces a partial sum, and ONE psum
     over ``model_axis`` completes it — Megatron-style column/row split, with
     the output bias added AFTER the psum so it is applied exactly once.
+
+    Grouped-query attention (``n_kv_heads`` < ``n_heads``; 1 = MQA): K/V
+    project to ``n_kv_heads`` heads and stay COMPACT until the compute
+    site — under ring attention the ppermute wire bytes shrink by
+    H/H_kv, under Ulysses the K/V all_to_all does (ops/ring_attention.py).
     """
 
     n_heads: int
+    n_kv_heads: int | None = None  # None = n_heads (standard MHA)
     seq_axis: str | None = None
     seq_impl: str = "ring"  # "ring" | "ulysses"
     compute_dtype: jnp.dtype = jnp.float32
@@ -77,14 +83,30 @@ class Attention(nn.Module):
             raise ValueError(f"{d_model=} not divisible by {self.n_heads=}")
         if self.n_heads % self.tp_size:
             raise ValueError(f"{self.n_heads=} not divisible by {self.tp_size=}")
+        kv_heads = (
+            self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        )
+        if kv_heads < 1:
+            raise ValueError(f"n_kv_heads must be >= 1, got {kv_heads}")
+        if self.n_heads % kv_heads:
+            raise ValueError(
+                f"{self.n_heads=} not divisible by n_kv_heads={kv_heads}"
+            )
+        if kv_heads % self.tp_size:
+            raise ValueError(
+                f"n_kv_heads={kv_heads} not divisible by {self.tp_size=}"
+            )
         head = d_model // self.n_heads
         heads_local = self.n_heads // self.tp_size
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (heads_local, head),
+        kv_local = kv_heads // self.tp_size
+        dense = lambda name, hh: nn.DenseGeneral(  # noqa: E731
+            (hh, head),
             dtype=self.compute_dtype,
             name=name,
         )
-        q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
+        q = dense("q", heads_local)(x)
+        k = dense("k", kv_local)(x)
+        v = dense("v", kv_local)(x)
 
         if self.seq_axis is None:
             offset = 0
@@ -119,6 +141,7 @@ class Attention(nn.Module):
 
 class Block(nn.Module):
     n_heads: int
+    n_kv_heads: int | None = None
     mlp_ratio: int = 4
     seq_axis: str | None = None
     seq_impl: str = "ring"
@@ -137,6 +160,7 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
         x = x + Attention(
             self.n_heads,
+            n_kv_heads=self.n_kv_heads,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
             compute_dtype=self.compute_dtype,
@@ -166,6 +190,7 @@ class TransformerLM(nn.Module):
     vocab: int = 256
     d_model: int = 128
     n_heads: int = 4
+    n_kv_heads: int | None = None  # GQA: fewer K/V heads (1 = MQA)
     n_layers: int = 2
     mlp_ratio: int = 4
     seq_axis: str | None = None
@@ -188,6 +213,7 @@ class TransformerLM(nn.Module):
             # (and init-twin) layout — remat must change memory, not params
             x = block_cls(
                 self.n_heads,
+                n_kv_heads=self.n_kv_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis,
                 seq_impl=self.seq_impl,
@@ -215,6 +241,7 @@ class MoEBlock(nn.Module):
     """
 
     n_heads: int
+    n_kv_heads: int | None = None
     n_experts: int = 4
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
@@ -240,6 +267,7 @@ class MoEBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
         x = x + Attention(
             self.n_heads,
+            n_kv_heads=self.n_kv_heads,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
             compute_dtype=self.compute_dtype,
@@ -279,6 +307,7 @@ class MoETransformerLM(nn.Module):
     vocab: int = 256
     d_model: int = 128
     n_heads: int = 4
+    n_kv_heads: int | None = None
     n_layers: int = 2
     n_experts: int = 4
     mlp_ratio: int = 4
@@ -299,6 +328,7 @@ class MoETransformerLM(nn.Module):
         for _ in range(self.n_layers):
             x, aux, dropped = MoEBlock(
                 self.n_heads,
+                n_kv_heads=self.n_kv_heads,
                 n_experts=self.n_experts,
                 mlp_ratio=self.mlp_ratio,
                 capacity_factor=self.capacity_factor,

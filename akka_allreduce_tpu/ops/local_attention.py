@@ -62,6 +62,10 @@ def blockwise_attention(
     the local windows globally for causal masking (as in ring attention).
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if k.shape[2] != q.shape[2]:  # grouped-query K/V expand at compute
+        from akka_allreduce_tpu.ops.ring_attention import repeat_kv
+
+        k, v = repeat_kv(k, q.shape[2]), repeat_kv(v, q.shape[2])
     b, tq, h, d = q.shape
     tk = k.shape[1]
     nb = -(-tk // block_k)
@@ -161,8 +165,15 @@ def local_attention(
     Dispatch: dense for short sequences (fastest, fits on chip), the Pallas
     TPU flash kernel when on TPU with kernel-friendly shapes, else the
     portable blockwise path. All three agree with the dense oracle.
+
+    Grouped-query K/V (fewer heads than ``q``) expand here — the compute
+    site; sequence-parallel wires upstream keep the compact form.
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if k.shape[2] != q.shape[2]:
+        from akka_allreduce_tpu.ops.ring_attention import repeat_kv
+
+        k, v = repeat_kv(k, q.shape[2]), repeat_kv(v, q.shape[2])
     if q.shape[1] <= _DENSE_MAX_T and k.shape[1] <= _DENSE_MAX_T:
         return attention_reference(
             q, k, v, causal=causal, sm_scale=scale,

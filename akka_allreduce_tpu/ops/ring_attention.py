@@ -40,6 +40,20 @@ from jax import lax
 _MASK_VALUE = -1e30
 
 
+def repeat_kv(k: jax.Array, n_heads: int) -> jax.Array:
+    """Expand grouped-query K/V (B, T, H_kv, D) to ``n_heads`` by repeating
+    each KV head over its query group (GQA; H_kv == 1 is MQA). The repeat
+    happens at the COMPUTE site only — sequence-parallel schedules move the
+    compact (B, T, H_kv, D) form over the wire, which is where GQA's
+    bandwidth saving lives."""
+    h_kv = k.shape[2]
+    if h_kv == n_heads:
+        return k
+    if n_heads % h_kv:
+        raise ValueError(f"{n_heads=} not divisible by kv heads {h_kv}")
+    return jnp.repeat(k, n_heads // h_kv, axis=2)
+
+
 def online_softmax_update(olm, qf, kk, vv, scale, mask):
     """One flash-style block fold: merge K/V block (kk, vv) into the running
     ``(o, l, m)`` statistics for queries ``qf`` (all fp32).
@@ -121,6 +135,11 @@ def ring_attention(
     softmax makes the result order-independent and numerically stable in fp32.
     The last block is consumed outside the loop so no final (discarded)
     rotation crosses the ICI.
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (H_kv dividing H) — the COMPACT form rotates around the ring, so the
+    per-step ICI bytes shrink by H/H_kv, and each block expands KV locally
+    just before its score matmul (:func:`repeat_kv`).
     """
     n = lax.axis_size(axis_name)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -142,6 +161,7 @@ def ring_attention(
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = None
+        kk, vv = repeat_kv(kk, h), repeat_kv(vv, h)
         return online_softmax_update(olm, qf, kk, vv, scale, mask)
 
     def step(s, carry):
@@ -175,18 +195,27 @@ def ulysses_attention(
     """All-to-all sequence parallelism; call inside ``shard_map``.
 
     Re-shards (B, T/n, H, D) -> (B, T, H/n, D) with one ``all_to_all``, runs
-    full-sequence dense attention on the local head group, and re-shards back.
+    full-sequence attention on the local head group, and re-shards back.
     Requires ``H % lax.axis_size(axis_name) == 0``.
+
+    Grouped-query attention: when the KV head count also divides the axis
+    size, K/V cross the all_to_all in COMPACT form (wire bytes shrink by
+    H/H_kv) and the local core expands them; otherwise they are expanded
+    before the exchange (correct, no bandwidth saving — noted so callers
+    pick H_kv >= the seq-axis size when they want the win).
     """
     from akka_allreduce_tpu.ops.local_attention import local_attention
 
     n = lax.axis_size(axis_name)
+    h = q.shape[2]
     if n == 1:
         return local_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    if q.shape[2] % n:
+    if h % n:
         raise ValueError(
-            f"ulysses needs heads ({q.shape[2]}) divisible by axis size {n}"
+            f"ulysses needs heads ({h}) divisible by axis size {n}"
         )
+    if k.shape[2] % n:
+        k, v = repeat_kv(k, h), repeat_kv(v, h)
 
     def seq_to_heads(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)

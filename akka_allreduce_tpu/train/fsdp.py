@@ -139,6 +139,7 @@ class FSDPLMTrainer:
         vocab: int = 64,
         d_model: int = 64,
         n_heads: int = 4,
+        n_kv_heads: int | None = None,
         n_layers: int = 2,
         seq_len: int = 64,
         seq_impl: str = "ring",
@@ -213,6 +214,7 @@ class FSDPLMTrainer:
 
         block = Block(
             n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
             compute_dtype=compute_dtype,
             seq_axis=self.seq_axis if self.sp > 1 else None,
             seq_impl=seq_impl,
@@ -223,7 +225,10 @@ class FSDPLMTrainer:
         head = _LMHead(vocab, compute_dtype=compute_dtype)
         rng = jax.random.PRNGKey(seed)
         # init with the DENSE twin (param shapes are T- and axis-independent)
-        init_block = Block(n_heads=n_heads, compute_dtype=compute_dtype)
+        init_block = Block(
+            n_heads=n_heads, n_kv_heads=n_kv_heads,
+            compute_dtype=compute_dtype,
+        )
         x0 = jnp.zeros((1, seq_len // self.sp, d_model), jnp.float32)
         tok0 = jnp.zeros((1, seq_len // self.sp), jnp.int32)
         layer_ps = [

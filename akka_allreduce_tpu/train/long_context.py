@@ -70,6 +70,7 @@ class LongContextTrainer:
         vocab: int = 64,
         d_model: int = 64,
         n_heads: int = 4,
+        n_kv_heads: int | None = None,
         n_layers: int = 2,
         seq_len: int = 128,
         seq_impl: str = "ring",
@@ -114,6 +115,7 @@ class LongContextTrainer:
             vocab=vocab,
             d_model=d_model,
             n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
             n_layers=n_layers,
             seq_axis=self.seq_axis,
             seq_impl=seq_impl,
@@ -131,6 +133,7 @@ class LongContextTrainer:
             vocab=vocab,
             d_model=d_model,
             n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
             n_layers=n_layers,
             compute_dtype=compute_dtype,
         )

@@ -63,6 +63,7 @@ class MoETrainer:
         vocab: int = 64,
         d_model: int = 64,
         n_heads: int = 4,
+        n_kv_heads: int | None = None,
         n_layers: int = 2,
         n_experts: int = 4,
         seq_len: int = 64,
@@ -124,6 +125,7 @@ class MoETrainer:
             vocab=vocab,
             d_model=d_model,
             n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
             n_layers=n_layers,
             n_experts=n_experts,
             capacity_factor=capacity_factor,
@@ -142,6 +144,7 @@ class MoETrainer:
             vocab=vocab,
             d_model=d_model,
             n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
             n_layers=n_layers,
             n_experts=n_experts,
             capacity_factor=capacity_factor,

@@ -98,6 +98,7 @@ class PipelineLMTrainer:
         vocab: int = 64,
         d_model: int = 64,
         n_heads: int = 4,
+        n_kv_heads: int | None = None,
         layers_per_stage: int = 1,
         microbatches: int = 2,
         seq_len: int = 64,
@@ -141,7 +142,10 @@ class PipelineLMTrainer:
         self.n_layers = layers_per_stage * self.stages
         self.tx = optimizer or optax.adam(learning_rate)
 
-        block = Block(n_heads=n_heads, compute_dtype=compute_dtype)
+        block = Block(
+            n_heads=n_heads, n_kv_heads=n_kv_heads,
+            compute_dtype=compute_dtype,
+        )
         embed = nn.Embed(vocab, d_model, dtype=compute_dtype)
         head = _LMHead(vocab, compute_dtype=compute_dtype)
         rng = jax.random.PRNGKey(seed)

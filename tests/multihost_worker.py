@@ -211,6 +211,28 @@ def main() -> None:
     assert pm.contributors == pp.dp - 1 and np.isfinite(pm.loss), pm
     print(f"MULTIHOST_MOE_PP_OK {process_id}", flush=True)
 
+    # ---- FSDP across processes --------------------------------------------
+    # the last trainer x multiprocess cell: trunk params shard 1/n over the
+    # GLOBAL line mesh, so every in-scan all_gather (and its reduce-scatter
+    # transpose in the backward) is a genuinely cross-process collective —
+    # one masked step through the pod seam, regather remat on
+    from akka_allreduce_tpu.train import FSDPLMTrainer
+
+    fsdp = FSDPLMTrainer(
+        mesh, vocab=16, d_model=32, n_heads=4, n_layers=2, seq_len=32,
+        optimizer=optax.sgd(1e-2), seed=6, remat="params",
+    )
+    fmask = np.ones((n,), np.float32)
+    fmask[-1] = 0.0
+    ftok = lrng.integers(0, 16, size=(n, 32)).astype(np.int32)
+    flab = lrng.integers(0, 16, size=(n, 32)).astype(np.int32)
+    lo_f, hi_f = process_id * (n // num_processes), (process_id + 1) * (
+        n // num_processes
+    )
+    fm = fsdp.train_step(ftok[lo_f:hi_f], flab[lo_f:hi_f], fmask)
+    assert fm.contributors == n - 1 and np.isfinite(fm.loss), fm
+    print(f"MULTIHOST_FSDP_OK {process_id}", flush=True)
+
     print(f"MULTIHOST_OK {process_id}", flush=True)
 
 

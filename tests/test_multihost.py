@@ -119,3 +119,5 @@ def test_multiprocess_jax_distributed_cpu():
         assert f"MULTIHOST_LM_OK {i}" in out, f"worker {i} output:\n{out}"
         # and the MoE / pipeline trainers through the same seam
         assert f"MULTIHOST_MOE_PP_OK {i}" in out, f"worker {i} output:\n{out}"
+        # and FSDP: per-layer param gathers crossing OS processes
+        assert f"MULTIHOST_FSDP_OK {i}" in out, f"worker {i} output:\n{out}"
