@@ -1413,6 +1413,130 @@ def _cmd_train_pp(argv: list[str]) -> int:
     return 0
 
 
+def _cmd_lm_generate(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "lm-generate",
+        description="KV-cache autoregressive decoding (models/generate.py): "
+        "optionally train on the copy task, then generate and report "
+        "decode tokens/s (slope between two generation lengths)",
+    )
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument(
+        "--kv-heads", type=int, default=None,
+        help="GQA: shrink the KV cache (B, L, H_kv, D) by heads/kv_heads",
+    )
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument(
+        "--gen", type=int, default=64,
+        help="tokens to generate (>= 2: the slope timing needs two lengths)",
+    )
+    p.add_argument(
+        "--train-steps", type=int, default=0,
+        help="on-device copy-task training steps before decoding "
+        "(0 = random params; >0 shows real text completion)",
+    )
+    p.add_argument("--seq-len", type=int, default=64, help="training seq len")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--bf16", action="store_true")
+    args = p.parse_args(argv)
+    if args.gen < 2:
+        p.error("--gen must be >= 2 (the slope timing needs two lengths)")
+
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.models import LMGenerator, TransformerLM
+    from akka_allreduce_tpu.models.data import SyntheticCopyLM
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = TransformerLM(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.heads,
+        n_kv_heads=args.kv_heads, n_layers=args.layers, compute_dtype=dtype,
+    )
+    ds = SyntheticCopyLM(args.seq_len, vocab=args.vocab)
+    if args.train_steps > 0:
+        import optax
+
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+        from akka_allreduce_tpu.train import LongContextTrainer
+
+        trainer = LongContextTrainer(
+            data_seq_mesh(1, 1), vocab=args.vocab, d_model=args.d_model,
+            n_heads=args.heads, n_kv_heads=args.kv_heads,
+            n_layers=args.layers, seq_len=args.seq_len,
+            compute_dtype=dtype, optimizer=optax.adam(3e-3),
+        )
+        hist = trainer.train_chain(
+            ds.device_sampler(), args.train_steps, args.batch
+        )
+        print(
+            f"trained {args.train_steps} steps: loss "
+            f"{hist[0].loss:.3f} -> {hist[-1].loss:.3f}"
+        )
+        params = jax.device_get(trainer.params)
+    else:
+        params = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, args.prompt_len), jnp.int32),
+        )
+
+    gen = LMGenerator(model, max_len=args.prompt_len + args.gen)
+    x, _ = next(ds.batches(args.batch, 1, seed_offset=123))
+    prompt = jnp.asarray(x[:, : args.prompt_len])
+
+    # decode throughput: slope between a short and the full generation so
+    # prefill + dispatch overhead cancels (bench.py's discipline)
+    import statistics
+
+    lo = max(1, args.gen // 4)
+    gen.generate(params, prompt, lo, temperature=args.temperature)
+    out = gen.generate(
+        params, prompt, args.gen, temperature=args.temperature
+    )  # compile both
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        o = gen.generate(
+            params, prompt, steps, temperature=args.temperature,
+            seed=int(t0 * 1e6) % (1 << 30),  # vary input (axon trap)
+        )
+        jax.device_get(o[:1, -1])  # real fence (block_until_ready is not)
+        return time.perf_counter() - t0
+
+    slopes = [
+        (timed(args.gen) - timed(lo)) / (args.gen - lo) for _ in range(5)
+    ]
+    ms_per_tok = statistics.median(slopes) * 1e3
+    out_np = np.asarray(out)
+    print(f"prompt : {np.asarray(prompt)[0].tolist()}")
+    print(f"decoded: {out_np[0].tolist()}")
+    half = args.seq_len // 2
+    if args.train_steps > 0 and args.prompt_len == half + 1:
+        # prompt ends at position half, so greedy decode should emit the
+        # copy x[1:half] (the copy task repeats [0, half) at [half, 2half))
+        want = x[0, 1:half][: out_np.shape[1]]
+        got = out_np[0][: len(want)]
+        acc = float((got == want).mean()) if len(want) else 0.0
+        print(f"copy accuracy vs source: {acc:.1%}")
+    if ms_per_tok > 1e-3:
+        rate = f"{args.batch * 1e3 / ms_per_tok:.0f} tokens/s"
+    else:
+        rate = "n/a (noise-dominated at this size)"
+    print(
+        f"decode: {ms_per_tok:.2f} ms/token, {rate} "
+        f"(batch {args.batch}, cache (B,{gen.max_len},"
+        f"{args.kv_heads or args.heads},{args.d_model // args.heads}))"
+    )
+    return 0
+
+
 COMMANDS = {
     "local-demo": _cmd_local_demo,
     "cluster-master": _cmd_cluster_master,
@@ -1429,6 +1553,7 @@ COMMANDS = {
     "train-lm": _cmd_train_lm,
     "train-moe": _cmd_train_moe,
     "train-pp": _cmd_train_pp,
+    "lm-generate": _cmd_lm_generate,
     "elastic-demo": _cmd_elastic_demo,
 }
 

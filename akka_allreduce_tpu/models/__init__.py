@@ -9,4 +9,5 @@ normalization that is pure-functional under SPMD.
 from akka_allreduce_tpu.models.mlp import MLP  # noqa: F401
 from akka_allreduce_tpu.models.resnet import ResNet50, ResNet  # noqa: F401
 from akka_allreduce_tpu.models.transformer import TransformerLM  # noqa: F401
+from akka_allreduce_tpu.models.generate import LMGenerator  # noqa: F401
 from akka_allreduce_tpu.models import data  # noqa: F401

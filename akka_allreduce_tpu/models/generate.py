@@ -1,0 +1,156 @@
+"""Autoregressive decoding for the Transformer LM family (KV cache).
+
+The reference is a training-side framework (gradient/weight sync —
+SURVEY.md §1); inference is a beyond-parity surface that completes the LM
+story the TPU way:
+
+- the whole generation loop is ONE jitted program: prefill consumes the
+  prompt in a single forward (filling every layer's KV cache), then a
+  ``lax.scan`` emits one token per step — no per-token Python dispatch;
+- the cache is shaped (B, max_len, H_kv, D) per layer, so grouped-query
+  attention (``n_kv_heads``) shrinks the decode working set — the
+  memory-bandwidth term that dominates small-batch decoding — by H/H_kv;
+- sampling is greedy (``temperature=0``) or temperature-scaled
+  categorical, with the key threaded through the scan carry.
+
+Numerical oracle (tests/test_generate.py): teacher-forcing the decode path
+over a fixed sequence must reproduce the training forward's logits at
+every position — the cache is exact, not approximate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from akka_allreduce_tpu.models.transformer import TransformerLM
+
+
+@dataclasses.dataclass
+class LMGenerator:
+    """KV-cache decoder for a :class:`TransformerLM`'s trained params.
+
+    Args:
+      model: the TRAINING-configured module (its decode twin is derived;
+        seq/tensor sharding must be off — decode is single-device).
+      max_len: cache capacity = prompt length + generated tokens budget.
+    """
+
+    model: TransformerLM
+    max_len: int
+
+    def __post_init__(self) -> None:
+        if self.model.seq_axis is not None or self.model.tp_size > 1:
+            raise ValueError(
+                "decoding runs single-device: build the generator from an "
+                "unsharded model config (seq_axis=None, tp_size=1)"
+            )
+        self.decoder = dataclasses.replace(
+            self.model, decode=True, max_decode_len=self.max_len, remat=False
+        )
+        self._fns: dict = {}  # compiled generate loops, keyed by shape
+        self._cache_tmpl: dict = {}  # zero-cache template per batch size
+
+    def init_cache(self, batch: int) -> dict:
+        """Fresh zero cache for ``batch`` rows.
+
+        ``init`` RUNS the module, so the cache it returns is dirty — index
+        already advanced past the stub token, slot 0 filled from the
+        throwaway init params; zero the whole tree (index included) to get
+        the true empty-cache state. The traced init runs once per batch
+        size (template cached); callers get fresh zeros each time."""
+        if batch not in self._cache_tmpl:
+            variables = self.decoder.init(
+                jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
+            )
+            self._cache_tmpl[batch] = variables["cache"]
+        return jax.tree.map(jnp.zeros_like, self._cache_tmpl[batch])
+
+    def _apply(self, params, cache, tokens):
+        logits, updated = self.decoder.apply(
+            {"params": params["params"], "cache": cache},
+            tokens,
+            mutable=["cache"],
+        )
+        return logits, updated["cache"]
+
+    def generate(
+        self,
+        params,
+        prompt,
+        steps: int,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ):
+        """Generate ``steps`` tokens after ``prompt`` (B, T_prompt) int32.
+
+        Returns (B, steps) int32. One jit per (prompt length, steps) pair;
+        the scan body is compiled once regardless of ``steps``.
+        """
+        if prompt.ndim != 2:
+            raise ValueError(f"prompt must be (B, T), got {prompt.shape}")
+        if steps < 1:
+            raise ValueError(f"need steps >= 1, got {steps}")
+        if prompt.shape[1] + steps > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.shape[1]} + steps {steps} exceeds "
+                f"max_len {self.max_len}"
+            )
+        cache = self.init_cache(prompt.shape[0])
+        key = (tuple(prompt.shape), steps, float(temperature))
+        if key not in self._fns:
+            self._fns[key] = self._compiled(steps, float(temperature))
+        fn = self._fns[key]
+        return fn(params, cache, jnp.asarray(prompt), jax.random.PRNGKey(seed))
+
+    def _compiled(self, steps: int, temperature: float):
+        apply = self._apply
+
+        def sample(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        def run(params, cache, prompt, key):
+            # prefill: the whole prompt in one forward fills the cache
+            logits, cache = apply(params, cache, prompt)
+            k0, key = jax.random.split(key)
+            tok = sample(logits[:, -1], k0)
+
+            def step(carry, _):
+                cache, tok, key = carry
+                logits, cache = apply(params, cache, tok[:, None])
+                k, key = jax.random.split(key)
+                nxt = sample(logits[:, -1], k)
+                return (cache, nxt, key), tok
+
+            (_, last, _), out = jax.lax.scan(
+                step, (cache, tok, key), None, length=steps - 1
+            )
+            # out is (steps-1, B): tokens emitted BEFORE each scan step
+            return jnp.concatenate(
+                [jnp.swapaxes(out, 0, 1), last[:, None]], axis=1
+            )
+
+        return jax.jit(run)
+
+    def decode_logits(self, params, tokens, *, chunk: int = 1):
+        """Teacher-forced logits via the cache path: feed ``tokens``
+        (B, T) in ``chunk``-sized pieces and return (B, T, vocab) — the
+        oracle hook: must equal the training forward's logits."""
+        b, t = tokens.shape
+        if t % chunk:
+            raise ValueError(f"{t=} not divisible by {chunk=}")
+        cache = self.init_cache(b)
+        outs = []
+        for i in range(0, t, chunk):
+            logits, cache = self._apply(
+                params, cache, jnp.asarray(tokens[:, i : i + chunk])
+            )
+            outs.append(logits)
+        return jnp.concatenate(outs, axis=1)

@@ -66,6 +66,14 @@ class Attention(nn.Module):
     project to ``n_kv_heads`` heads and stay COMPACT until the compute
     site — under ring attention the ppermute wire bytes shrink by
     H/H_kv, under Ulysses the K/V all_to_all does (ops/ring_attention.py).
+
+    Autoregressive decoding (``decode=True``): a "cache" variable
+    collection holds the K/V written so far — shaped
+    (B, max_decode_len, H_kv, D), so GQA shrinks the cache (its main
+    inference win) — and each call appends its chunk at the running
+    ``cache_index`` and attends over the whole cache causally. Init the
+    cache with ``model.init`` on any-length tokens; apply with
+    ``mutable=["cache"]``. Single device only (no seq/tensor sharding).
     """
 
     n_heads: int
@@ -75,6 +83,8 @@ class Attention(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     model_axis: str | None = None
     tp_size: int = 1
+    decode: bool = False  # KV-cache autoregressive mode
+    max_decode_len: int = 0  # cache capacity (decode=True only)
 
     @nn.compact
     def __call__(self, x):
@@ -96,6 +106,13 @@ class Attention(nn.Module):
             raise ValueError(
                 f"n_kv_heads={kv_heads} not divisible by {self.tp_size=}"
             )
+        if self.decode and (self.seq_axis is not None or self.tp_size > 1):
+            raise ValueError(
+                "decode=True is the single-device KV-cache path; it does "
+                "not compose with seq/tensor sharding"
+            )
+        if self.decode and self.max_decode_len < 1:
+            raise ValueError("decode=True needs max_decode_len >= 1")
         head = d_model // self.n_heads
         heads_local = self.n_heads // self.tp_size
         kv_local = kv_heads // self.tp_size
@@ -108,13 +125,39 @@ class Attention(nn.Module):
         k = dense("k", kv_local)(x)
         v = dense("v", kv_local)(x)
 
-        if self.seq_axis is None:
+        if self.decode:
+            b, t = x.shape[0], x.shape[1]
+            ck = self.variable(
+                "cache", "cached_k", jnp.zeros,
+                (b, self.max_decode_len, kv_local, head), k.dtype,
+            )
+            cv = self.variable(
+                "cache", "cached_v", jnp.zeros,
+                (b, self.max_decode_len, kv_local, head), v.dtype,
+            )
+            ci = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            offset = ci.value  # global position of this chunk's first token
+        elif self.seq_axis is None:
             offset = 0
         else:
             offset = lax.axis_index(self.seq_axis) * x.shape[1]
         q, k = rope(q, offset), rope(k, offset)
 
-        if self.seq_axis is None:
+        if self.decode:
+            from akka_allreduce_tpu.ops.local_attention import local_attention
+
+            # append this chunk's K/V at the running index; slots past
+            # offset + t hold zeros and are causally invisible (their
+            # k_pos exceeds every live q_pos)
+            ck.value = lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+            ci.value = offset + t
+            out = local_attention(
+                q, ck.value, cv.value, causal=True, q_offset=offset,
+            )
+        elif self.seq_axis is None:
             # dense single-device form: dispatch to the best local core
             # (flash kernel on TPU, blockwise off-chip for long T)
             from akka_allreduce_tpu.ops.local_attention import local_attention
@@ -148,6 +191,8 @@ class Block(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     model_axis: str | None = None
     tp_size: int = 1
+    decode: bool = False
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -166,6 +211,8 @@ class Block(nn.Module):
             compute_dtype=self.compute_dtype,
             model_axis=self.model_axis,
             tp_size=self.tp_size,
+            decode=self.decode,
+            max_decode_len=self.max_decode_len,
         )(h)
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
         # TP: hidden dim column-split on the up projection, row-split on the
@@ -202,6 +249,8 @@ class TransformerLM(nn.Module):
     # one extra forward of FLOPs for O(layers) activation memory — the knob
     # that lets long sequences fit in HBM
     remat: bool = False
+    decode: bool = False  # KV-cache autoregressive mode (models/generate.py)
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, tokens):
@@ -220,6 +269,8 @@ class TransformerLM(nn.Module):
                 compute_dtype=self.compute_dtype,
                 model_axis=self.model_axis,
                 tp_size=self.tp_size,
+                decode=self.decode,
+                max_decode_len=self.max_decode_len,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
