@@ -174,7 +174,11 @@ def local_attention(
         from akka_allreduce_tpu.ops.ring_attention import repeat_kv
 
         k, v = repeat_kv(k, q.shape[2]), repeat_kv(v, q.shape[2])
-    if q.shape[1] <= _DENSE_MAX_T and k.shape[1] <= _DENSE_MAX_T:
+    # dense is gated on the SCORE MATRIX size, not the raw lengths: a
+    # short query block over a long K/V (the decode-over-cache shape,
+    # Tq=1) has a tiny (B, H, Tq, Tk) score tensor, and the blockwise
+    # scan would be pure launch overhead for it
+    if q.shape[1] * k.shape[1] <= _DENSE_MAX_T * _DENSE_MAX_T:
         return attention_reference(
             q, k, v, causal=causal, sm_scale=scale,
             q_offset=q_offset, k_offset=k_offset,
