@@ -1327,12 +1327,20 @@ def _cmd_train_pp(argv: list[str]) -> int:
     )
     p.add_argument(
         "--schedule",
-        choices=("gpipe", "1f1b"),
+        choices=("gpipe", "1f1b", "interleaved"),
         default="gpipe",
         help="pipeline schedule: gpipe holds O(microbatches) activations "
         "in flight (AD through the tick scan); 1f1b interleaves each "
         "micro's backward right behind its forward, holding O(stages) — "
-        "same numerics (tests/test_pipeline.py), the standard memory fix",
+        "same numerics (tests/test_pipeline.py), the standard memory fix; "
+        "interleaved adds --virtual chunks per stage (Megatron virtual "
+        "pipeline) so the fill/drain bubble is paid in 1/virtual-sized "
+        "chunk ticks",
+    )
+    p.add_argument(
+        "--virtual", type=int, default=1,
+        help="virtual chunks per stage for --schedule interleaved "
+        "(layers-per-stage must divide by it)",
     )
     _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
@@ -1360,12 +1368,16 @@ def _cmd_train_pp(argv: list[str]) -> int:
         compress=args.compress,
         overlap=args.overlap,
         schedule=args.schedule,
+        virtual_chunks=args.virtual,
+    )
+    sched = args.schedule + (
+        f" v={args.virtual}" if args.schedule == "interleaved" else ""
     )
     print(
         f"PP params: {trainer.param_count / 1e6:.2f}M "
         f"({trainer.n_layers} layers), mesh dp={trainer.dp} x "
         f"pp={trainer.stages}, {args.microbatches} microbatches "
-        f"({args.schedule})"
+        f"({sched})"
     )
     if args.steps <= 0:
         return 0

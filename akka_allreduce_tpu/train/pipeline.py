@@ -110,6 +110,7 @@ class PipelineLMTrainer:
         compress: str | None = None,
         overlap: bool = False,
         schedule: str = "gpipe",
+        virtual_chunks: int = 1,
     ) -> None:
         from akka_allreduce_tpu.models.transformer import Block
 
@@ -117,14 +118,32 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"need a (data, pipe) mesh, got axes {mesh.axis_names}"
             )
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"schedule must be gpipe or 1f1b, got {schedule!r}")
-        if schedule == "1f1b" and overlap:
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                "overlap excludes schedule='1f1b': its gradients are "
-                "hand-accumulated per tick (no backward pass for the "
-                "per-leaf sync to hook); 1f1b's grouped collective already "
+                f"schedule must be gpipe, 1f1b or interleaved, got {schedule!r}"
+            )
+        if schedule in ("1f1b", "interleaved") and overlap:
+            raise ValueError(
+                "overlap excludes the hand-scheduled pipelines: their "
+                "gradients are accumulated per tick (no backward pass for "
+                "the per-leaf sync to hook); the grouped collective already "
                 "fires once at the end of the tick scan"
+            )
+        if schedule == "interleaved":
+            if virtual_chunks < 2:
+                raise ValueError(
+                    "schedule='interleaved' needs virtual_chunks >= 2 "
+                    "(1 chunk IS plain 1f1b — use schedule='1f1b')"
+                )
+            if layers_per_stage % virtual_chunks:
+                raise ValueError(
+                    f"{layers_per_stage=} not divisible by "
+                    f"{virtual_chunks=} chunks"
+                )
+        elif virtual_chunks != 1:
+            raise ValueError(
+                f"virtual_chunks={virtual_chunks} only applies to "
+                "schedule='interleaved'"
             )
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
@@ -155,8 +174,31 @@ class PipelineLMTrainer:
             block.init(jax.random.fold_in(rng, 1000 + i), x0)["params"]
             for i in range(self.n_layers)
         ]
-        # stack to (L, ...) leaves: ONE trunk tree, layer dim sharded on pipe
-        trunk = jax.tree.map(lambda *ls: jnp.stack(ls), *layer_ps)
+        # stack to (L, ...) leaves: ONE trunk tree, layer dim sharded on
+        # pipe. Interleaved: stage s's local rows hold its v chunks in
+        # chunk order, and chunk c of stage s is the LOGICAL block c*S + s
+        # (a microbatch loops the ring v times, visiting blocks in logical
+        # order), so the stacked row s*lps + c*cl + j carries logical
+        # layer (c*S + s)*cl + j. _layer_perm maps stacked -> logical;
+        # everything external (get_flat_params, checkpoints) sees logical.
+        lps = layers_per_stage
+        cl = lps // virtual_chunks
+        self._layer_perm = np.arange(self.n_layers)
+        if schedule == "interleaved":
+            self._layer_perm = np.array(
+                [
+                    (c * self.stages + s) * cl + j
+                    for s in range(self.stages)
+                    for c in range(virtual_chunks)
+                    for j in range(cl)
+                ]
+            )
+        self._layer_perm_inv = np.argsort(self._layer_perm)
+        trunk = jax.tree.map(
+            lambda *ls: jnp.stack([ls[g] for g in self._layer_perm]),
+            *layer_ps,
+        )
+        self.virtual_chunks = virtual_chunks
         self.params = {
             "embed": embed.init(jax.random.fold_in(rng, 1), tok0)["params"],
             "trunk": trunk,
@@ -258,6 +300,41 @@ class PipelineLMTrainer:
             updates, new_opt = tx.update(gavg, opt_state, params)
             return optax.apply_updates(params, updates), new_opt
 
+        def stage_all(trunk_local, head_p, inp, lbl):
+            """One stage's (or chunk's) whole tick-work: blocks, then
+            head+loss. The single vjp point for BOTH hand-scheduled
+            cotangent paths — mid stages seed d(out) with the received
+            cotangent (d(ce)=0, so the head contributes nothing), the last
+            stage seeds d(ce)=1. Shared by 1f1b and interleaved so the
+            schedules can never diverge in per-tick math."""
+            out = run_stage(trunk_local, inp)
+            logits = head_apply({"params": head_p}, out)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, lbl
+            ).sum()
+            return out, ce
+
+        def hand_epilogue(
+            params, opt_state, g_emb, g_trunk, g_head, ce_total, v, v0, denom
+        ):
+            """Shared tail of the hand-scheduled schedules: mask-scale the
+            accumulated grads, ONE grouped collective per sharding class
+            (bf16/int8 wire compression composes here), loss psum, update."""
+            grads = {"embed": g_emb, "trunk": g_trunk, "head": g_head}
+            scale = v / denom
+            grads = jax.tree.map(
+                lambda g: g * scale.astype(g.dtype), grads
+            )
+            from akka_allreduce_tpu.comm.allreduce import grouped_tree_psum
+
+            gavg = grouped_tree_psum(
+                grads, param_specs, axis_names, wire_dtype=compress
+            )
+            loss_avg = lax.psum(ce_total * v / denom, axis_names)
+            contributors = lax.psum(v0, data_axis)
+            new_params, new_opt = apply_update(params, opt_state, gavg)
+            return new_params, new_opt, loss_avg, contributors
+
         def step(params, opt_state, x, y, valid):
             s, v0, v, mb, t_len, is_last_b, denom = stage_context(x, valid)
             is_last = is_last_b.astype(jnp.float32)
@@ -356,18 +433,6 @@ class PipelineLMTrainer:
             micro_tok = x.reshape(m_count, mb, t_len)
             labels = y.reshape(m_count, mb, t_len)
 
-            def stage_all(trunk_local, head_p, inp, lbl):
-                """One stage's whole tick-work: blocks, then head+loss.
-                The single vjp point for BOTH cotangent paths — mid stages
-                seed d(out) with the received cotangent (d(ce)=0, so the
-                head contributes nothing), the last stage seeds d(ce)=1."""
-                out = run_stage(trunk_local, inp)
-                logits = head_apply({"params": head_p}, out)
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, lbl
-                ).sum()
-                return out, ce
-
             def tick(carry, t):
                 ring, act_rx, ct_rx, g_emb, g_trunk, g_head, ce_acc = carry
                 # ---- forward: micro f = t - s (GPipe pacing) ----
@@ -465,24 +530,181 @@ class PipelineLMTrainer:
             (_, _, _, g_emb, g_trunk, g_head, ce_total), _ = lax.scan(
                 tick, carry0, jnp.arange(m_count + 2 * s_count - 2)
             )
-            grads = {"embed": g_emb, "trunk": g_trunk, "head": g_head}
-            scale = v / denom
-            grads = jax.tree.map(
-                lambda g: g * scale.astype(g.dtype), grads
+            return hand_epilogue(
+                params, opt_state, g_emb, g_trunk, g_head, ce_total,
+                v, v0, denom,
             )
-            # ONE explicit grouped collective per sharding class: trunk
-            # (pipe-sharded) reduces over data, embed/head over data x pipe
-            # — the same machinery as the compressed paths, so bf16/int8
-            # wire compression composes with 1f1b unchanged
-            from akka_allreduce_tpu.comm.allreduce import grouped_tree_psum
 
-            gavg = grouped_tree_psum(
-                grads, param_specs, axis_names, wire_dtype=compress
+        # ---- interleaved 1F1B: v virtual chunks per stage, table-driven ----
+        # (pipeline_schedule.py derives per-tick work tables and PROVES the
+        # single sticky rx slot per direction suffices; the cyclic ppermute
+        # wrap carries a microbatch from chunk c on stage S-1 to chunk c+1
+        # on stage 0, so one scan serves all v loops around the ring)
+        if schedule == "interleaved":
+            from akka_allreduce_tpu.train.pipeline_schedule import (
+                interleaved_1f1b_tables,
             )
-            loss_avg = lax.psum(ce_total * v / denom, axis_names)
-            contributors = lax.psum(v0, data_axis)
-            new_params, new_opt = apply_update(params, opt_state, gavg)
-            return new_params, new_opt, loss_avg, contributors
+
+            tabs = interleaved_1f1b_tables(s_count, m_count, virtual_chunks)
+            self.schedule_tables = tabs
+            tick_xs = (
+                jnp.asarray(tabs.f_micro),
+                jnp.asarray(tabs.f_chunk),
+                jnp.asarray(tabs.f_arrive),
+                jnp.asarray(tabs.b_micro),
+                jnp.asarray(tabs.b_chunk),
+                jnp.asarray(tabs.b_arrive),
+            )
+            rk = tabs.ring_k
+        v_chunks = virtual_chunks
+        chunk_l = layers_per_stage // virtual_chunks
+
+        def chunk_slice(tree, c):
+            """This stage's chunk c: rows [c*cl, (c+1)*cl) of its local
+            (lps, ...) trunk leaves."""
+            return jax.tree.map(
+                lambda l: lax.dynamic_slice_in_dim(
+                    l, c * chunk_l, chunk_l, axis=0
+                ),
+                tree,
+            )
+
+        def chunk_add(gtree, c, d):
+            """Accumulate a chunk's gradient into its slice of the local
+            (lps, ...) gradient leaves."""
+            return jax.tree.map(
+                lambda g, dd: lax.dynamic_update_slice_in_dim(
+                    g,
+                    lax.dynamic_slice_in_dim(g, c * chunk_l, chunk_l, axis=0)
+                    + dd,
+                    c * chunk_l,
+                    axis=0,
+                ),
+                gtree,
+                d,
+            )
+
+        def step_interleaved(params, opt_state, x, y, valid):
+            s, v0, v, mb, t_len, is_last, denom = stage_context(x, valid)
+            micro_tok = x.reshape(m_count, mb, t_len)
+            labels = y.reshape(m_count, mb, t_len)
+
+            def at(row):
+                return lax.dynamic_index_in_dim(row, s, 0, keepdims=False)
+
+            def tick(carry, xs):
+                fm_row, fc_row, fa_row, bm_row, bc_row, ba_row = xs
+                (
+                    ring, pend_act, act_rx, pend_ct, ct_rx,
+                    g_emb, g_trunk, g_head, ce_acc,
+                ) = carry
+                # sticky rx: refresh only when the neighbor really sent
+                act_rx = jnp.where(at(fa_row), pend_act, act_rx)
+                ct_rx = jnp.where(at(ba_row), pend_ct, ct_rx)
+
+                # ---- forward work item ----
+                fm, fc_ = at(fm_row), at(fc_row)
+                do_f = fm >= 0
+                fmc = jnp.clip(fm, 0, m_count - 1)
+                tok_f = lax.dynamic_index_in_dim(
+                    micro_tok, fmc, 0, keepdims=False
+                )
+                lbl_f = lax.dynamic_index_in_dim(
+                    labels, fmc, 0, keepdims=False
+                )
+                emb_f = embed_apply({"params": params["embed"]}, tok_f)
+                entry = (s == 0) & (fc_ == 0)  # a fresh micro enters here
+                inp = jnp.where(entry, emb_f, act_rx)
+                slot_f = jnp.mod(fmc, rk)
+                prev = lax.dynamic_slice(
+                    ring, (fc_, slot_f, 0, 0, 0), (1, 1) + ring.shape[2:]
+                )[0, 0]
+                ring = lax.dynamic_update_slice(
+                    ring,
+                    jnp.where(do_f, inp, prev)[None, None],
+                    (fc_, slot_f, 0, 0, 0),
+                )
+                out_f, ce_f = stage_all(
+                    chunk_slice(params["trunk"], fc_), params["head"],
+                    inp, lbl_f,
+                )
+                pend_act = lax.ppermute(out_f, pipe_axis, fwd)
+                head_site = is_last & (fc_ == v_chunks - 1)
+                ce_acc = ce_acc + ce_f * (head_site & do_f).astype(
+                    jnp.float32
+                )
+
+                # ---- backward work item ----
+                bm, bc_ = at(bm_row), at(bc_row)
+                do_b = bm >= 0
+                do_bf = do_b.astype(jnp.float32)
+                bmc = jnp.clip(bm, 0, m_count - 1)
+                inp_b = lax.dynamic_slice(
+                    ring,
+                    (bc_, jnp.mod(bmc, rk), 0, 0, 0),
+                    (1, 1) + ring.shape[2:],
+                )[0, 0]
+                tok_b = lax.dynamic_index_in_dim(
+                    micro_tok, bmc, 0, keepdims=False
+                )
+                lbl_b = lax.dynamic_index_in_dim(
+                    labels, bmc, 0, keepdims=False
+                )
+                (out_b, _), vjp_fn = jax.vjp(
+                    lambda tr, hp, i: stage_all(tr, hp, i, lbl_b),
+                    chunk_slice(params["trunk"], bc_),
+                    params["head"],
+                    inp_b,
+                )
+                head_site_b = is_last & (bc_ == v_chunks - 1)
+                ct_out = (
+                    jnp.where(head_site_b, jnp.zeros_like(out_b), ct_rx)
+                    * do_bf.astype(out_b.dtype)
+                )
+                ct_ce = head_site_b.astype(jnp.float32) * do_bf
+                d_chunk, d_head, d_inp = vjp_fn((ct_out, ct_ce))
+                g_trunk = chunk_add(g_trunk, bc_, d_chunk)
+                g_head = jax.tree.map(jnp.add, g_head, d_head)
+                # the cotangent leaves the pipeline where the micro entered
+                exit_site = (s == 0) & (bc_ == 0)
+                d_emb_ct = jnp.where(
+                    exit_site, d_inp, jnp.zeros_like(d_inp)
+                )
+                _, evjp = jax.vjp(
+                    lambda ep: embed_apply({"params": ep}, tok_b),
+                    params["embed"],
+                )
+                (d_embp,) = evjp(d_emb_ct)
+                g_emb = jax.tree.map(jnp.add, g_emb, d_embp)
+                pend_ct = lax.ppermute(d_inp, pipe_axis, rev)
+                return (
+                    ring, pend_act, act_rx, pend_ct, ct_rx,
+                    g_emb, g_trunk, g_head, ce_acc,
+                ), None
+
+            act_dtype = jnp.dtype(compute_dtype)
+            vary = lambda z: lax.pcast(z, axis_names, to="varying")  # noqa: E731
+            zeros_act = vary(jnp.zeros((mb, t_len, d_model), act_dtype))
+            g0 = jax.tree.map(
+                lambda p: vary(jnp.zeros_like(p)), params
+            )
+            carry0 = (
+                vary(
+                    jnp.zeros(
+                        (v_chunks, rk, mb, t_len, d_model), act_dtype
+                    )
+                ),
+                zeros_act, zeros_act, zeros_act, zeros_act,
+                g0["embed"], g0["trunk"], g0["head"],
+                vary(jnp.float32(0.0)),
+            )
+            (*_, g_emb, g_trunk, g_head, ce_total), _ = lax.scan(
+                tick, carry0, tick_xs
+            )
+            return hand_epilogue(
+                params, opt_state, g_emb, g_trunk, g_head, ce_total,
+                v, v0, denom,
+            )
 
         batch_spec = P(self.data_axis)
         self._data_sharding = NamedSharding(mesh, batch_spec)
@@ -498,11 +720,16 @@ class PipelineLMTrainer:
         self._check_vma = (
             not overlap
             and compress != "int8"
-            and schedule != "1f1b"
+            and schedule not in ("1f1b", "interleaved")
             and not flash_vma_relax(seq_len, d_model // n_heads)
         )
+        step_fns = {
+            "gpipe": step,
+            "1f1b": step_1f1b,
+            "interleaved": step_interleaved,
+        }
         mapped = jax.shard_map(
-            step_1f1b if schedule == "1f1b" else step,
+            step_fns[schedule],
             mesh=mesh,
             in_specs=(
                 self._param_specs,
@@ -517,8 +744,8 @@ class PipelineLMTrainer:
             check_vma=self._check_vma,
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
-        # reused by train_chain's on-device loop (either schedule)
-        self._raw_step = step_1f1b if schedule == "1f1b" else step
+        # reused by train_chain's on-device loop (any schedule)
+        self._raw_step = step_fns[schedule]
         self._replicated = NamedSharding(mesh, P())
         self._chains: dict = {}
 
@@ -647,7 +874,76 @@ class PipelineLMTrainer:
             )
         return out
 
+    # -- checkpoint seam: logical layer order, schedule-portable ------------
+
+    @staticmethod
+    def _is_params_container(t) -> bool:
+        """A dict mirroring the params layout (optax moments do)."""
+        return isinstance(t, dict) and "trunk" in t
+
+    def _map_trunk_order(self, tree, order):
+        """Reindex every trunk leaf's layer dim by ``order`` (host-side
+        numpy take), for params AND optax moment containers. Identity
+        permutation (gpipe/1f1b) is a no-op."""
+        if np.array_equal(order, np.arange(len(order))):
+            return tree
+
+        def reorder(container):
+            out = dict(container)
+            out["trunk"] = jax.tree.map(
+                lambda l: np.asarray(l)[order], container["trunk"]
+            )
+            return out
+
+        return jax.tree.map(
+            lambda t: reorder(t) if self._is_params_container(t) else t,
+            tree,
+            is_leaf=self._is_params_container,
+        )
+
+    def checkpoint_state(self) -> dict:
+        """Serialize with trunk leaves in LOGICAL layer order, so a
+        checkpoint written under any schedule (gpipe / 1f1b / interleaved,
+        any virtual_chunks) restores under any other — the device-storage
+        permutation never leaks into the format."""
+        host = jax.tree.map(lambda x: np.asarray(x), dict(
+            params=self.params, opt_state=self.opt_state
+        ))
+        return self._map_trunk_order(host, self._layer_perm_inv)
+
+    def checkpoint_template(self) -> dict:
+        """ShapeDtypeStruct twin (reordering preserves shapes/dtypes)."""
+        return jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.asarray(l).dtype),
+            {"params": self.params, "opt_state": self.opt_state},
+        )
+
+    def restore_checkpoint_state(self, state: dict) -> None:
+        stored = self._map_trunk_order(
+            {"params": state["params"], "opt_state": state["opt_state"]},
+            self._layer_perm,
+        )
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        place = lambda t, specs: jax.device_put(  # noqa: E731
+            t,
+            jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), specs, is_leaf=is_spec
+            ),
+        )
+        self.params = place(stored["params"], self._param_specs)
+        self.opt_state = place(stored["opt_state"], self._opt_specs)
+
+    def logical_params(self) -> dict:
+        """Params with trunk leaves in LOGICAL layer order (host arrays).
+
+        The interleaved schedule stores the trunk in device-traversal
+        order (stage-major chunks — see the stacking comment in __init__);
+        external views un-permute so cross-schedule comparisons and
+        checkpoints see the same model regardless of schedule."""
+        host = jax.tree.map(lambda l: np.asarray(l), self.params)
+        return self._map_trunk_order(host, self._layer_perm_inv)
+
     def get_flat_params(self) -> np.ndarray:
         from akka_allreduce_tpu.binder.api import flatten_pytree
 
-        return flatten_pytree(self.params)[0]
+        return flatten_pytree(self.logical_params())[0]

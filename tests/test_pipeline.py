@@ -207,3 +207,167 @@ class Test1F1BSchedule:
             PipelineLMTrainer(
                 mesh(2, 4), layers_per_stage=1, schedule="2f2b", **self._kw()
             )
+
+
+class TestInterleavedSchedule:
+    """Megatron-style virtual pipeline (schedule='interleaved'): v chunks
+    per stage, table-driven ticks (train/pipeline_schedule.py), the cyclic
+    ppermute wrap carrying each micro from chunk c to c+1. Numerics are the
+    same sums as GPipe; the win is the bubble paid in 1/v-sized chunk
+    ticks."""
+
+    def _kw(self, m):
+        import optax
+
+        return dict(
+            vocab=16, d_model=32, n_heads=4, seq_len=32, microbatches=m,
+            optimizer=optax.sgd(1e-2), seed=0,
+        )
+
+    def test_matches_gpipe(self):
+        t_i = PipelineLMTrainer(
+            mesh(1, 4), layers_per_stage=2, schedule="interleaved",
+            virtual_chunks=2, **self._kw(4),
+        )
+        t_g = PipelineLMTrainer(
+            mesh(1, 4), layers_per_stage=2, schedule="gpipe", **self._kw(4),
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        for x, y in ds.batches(4, 3):
+            a, b = t_i.train_step(x, y), t_g.train_step(x, y)
+            assert abs(a.loss - b.loss) < 1e-6, (a.loss, b.loss)
+        d = np.abs(t_i.get_flat_params() - t_g.get_flat_params()).max()
+        assert d < 1e-6, d
+
+    def test_masked_row_and_dp(self):
+        t = PipelineLMTrainer(
+            mesh(2, 2), layers_per_stage=2, schedule="interleaved",
+            virtual_chunks=2, **self._kw(2),
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(4, 1))
+        m = t.train_step(x, y, valid=[1.0, 0.0])
+        assert m.contributors == 1.0 and np.isfinite(m.loss)
+
+    def test_compress_composes(self):
+        kw = self._kw(4)
+        t_c = PipelineLMTrainer(
+            mesh(1, 2), layers_per_stage=2, schedule="interleaved",
+            virtual_chunks=2, compress="bf16", **kw,
+        )
+        t_f = PipelineLMTrainer(
+            mesh(1, 2), layers_per_stage=2, schedule="interleaved",
+            virtual_chunks=2, **kw,
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        for x, y in ds.batches(4, 2):
+            a, b = t_c.train_step(x, y), t_f.train_step(x, y)
+            assert abs(a.loss - b.loss) < 5e-2
+        assert np.isfinite(t_c.get_flat_params()).all()
+
+    def test_train_chain_and_guards(self):
+        t = PipelineLMTrainer(
+            mesh(1, 2), layers_per_stage=2, schedule="interleaved",
+            virtual_chunks=2, **self._kw(2),
+        )
+        sampler = data.lm_copy_task(32, vocab=16).device_sampler()
+        hist = t.train_chain(sampler, 3, 2)
+        assert len(hist) == 3 and all(np.isfinite(h.loss) for h in hist)
+        with pytest.raises(ValueError, match="virtual_chunks >= 2"):
+            PipelineLMTrainer(
+                mesh(1, 2), layers_per_stage=2, schedule="interleaved",
+                **self._kw(2),
+            )
+        with pytest.raises(ValueError, match="divisible"):
+            PipelineLMTrainer(
+                mesh(1, 2), layers_per_stage=3, schedule="interleaved",
+                virtual_chunks=2, **self._kw(2),
+            )
+        with pytest.raises(ValueError, match="only applies"):
+            PipelineLMTrainer(
+                mesh(1, 2), layers_per_stage=2, schedule="gpipe",
+                virtual_chunks=2, **self._kw(2),
+            )
+
+    def test_checkpoint_is_schedule_portable(self, tmp_path):
+        """A gpipe-written checkpoint restores into an interleaved trainer
+        (and back): the serialized trunk is in LOGICAL layer order, the
+        device-storage permutation never leaks into the format."""
+        from akka_allreduce_tpu.train import TrainerCheckpointer
+
+        kw = self._kw(4)
+        t_g = PipelineLMTrainer(
+            mesh(1, 2), layers_per_stage=2, schedule="gpipe", **kw
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        batches = [next(ds.batches(4, 1, seed_offset=i)) for i in range(4)]
+        for x, y in batches[:2]:
+            t_g.train_step(x, y)
+        with TrainerCheckpointer(tmp_path / "pp") as ckpt:
+            assert ckpt.save(t_g)
+            t_i = PipelineLMTrainer(
+                mesh(1, 2), layers_per_stage=2, schedule="interleaved",
+                virtual_chunks=2, **kw,
+            )
+            assert ckpt.restore(t_i) == 2
+        np.testing.assert_array_equal(
+            t_i.get_flat_params(), t_g.get_flat_params()
+        )
+        for x, y in batches[2:]:
+            a, b = t_i.train_step(x, y), t_g.train_step(x, y)
+            assert abs(a.loss - b.loss) < 1e-6
+        np.testing.assert_allclose(
+            t_i.get_flat_params(), t_g.get_flat_params(),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_bubble_shrinks_with_chunks(self):
+        """The schedule evidence: same (S, M), more chunks -> smaller
+        makespan in chunk units (each tick does 1/v of a stage), and v=1
+        reproduces plain 1F1B's M + 2S - 2 ticks exactly."""
+        from akka_allreduce_tpu.train.pipeline_schedule import (
+            interleaved_1f1b_tables,
+        )
+
+        S, M = 4, 8
+        t1 = interleaved_1f1b_tables(S, M, 1)
+        assert t1.n_ticks == M + 2 * S - 2
+        # plain 1F1B's start ticks: fwd m at stage0 tick m, bwd at m+S-1
+        for m in range(M):
+            assert t1.f_micro[m, 0] == m
+            assert t1.b_micro[m + S - 1, S - 1] == m
+        units = {
+            v: interleaved_1f1b_tables(S, M, v).n_ticks * (4 // v)
+            for v in (1, 2, 4)
+        }
+        # chunk-tick makespan, normalized to quarter-stage work units
+        assert units[2] < units[1], units
+        assert units[4] < units[2], units
+
+    def test_interleaved_memory_flat_in_microbatches(self):
+        """Like 1F1B, the interleaved live state is the carry (ring +
+        pending slots), not O(M) saved ticks."""
+
+        def temp_bytes(m):
+            t = PipelineLMTrainer(
+                mesh(1, 2), layers_per_stage=2, schedule="interleaved",
+                virtual_chunks=2, **self._kw(m),
+            )
+            xd = jax.device_put(
+                np.zeros((m * 2, 32), np.int32), t._data_sharding
+            )
+            yd = jax.device_put(
+                np.zeros((m * 2, 32), np.int32), t._data_sharding
+            )
+            vd = jax.device_put(np.ones((1,), np.float32), t._valid_sharding)
+            ma = (
+                t._step.lower(t.params, t.opt_state, xd, yd, vd)
+                .compile()
+                .memory_analysis()
+            )
+            return None if ma is None else ma.temp_size_in_bytes
+
+        b4, b16 = temp_bytes(4), temp_bytes(16)
+        if None in (b4, b16):
+            pytest.skip("memory_analysis unavailable on this backend")
+        assert b16 < 1.5 * b4, (b4, b16)
