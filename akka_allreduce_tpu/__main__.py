@@ -1454,6 +1454,11 @@ def _cmd_lm_generate(argv: list[str]) -> int:
     p.add_argument("--seq-len", type=int, default=64, help="training seq len")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument(
+        "--cache-quant", choices=("int8",), default=None,
+        help="quantize the KV cache to int8 + per-row scales (4x fewer "
+        "cache bytes than f32; ~0.4%% per-element error)",
+    )
     args = p.parse_args(argv)
     if args.gen < 2:
         p.error("--gen must be >= 2 (the slope timing needs two lengths)")
@@ -1499,7 +1504,10 @@ def _cmd_lm_generate(argv: list[str]) -> int:
             jnp.zeros((1, args.prompt_len), jnp.int32),
         )
 
-    gen = LMGenerator(model, max_len=args.prompt_len + args.gen)
+    gen = LMGenerator(
+        model, max_len=args.prompt_len + args.gen,
+        cache_quant=args.cache_quant,
+    )
     x, _ = next(ds.batches(args.batch, 1, seed_offset=123))
     prompt = jnp.asarray(x[:, : args.prompt_len])
 
@@ -1541,10 +1549,12 @@ def _cmd_lm_generate(argv: list[str]) -> int:
         rate = f"{args.batch * 1e3 / ms_per_tok:.0f} tokens/s"
     else:
         rate = "n/a (noise-dominated at this size)"
+    qnote = f" {args.cache_quant}-quantized" if args.cache_quant else ""
     print(
         f"decode: {ms_per_tok:.2f} ms/token, {rate} "
         f"(batch {args.batch}, cache (B,{gen.max_len},"
-        f"{args.kv_heads or args.heads},{args.d_model // args.heads}))"
+        f"{args.kv_heads or args.heads},{args.d_model // args.heads})"
+        f"{qnote})"
     )
     return 0
 

@@ -40,6 +40,7 @@ class LMGenerator:
 
     model: TransformerLM
     max_len: int
+    cache_quant: str | None = None  # "int8": quantized KV cache (4x vs f32)
 
     def __post_init__(self) -> None:
         if self.model.seq_axis is not None or self.model.tp_size > 1:
@@ -48,7 +49,8 @@ class LMGenerator:
                 "unsharded model config (seq_axis=None, tp_size=1)"
             )
         self.decoder = dataclasses.replace(
-            self.model, decode=True, max_decode_len=self.max_len, remat=False
+            self.model, decode=True, max_decode_len=self.max_len,
+            remat=False, cache_quant=self.cache_quant,
         )
         self._fns: dict = {}  # compiled generate loops, keyed by shape
         self._cache_tmpl: dict = {}  # zero-cache template per batch size

@@ -74,6 +74,11 @@ class Attention(nn.Module):
     ``cache_index`` and attends over the whole cache causally. Init the
     cache with ``model.init`` on any-length tokens; apply with
     ``mutable=["cache"]``. Single device only (no seq/tensor sharding).
+
+    ``cache_quant="int8"`` stores the cache quantized per (token, head)
+    row — int8 payload + one f32 scale per row, ~4× fewer cache bytes
+    than f32 (2× vs bf16) at ~0.4 % per-element quantization error — the
+    inference twin of the training wire's int8 ring compression.
     """
 
     n_heads: int
@@ -85,6 +90,7 @@ class Attention(nn.Module):
     tp_size: int = 1
     decode: bool = False  # KV-cache autoregressive mode
     max_decode_len: int = 0  # cache capacity (decode=True only)
+    cache_quant: str | None = None  # None = compute dtype; "int8" quantized
 
     @nn.compact
     def __call__(self, x):
@@ -126,15 +132,24 @@ class Attention(nn.Module):
         v = dense("v", kv_local)(x)
 
         if self.decode:
+            if self.cache_quant not in (None, "int8"):
+                raise ValueError(
+                    f"cache_quant must be None or 'int8', got "
+                    f"{self.cache_quant!r}"
+                )
+            quant = self.cache_quant == "int8"
             b, t = x.shape[0], x.shape[1]
-            ck = self.variable(
-                "cache", "cached_k", jnp.zeros,
-                (b, self.max_decode_len, kv_local, head), k.dtype,
-            )
-            cv = self.variable(
-                "cache", "cached_v", jnp.zeros,
-                (b, self.max_decode_len, kv_local, head), v.dtype,
-            )
+            kv_shape = (b, self.max_decode_len, kv_local, head)
+            cache_dt = jnp.int8 if quant else k.dtype
+            ck = self.variable("cache", "cached_k", jnp.zeros, kv_shape, cache_dt)
+            cv = self.variable("cache", "cached_v", jnp.zeros, kv_shape, cache_dt)
+            if quant:
+                cks = self.variable(
+                    "cache", "k_scale", jnp.zeros, kv_shape[:3], jnp.float32
+                )
+                cvs = self.variable(
+                    "cache", "v_scale", jnp.zeros, kv_shape[:3], jnp.float32
+                )
             ci = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
@@ -151,11 +166,37 @@ class Attention(nn.Module):
             # append this chunk's K/V at the running index; slots past
             # offset + t hold zeros and are causally invisible (their
             # k_pos exceeds every live q_pos)
-            ck.value = lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+            def write(cache, chunk):
+                cache.value = lax.dynamic_update_slice(
+                    cache.value, chunk, (0, offset) + (0,) * (chunk.ndim - 2)
+                )
+
+            if quant:
+                def quantize(x_):
+                    # per (token, head) row: one f32 scale over the D dim
+                    s = jnp.max(jnp.abs(x_), axis=-1) / 127.0
+                    s = jnp.maximum(s, 1e-8).astype(jnp.float32)
+                    q_ = jnp.clip(
+                        jnp.round(x_ / s[..., None].astype(x_.dtype)),
+                        -127, 127,
+                    ).astype(jnp.int8)
+                    return q_, s
+
+                kq, ks = quantize(k)
+                vq, vs = quantize(v)
+                write(ck, kq), write(cv, vq)
+                write(cks, ks), write(cvs, vs)
+                dq = lambda c, s: (  # noqa: E731
+                    c.value.astype(k.dtype)
+                    * s.value[..., None].astype(k.dtype)
+                )
+                k_full, v_full = dq(ck, cks), dq(cv, cvs)
+            else:
+                write(ck, k), write(cv, v)
+                k_full, v_full = ck.value, cv.value
             ci.value = offset + t
             out = local_attention(
-                q, ck.value, cv.value, causal=True, q_offset=offset,
+                q, k_full, v_full, causal=True, q_offset=offset,
             )
         elif self.seq_axis is None:
             # dense single-device form: dispatch to the best local core
@@ -193,6 +234,7 @@ class Block(nn.Module):
     tp_size: int = 1
     decode: bool = False
     max_decode_len: int = 0
+    cache_quant: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -213,6 +255,7 @@ class Block(nn.Module):
             tp_size=self.tp_size,
             decode=self.decode,
             max_decode_len=self.max_decode_len,
+            cache_quant=self.cache_quant,
         )(h)
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
         # TP: hidden dim column-split on the up projection, row-split on the
@@ -251,6 +294,7 @@ class TransformerLM(nn.Module):
     remat: bool = False
     decode: bool = False  # KV-cache autoregressive mode (models/generate.py)
     max_decode_len: int = 0
+    cache_quant: str | None = None  # "int8" = quantized KV cache
 
     @nn.compact
     def __call__(self, tokens):
@@ -271,6 +315,7 @@ class TransformerLM(nn.Module):
                 tp_size=self.tp_size,
                 decode=self.decode,
                 max_decode_len=self.max_decode_len,
+                cache_quant=self.cache_quant,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)

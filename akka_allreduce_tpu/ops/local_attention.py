@@ -150,6 +150,52 @@ def _flash_ok(q: jax.Array, k: jax.Array, q_offset, k_offset) -> bool:
     return tq == k.shape[1] and flash_shapes_ok(tq, d)
 
 
+def quantized_cache_attention(
+    q: jax.Array,
+    k_q: jax.Array,
+    k_scale: jax.Array,
+    v_q: jax.Array,
+    v_scale: jax.Array,
+    *,
+    q_offset,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Causal attention over an int8-quantized KV cache WITHOUT
+    materializing the dequantized cache: per-row scales fold into the
+    score matrix (q·(k·s) = (q·k)·s) and the probability weights
+    (Σ p·s·v = (p·s)·v), so the only full-cache reads are the int8
+    payloads — the bandwidth the quantization was bought for.
+
+    Shapes: ``q`` (B, Tq, H, D); ``k_q``/``v_q`` (B, L, H_kv, D) int8 with
+    (B, L, H_kv) f32 scales. Built for the decode shape (small Tq over a
+    long cache); scores are (B, H, Tq, L) — tiny for Tq of a few.
+    """
+    import math as _math
+
+    from akka_allreduce_tpu.ops.ring_attention import _MASK_VALUE, repeat_kv
+
+    h = q.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / _math.sqrt(q.shape[-1])
+    group = h // k_q.shape[2]
+    kc = repeat_kv(k_q.astype(q.dtype), h)  # convert fuses into the dot
+    vc = repeat_kv(v_q.astype(q.dtype), h)
+    ks = jnp.repeat(k_scale, group, axis=2)  # (B, L, H)
+    vs = jnp.repeat(v_scale, group, axis=2)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
+    ) * (ks.transpose(0, 2, 1)[:, :, None, :] * scale)
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = jnp.arange(k_q.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]
+    scores = jnp.where(mask[None, None], scores, _MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1)
+    weighted = probs * vs.transpose(0, 2, 1)[:, :, None, :]
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weighted, vc, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
+
+
 def local_attention(
     q: jax.Array,
     k: jax.Array,
