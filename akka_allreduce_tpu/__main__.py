@@ -1344,7 +1344,6 @@ def _cmd_train_pp(argv: list[str]) -> int:
     )
     _add_sharded_compress_flag(p)
     args = p.parse_args(argv)
-
     import jax
 
     from akka_allreduce_tpu.models import data
@@ -1355,6 +1354,17 @@ def _cmd_train_pp(argv: list[str]) -> int:
     mesh = jax.make_mesh(
         (dp, args.pp), ("data", "pipe"), devices=devs[: dp * args.pp]
     )
+    try:
+        # pure flag validation only — internal construction errors keep
+        # their tracebacks; flag mistakes become argparse usage errors
+        PipelineLMTrainer.validate_flags(
+            schedule=args.schedule,
+            virtual_chunks=args.virtual,
+            layers_per_stage=args.layers_per_stage,
+            overlap=args.overlap,
+        )
+    except ValueError as e:
+        p.error(str(e))
     trainer = PipelineLMTrainer(
         mesh,
         vocab=args.vocab,

@@ -161,7 +161,11 @@ class Attention(nn.Module):
         q, k = rope(q, offset), rope(k, offset)
 
         if self.decode:
-            from akka_allreduce_tpu.ops.local_attention import local_attention
+            from akka_allreduce_tpu.ops.local_attention import (
+                _DENSE_MAX_T,
+                local_attention,
+                quantized_cache_attention,
+            )
 
             # append this chunk's K/V at the running index; slots past
             # offset + t hold zeros and are causally invisible (their
@@ -186,18 +190,50 @@ class Attention(nn.Module):
                 vq, vs = quantize(v)
                 write(ck, kq), write(cv, vq)
                 write(cks, ks), write(cvs, vs)
-                dq = lambda c, s: (  # noqa: E731
-                    c.value.astype(k.dtype)
-                    * s.value[..., None].astype(k.dtype)
-                )
-                k_full, v_full = dq(ck, cks), dq(cv, cvs)
+                ci.value = offset + t
+                # decode (small Tq over the long cache): attend directly
+                # over the int8 payloads — the scales fold into the
+                # scores/weights, so no dequantized full-precision copy of
+                # the cache is ever materialized (the bandwidth the
+                # quantization was bought for). Prefill (large Tq) would
+                # make the dense (B,H,Tq,L) f32 scores the memory hog
+                # instead; there, dequantize once and take
+                # local_attention's blockwise/flash dispatch. Gate on the
+                # per-key byte costs of the two branches (both scale with
+                # L, so L cancels): fused scores cost 4·H·Tq bytes/key,
+                # dequant costs itemsize·2·H_kv·D bytes/key (K and V) —
+                # Tq=1 over any cache length stays fused.
+                score_b = 4 * heads_local * t
+                dequant_b = 2 * kv_local * head * k.dtype.itemsize
+                # t == 1 is unconditional: the dequant branch would also
+                # WRITE and re-read the full-precision copy (its per-key
+                # cost is ~3x dequant_b in practice), so token-by-token
+                # decode must never take it even at extreme GQA ratios
+                # where the byte model above tips the other way
+                if (
+                    t == 1
+                    or score_b <= dequant_b
+                    or t * self.max_decode_len <= _DENSE_MAX_T * _DENSE_MAX_T
+                ):
+                    out = quantized_cache_attention(
+                        q, ck.value, cks.value, cv.value, cvs.value,
+                        q_offset=offset,
+                    )
+                else:
+                    dq = lambda c, s: (  # noqa: E731
+                        c.value.astype(k.dtype)
+                        * s.value[..., None].astype(k.dtype)
+                    )
+                    out = local_attention(
+                        q, dq(ck, cks), dq(cv, cvs),
+                        causal=True, q_offset=offset,
+                    )
             else:
                 write(ck, k), write(cv, v)
-                k_full, v_full = ck.value, cv.value
-            ci.value = offset + t
-            out = local_attention(
-                q, k_full, v_full, causal=True, q_offset=offset,
-            )
+                ci.value = offset + t
+                out = local_attention(
+                    q, ck.value, cv.value, causal=True, q_offset=offset,
+                )
         elif self.seq_axis is None:
             # dense single-device form: dispatch to the best local core
             # (flash kernel on TPU, blockwise off-chip for long T)

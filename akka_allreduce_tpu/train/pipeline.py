@@ -91,33 +91,18 @@ class PipelineLMTrainer:
         divide by it. More microbatches = smaller bubble, smaller matmuls.
     """
 
-    def __init__(
-        self,
-        mesh: Mesh,
+    @staticmethod
+    def validate_flags(
         *,
-        vocab: int = 64,
-        d_model: int = 64,
-        n_heads: int = 4,
-        n_kv_heads: int | None = None,
-        layers_per_stage: int = 1,
-        microbatches: int = 2,
-        seq_len: int = 64,
-        optimizer: optax.GradientTransformation | None = None,
-        learning_rate: float = 1e-2,
-        seed: int = 0,
-        compute_dtype=jnp.float32,
-        remat: bool = False,
-        compress: str | None = None,
-        overlap: bool = False,
         schedule: str = "gpipe",
         virtual_chunks: int = 1,
+        layers_per_stage: int = 1,
+        overlap: bool = False,
     ) -> None:
-        from akka_allreduce_tpu.models.transformer import Block
-
-        if len(mesh.axis_names) != 2:
-            raise ValueError(
-                f"need a (data, pipe) mesh, got axes {mesh.axis_names}"
-            )
+        """Raise ValueError for schedule/virtual/overlap combinations the
+        trainer cannot run. Pure flag checks (no mesh/model state) so CLIs
+        can convert them to usage errors BEFORE construction — one source
+        of truth instead of hand-copied checks."""
         if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
                 f"schedule must be gpipe, 1f1b or interleaved, got {schedule!r}"
@@ -145,6 +130,40 @@ class PipelineLMTrainer:
                 f"virtual_chunks={virtual_chunks} only applies to "
                 "schedule='interleaved'"
             )
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        vocab: int = 64,
+        d_model: int = 64,
+        n_heads: int = 4,
+        n_kv_heads: int | None = None,
+        layers_per_stage: int = 1,
+        microbatches: int = 2,
+        seq_len: int = 64,
+        optimizer: optax.GradientTransformation | None = None,
+        learning_rate: float = 1e-2,
+        seed: int = 0,
+        compute_dtype=jnp.float32,
+        remat: bool = False,
+        compress: str | None = None,
+        overlap: bool = False,
+        schedule: str = "gpipe",
+        virtual_chunks: int = 1,
+    ) -> None:
+        from akka_allreduce_tpu.models.transformer import Block
+
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                f"need a (data, pipe) mesh, got axes {mesh.axis_names}"
+            )
+        self.validate_flags(
+            schedule=schedule,
+            virtual_chunks=virtual_chunks,
+            layers_per_stage=layers_per_stage,
+            overlap=overlap,
+        )
         from akka_allreduce_tpu.comm.allreduce import validate_trainer_compress
 
         self.compress = validate_trainer_compress(compress, overlap=overlap)

@@ -63,6 +63,36 @@ class TestCLI:
         assert [l["step"] for l in steps] == [1, 2]
         assert all(l["contributors"] == 2.0 for l in steps)
 
+    def test_train_pp_rejects_bad_virtual_schedule(self, capsys):
+        import pytest
+
+        # flag combinations the trainer rejects surface as argparse errors
+        # (exit 2), not raw ValueError tracebacks
+        def err_of(argv):
+            with pytest.raises(SystemExit) as e:
+                main(argv)
+            assert e.value.code == 2
+            return capsys.readouterr().err
+
+        assert "interleaved" in err_of(
+            ["train-pp", "--virtual", "2", "--schedule", "gpipe"]
+        )
+        assert "not divisible" in err_of(
+            [
+                "train-pp", "--schedule", "interleaved", "--virtual", "3",
+                "--layers-per-stage", "2",
+            ]
+        )
+        err_of(["train-pp", "--virtual", "0"])
+        # interleaved with the default --virtual 1 is plain 1f1b
+        assert "virtual_chunks >= 2" in err_of(
+            ["train-pp", "--schedule", "interleaved"]
+        )
+        # a constraint never hand-copied into the CLI still converts
+        assert "overlap" in err_of(
+            ["train-pp", "--schedule", "1f1b", "--overlap"]
+        )
+
     def test_elastic_demo(self, capsys):
         # the drop window must outlast the phi detector's suspicion ramp
         # (~3-4 silent intervals at threshold 8), hence drop at 2, rejoin at 8
