@@ -10,6 +10,9 @@ from akka_allreduce_tpu.train.zero1 import Zero1DPTrainer  # noqa: F401
 from akka_allreduce_tpu.train.fsdp import FSDPLMTrainer  # noqa: F401
 from akka_allreduce_tpu.train.elastic import (  # noqa: F401
     ElasticDPTrainer,
+    ElasticLongContextTrainer,
+    ElasticMoETrainer,
+    ElasticPipelineTrainer,
     ElasticTrainer,
 )
 from akka_allreduce_tpu.train.long_context import (  # noqa: F401

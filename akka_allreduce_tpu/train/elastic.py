@@ -20,6 +20,7 @@ the phi-accrual detector; a silent node's devices leave the mesh at the next
 from __future__ import annotations
 
 import logging
+import math
 import time
 from typing import Callable, Mapping, Sequence
 
@@ -175,6 +176,189 @@ class ElasticTrainer:
         from akka_allreduce_tpu.binder.api import flatten_pytree
 
         return flatten_pytree(self.trainer.gathered_params())[0]
+
+
+def adaptive_parallel_factor(n_devices: int, divides: int) -> int:
+    """Largest axis size that divides BOTH the live device count and a
+    model-structure count (experts / total layers / sequence length).
+
+    The elastic wrinkle for sharded model structure (VERDICT r3 next-round
+    #1): the number of experts, pipeline layers, or sequence positions is
+    FIXED by the model, but the mesh axis carrying it must divide the live
+    device count, which changes on every re-mesh. The policy here maximizes
+    the structure axis (most parallelism over the scarce dimension) subject
+    to both divisibilities; the data axis absorbs the rest.
+    """
+    if n_devices < 1 or divides < 1:
+        raise ValueError(f"need positive counts, got {n_devices=}, {divides=}")
+    return math.gcd(n_devices, divides)
+
+
+def _capped_factor(n_devices: int, divides: int, cap: int | None) -> int:
+    """adaptive_parallel_factor, optionally capped (a smaller axis keeps
+    per-shard work non-trivial — e.g. layers_per_stage >= virtual_chunks,
+    or enough local tokens per seq shard)."""
+    g = adaptive_parallel_factor(n_devices, divides)
+    if cap is None or g <= cap:
+        return g
+    if cap < 1:
+        raise ValueError(f"axis cap must be >= 1, got {cap}")
+    return max(f for f in range(1, cap + 1) if g % f == 0)
+
+
+class ElasticMoETrainer(ElasticTrainer):
+    """Elastic expert-parallel training: the (data, expert) mesh re-shapes
+    with membership. On every re-mesh the expert axis becomes the largest
+    size dividing both ``n_experts`` and the live device count, so the
+    SAME experts redistribute over fewer/more devices: expert-sharded
+    leaves ((E, ...) stacked, ``ep_param_specs``) snapshot as global host
+    arrays and re-place onto the new axis — 2 experts/device at ep=4 can
+    become 4/device at ep=2 and back, with routing unchanged (capacity is
+    computed per LOCAL tokens, so ample ``capacity_factor`` keeps the step
+    partition-independent — the continuation oracle in the tests)."""
+
+    def __init__(
+        self,
+        devices_by_node: Mapping[int, Sequence[jax.Device]],
+        *,
+        n_experts: int = 4,
+        max_ep: int | None = None,
+        detector: PhiAccrualFailureDetector | None = None,
+        min_nodes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        **trainer_kwargs,
+    ) -> None:
+        from akka_allreduce_tpu.train.moe import MoETrainer
+
+        def mesh_factory(*, devices):
+            n = len(devices)
+            ep = _capped_factor(n, n_experts, max_ep)
+            return jax.make_mesh(
+                (n // ep, ep), ("data", "expert"), devices=devices
+            )
+
+        def factory(mesh):
+            return MoETrainer(mesh, n_experts=n_experts, **trainer_kwargs)
+
+        super().__init__(
+            factory,
+            devices_by_node,
+            mesh_factory=mesh_factory,
+            detector=detector,
+            min_nodes=min_nodes,
+            clock=clock,
+        )
+
+
+class ElasticPipelineTrainer(ElasticTrainer):
+    """Elastic pipeline-parallel training: the (data, pipe) mesh re-shapes
+    with membership. Total trunk depth ``n_layers`` is fixed; on re-mesh
+    the stage count becomes the largest size dividing both ``n_layers``
+    and the live device count, and ``layers_per_stage`` re-derives as
+    ``n_layers // stages`` — the same logical layers re-chunk across a
+    different number of stages. State crosses the shape change through the
+    trainer's LOGICAL-layer-order checkpoint protocol (the stacked trunk
+    is (n_layers, ...) regardless of the stage split, and
+    ``restore_checkpoint_state`` applies the NEW trainer's stage
+    permutation), which also makes the re-mesh schedule-portable. With
+    ``schedule='interleaved'``, ``virtual_chunks`` must divide every
+    reachable ``layers_per_stage``; the factory surfaces the trainer's
+    ValueError if a membership change breaks that."""
+
+    def __init__(
+        self,
+        devices_by_node: Mapping[int, Sequence[jax.Device]],
+        *,
+        n_layers: int = 2,
+        microbatches: int = 2,
+        max_pp: int | None = None,
+        detector: PhiAccrualFailureDetector | None = None,
+        min_nodes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        **trainer_kwargs,
+    ) -> None:
+        from akka_allreduce_tpu.train.pipeline import PipelineLMTrainer
+
+        # interleaved needs layers_per_stage divisible by virtual_chunks at
+        # EVERY reachable stage count; exactly the stage counts dividing
+        # n_layers/virtual satisfy that (lps = virtual * (n_layers/virtual)
+        # / pp), so the adaptive factor targets that quotient
+        virtual = max(int(trainer_kwargs.get("virtual_chunks", 1)), 1)
+        if n_layers % virtual:
+            raise ValueError(
+                f"{n_layers=} not divisible by virtual_chunks={virtual}"
+            )
+        pp_divides = n_layers // virtual
+
+        def mesh_factory(*, devices):
+            n = len(devices)
+            pp = _capped_factor(n, pp_divides, max_pp)
+            return jax.make_mesh(
+                (n // pp, pp), ("data", "pipe"), devices=devices
+            )
+
+        def factory(mesh):
+            pp = int(mesh.shape["pipe"])
+            return PipelineLMTrainer(
+                mesh,
+                layers_per_stage=n_layers // pp,
+                microbatches=microbatches,
+                **trainer_kwargs,
+            )
+
+        super().__init__(
+            factory,
+            devices_by_node,
+            mesh_factory=mesh_factory,
+            detector=detector,
+            min_nodes=min_nodes,
+            clock=clock,
+        )
+
+
+class ElasticLongContextTrainer(ElasticTrainer):
+    """Elastic sequence-parallel training: the (data, seq) mesh re-shapes
+    with membership. On re-mesh the seq axis becomes the largest size that
+    divides both ``seq_len`` and the live device count, capped at
+    ``max_sp`` (ring/Ulysses want enough LOCAL tokens per shard to stay
+    compute-bound); each replica's sequence re-splits across the new shard
+    count. Params are replicated (no TP — tensor-parallel elasticity would
+    additionally re-shard heads and is not composed here), so the snapshot
+    crosses any shape change; numerics match continuation to ring-reduce
+    float tolerance (the blockwise softmax reduces in a different block
+    order under a different sp)."""
+
+    def __init__(
+        self,
+        devices_by_node: Mapping[int, Sequence[jax.Device]],
+        *,
+        seq_len: int = 128,
+        max_sp: int | None = None,
+        detector: PhiAccrualFailureDetector | None = None,
+        min_nodes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        **trainer_kwargs,
+    ) -> None:
+        from akka_allreduce_tpu.train.long_context import LongContextTrainer
+
+        def mesh_factory(*, devices):
+            n = len(devices)
+            sp = _capped_factor(n, seq_len, max_sp)
+            return jax.make_mesh(
+                (n // sp, sp), ("data", "seq"), devices=devices
+            )
+
+        def factory(mesh):
+            return LongContextTrainer(mesh, seq_len=seq_len, **trainer_kwargs)
+
+        super().__init__(
+            factory,
+            devices_by_node,
+            mesh_factory=mesh_factory,
+            detector=detector,
+            min_nodes=min_nodes,
+            clock=clock,
+        )
 
 
 class ElasticDPTrainer(ElasticTrainer):

@@ -228,11 +228,14 @@ class TestElasticShardedState:
             i: devs[i * per : (i + 1) * per] for i in range(n)
         }
 
-    def _cycle(self, elastic, factory, batch_for):
+    def _cycle(self, elastic, batch_for, *, expect_shapes=None,
+               shape_attrs=()):
         """Run the 4 -> 3 -> 4 node drop/late-joiner cycle; at each phase,
-        lockstep-compare against a fresh mirror trainer built on the same
-        device set from the same snapshot."""
-        from akka_allreduce_tpu.parallel import line_mesh
+        lockstep-compare against a fresh mirror trainer built from the
+        elastic trainer's own factories and the same snapshot. Optionally
+        assert the adaptive mesh shape per phase (``expect_shapes`` zipped
+        with trainer attributes ``shape_attrs``) and that the LOGICAL model
+        state crosses every re-mesh exactly."""
         from akka_allreduce_tpu.train.checkpoint import Snapshot
 
         now = {"t": 0.0}
@@ -245,7 +248,9 @@ class TestElasticShardedState:
 
         def mirror():
             snap = Snapshot.capture(elastic.trainer)
-            m = factory(line_mesh(devices=elastic._live_devices()))
+            m = elastic.trainer_factory(
+                elastic.mesh_factory(devices=elastic._live_devices())
+            )
             snap.restore_into(m)
             return m
 
@@ -255,19 +260,31 @@ class TestElasticShardedState:
             (list(range(4)), 4),  # late joiner returns
         ]
         seed = 0
-        for alive, want_nodes in phases:
+        params_before = None
+        for i, (alive, want_nodes) in enumerate(phases):
             # several silent polls so the phi detector trips (or heals)
             for _ in range(8):
                 advance_and_heartbeat(alive)
-                elastic.poll()
+                remeshed = elastic.poll()
+                if remeshed and params_before is not None:
+                    # logical state crossed the shape change exactly
+                    np.testing.assert_array_equal(
+                        elastic.get_flat_params(), params_before
+                    )
             assert elastic.n_nodes == want_nodes, (alive, elastic.n_nodes)
+            if expect_shapes is not None:
+                got = tuple(
+                    getattr(elastic.trainer, a) for a in shape_attrs
+                )
+                assert got == expect_shapes[i], (got, expect_shapes[i])
             m = mirror()
             for _ in range(2):
-                x, y = batch_for(elastic.n_devices, seed)
+                x, y = batch_for(elastic.trainer, seed)
                 seed += 1
                 a = elastic.train_step(x, y)
                 b = m.train_step(x, y)
                 assert abs(a.loss - b.loss) < 1e-6, (a.loss, b.loss)
+            params_before = elastic.get_flat_params().copy()
         assert elastic.generation == 2
         return elastic
 
@@ -289,11 +306,13 @@ class TestElasticShardedState:
 
         ds = data.mnist_like()
 
-        def batch_for(n_devices, seed):
-            return next(iter(ds.batches(n_devices * 4, 1, seed_offset=seed)))
+        def batch_for(trainer, seed):
+            return next(
+                iter(ds.batches(trainer.n_devices * 4, 1, seed_offset=seed))
+            )
 
         e = ElasticTrainer(factory, self._nodes())
-        e = self._cycle(e, factory, batch_for)
+        e = self._cycle(e, batch_for)
         # moments are sharded over the CURRENT 8-device mesh again
         for leaf in jax.tree.leaves(e.trainer.opt_state):
             if np.asarray(leaf).ndim > 0:
@@ -301,6 +320,124 @@ class TestElasticShardedState:
                     leaf.addressable_shards[0].data.shape[0] * 8
                     == leaf.shape[0]
                 )
+
+    def test_moe_drop_and_rejoin(self):
+        """Elastic EP (VERDICT r3 next-round #1): the expert axis re-shapes
+        4 -> 2 -> 4 as the device count goes 8 -> 6 -> 8; the SAME experts
+        redistribute (2/shard -> 2·2/shard -> back), logical params survive
+        every re-mesh exactly, and each phase continues in lockstep with a
+        fresh same-geometry trainer restored from the same snapshot."""
+        from akka_allreduce_tpu.train import ElasticMoETrainer
+
+        e = ElasticMoETrainer(
+            self._nodes(),
+            n_experts=4,
+            vocab=16,
+            d_model=32,
+            n_heads=2,
+            n_layers=2,
+            seq_len=32,
+            capacity_factor=4.0,  # ample: step is partition-independent
+            learning_rate=1e-2,
+            seed=0,
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+
+        def batch_for(trainer, seed):
+            rows = trainer.dp * trainer.ep
+            return next(ds.batches(rows, 1, seed_offset=seed))
+
+        expect_shapes = [(2, 4), (3, 2), (2, 4)]  # (dp, ep) per phase
+        self._cycle(e, batch_for, expect_shapes=expect_shapes,
+                    shape_attrs=("dp", "ep"))
+        # expert-stacked leaves are sharded 1/4 over the restored mesh
+        w = e.trainer.params["params"]["MoEBlock_0"]["moe_w1"]
+        assert w.shape[0] == 4  # (E, ...) stacked
+        assert w.addressable_shards[0].data.shape[0] == 1
+
+    def test_pipeline_drop_and_rejoin(self):
+        """Elastic PP: 4 stages x 1 layer -> 2 stages x 2 layers -> back,
+        crossing the shape change through the logical-layer-order
+        checkpoint protocol; logical params identical across each re-mesh."""
+        from akka_allreduce_tpu.train import ElasticPipelineTrainer
+
+        e = ElasticPipelineTrainer(
+            self._nodes(),
+            n_layers=4,
+            microbatches=2,
+            vocab=16,
+            d_model=32,
+            n_heads=2,
+            seq_len=32,
+            learning_rate=1e-2,
+            seed=0,
+            schedule="1f1b",
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+
+        def batch_for(trainer, seed):
+            rows = trainer.dp * trainer.microbatches
+            return next(ds.batches(rows, 1, seed_offset=seed))
+
+        expect_shapes = [(2, 4), (3, 2), (2, 4)]  # (dp, pp) per phase
+        self._cycle(e, batch_for, expect_shapes=expect_shapes,
+                    shape_attrs=("dp", "stages"))
+        assert e.trainer.n_layers == 4 and e.trainer.stages == 4
+
+    def test_pipeline_interleaved_survives_remesh(self):
+        """The interleaved schedule's virtual chunks survive a stage-count
+        change when they divide every reachable layers_per_stage (8 layers:
+        4 stages x 2 -> 2 stages x 4, virtual=2 divides both)."""
+        from akka_allreduce_tpu.train import ElasticPipelineTrainer
+
+        e = ElasticPipelineTrainer(
+            self._nodes(),
+            n_layers=8,
+            microbatches=2,
+            vocab=16,
+            d_model=16,
+            n_heads=2,
+            seq_len=16,
+            seed=0,
+            schedule="interleaved",
+            virtual_chunks=2,
+        )
+        ds = data.lm_copy_task(16, vocab=16)
+
+        def batch_for(trainer, seed):
+            rows = trainer.dp * trainer.microbatches
+            return next(ds.batches(rows, 1, seed_offset=seed))
+
+        expect_shapes = [(2, 4), (3, 2), (2, 4)]
+        self._cycle(e, batch_for, expect_shapes=expect_shapes,
+                    shape_attrs=("dp", "stages"))
+        assert e.trainer.schedule == "interleaved"
+
+    def test_long_context_drop_and_rejoin(self):
+        """Elastic SP: the seq axis re-splits 4 -> 2 -> 4 with membership
+        (max_sp=4 keeps local shards non-trivial); params are replicated so
+        the snapshot crosses any shape."""
+        from akka_allreduce_tpu.train import ElasticLongContextTrainer
+
+        e = ElasticLongContextTrainer(
+            self._nodes(),
+            seq_len=32,
+            max_sp=4,
+            vocab=16,
+            d_model=32,
+            n_heads=2,
+            n_layers=2,
+            learning_rate=1e-2,
+            seed=0,
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+
+        def batch_for(trainer, seed):
+            return next(ds.batches(trainer.dp * 2, 1, seed_offset=seed))
+
+        expect_shapes = [(2, 4), (3, 2), (2, 4)]  # (dp, sp) per phase
+        self._cycle(e, batch_for, expect_shapes=expect_shapes,
+                    shape_attrs=("dp", "sp"))
 
     def test_fsdp_drop_and_rejoin(self):
         import optax
@@ -321,11 +458,11 @@ class TestElasticShardedState:
 
         ds = data.lm_copy_task(32, vocab=16)
 
-        def batch_for(n_devices, seed):
-            return next(ds.batches(n_devices, 1, seed_offset=seed))
+        def batch_for(trainer, seed):
+            return next(ds.batches(trainer.n_devices, 1, seed_offset=seed))
 
         e = ElasticTrainer(factory, self._nodes())
-        e = self._cycle(e, factory, batch_for)
+        e = self._cycle(e, batch_for)
         # trunk re-sharded 1/8 on the restored full mesh
         for leaf in jax.tree.leaves(e.trainer.params["trunk"]):
             assert leaf.addressable_shards[0].data.shape[1] * 8 == leaf.shape[1]
