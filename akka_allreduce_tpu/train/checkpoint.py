@@ -85,6 +85,25 @@ def _restore_ef(trainer, ef) -> None:
     trainer._ef = jax.device_put(ef, trainer._data_sharding)
 
 
+def capture_state(trainer) -> tuple[dict, bool]:
+    """``(state, custom)``: the serializable state tree of ``trainer`` under
+    either checkpoint protocol — the ONE place that knows what trainer
+    state consists of. ``custom=True`` means the tree came from the
+    trainer-defined ``checkpoint_state()`` (ZeRO-1 / FSDP / Pipeline, host
+    numpy, mesh-size-independent); otherwise it is the live
+    ``{params, opt_state[, ef]}`` device pytree. Every checkpoint path
+    (sync / async / delta / Snapshot) captures through here, so a new
+    piece of trainer state is added exactly once."""
+    if hasattr(trainer, "checkpoint_state"):
+        return dict(trainer.checkpoint_state()), True
+    state = {"params": trainer.params, "opt_state": trainer.opt_state}
+    if getattr(trainer, "_ef", None) is not None:
+        # error-feedback residual is training state: dropping it on
+        # restart would permanently lose every withheld gradient
+        state["ef"] = trainer._ef
+    return state, False
+
+
 @dataclasses.dataclass
 class Snapshot:
     """In-memory (host RAM) snapshot of trainer state for fast re-mesh resume.
@@ -109,23 +128,20 @@ class Snapshot:
 
     @classmethod
     def capture(cls, trainer) -> "Snapshot":
-        if hasattr(trainer, "checkpoint_state"):
-            state = jax.tree.map(
-                lambda x: np.asarray(x), dict(trainer.checkpoint_state())
-            )
+        state, custom = capture_state(trainer)
+        host = jax.tree.map(np.asarray, state)
+        if custom:
             return cls(
                 params=None,
                 opt_state=None,
                 step=trainer.step_num,
-                custom=state,
+                custom=host,
             )
-        host = lambda t: jax.tree.map(lambda x: np.asarray(x), t)
-        ef = getattr(trainer, "_ef", None)
         return cls(
-            params=host(trainer.params),
-            opt_state=host(trainer.opt_state),
+            params=host["params"],
+            opt_state=host["opt_state"],
             step=trainer.step_num,
-            ef=None if ef is None else np.asarray(ef),
+            ef=host.get("ef"),
         )
 
     def restore_into(self, trainer) -> None:
@@ -171,25 +187,13 @@ class TrainerCheckpointer:
             ),
         )
 
-    def save(self, trainer, *, force: bool = False) -> bool:
+    def save(self, trainer, *, force: bool = False, block: bool = True) -> bool:
+        # ``block`` exists for signature parity with the async subclass —
+        # this save is synchronous regardless
         if trainer.step_num in self._mgr.all_steps():
             return False  # this step is already durable; nothing to do
-        if hasattr(trainer, "checkpoint_state"):
-            # trainer-defined serialization (e.g. ZeRO-1's flat weights +
-            # sharded optimizer state, which don't fit the params/opt_state
-            # pytree shape)
-            state = dict(trainer.checkpoint_state())
-            state["step"] = trainer.step_num
-        else:
-            state = {
-                "params": trainer.params,
-                "opt_state": trainer.opt_state,
-                "step": trainer.step_num,
-            }
-            if getattr(trainer, "_ef", None) is not None:
-                # error-feedback residual is training state: dropping it on
-                # restart would permanently lose every withheld gradient
-                state["ef"] = trainer._ef
+        state, _ = capture_state(trainer)
+        state["step"] = trainer.step_num
         saved = self._mgr.save(
             trainer.step_num, args=ocp.args.StandardSave(state), force=force
         )
@@ -286,3 +290,307 @@ class TrainerCheckpointer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class DeltaCheckpointer:
+    """Per-leaf, content-addressed checkpoints: a save writes only the
+    leaves whose bytes CHANGED since any kept checkpoint (VERDICT r3
+    next-round #2, "optional per-leaf delta saves" — size checkpoints to
+    the link).
+
+    Layout: ``blobs/<sha256>.npy`` holds each distinct leaf content once;
+    ``manifest_<step>.json`` maps leaf paths to blob hashes. Unchanged
+    leaves (frozen embeddings, converged moments, a quiet EF residual, the
+    weights themselves when saving more often than they change) cost one
+    hash, zero bytes on the wire/disk. Pruning drops manifests beyond
+    ``max_to_keep`` and any blob no kept manifest references.
+
+    Works with both state protocols (the params/opt_state pytree and the
+    trainer-defined ``checkpoint_state``); restore places leaves through
+    the same machinery as :class:`TrainerCheckpointer`.
+    """
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3) -> None:
+        self.directory = Path(directory).absolute()
+        self.blobs = self.directory / "blobs"
+        self.blobs.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+
+    # -- tree <-> {path: leaf} -----------------------------------------------
+
+    @staticmethod
+    def _flatten(state) -> dict:
+        import jax.tree_util as jtu
+
+        return {
+            jtu.keystr(path): leaf
+            for path, leaf in jtu.tree_leaves_with_path(state)
+        }
+
+    def _capture(self, trainer) -> tuple[dict, bool]:
+        state, custom = capture_state(trainer)
+        return jax.tree.map(np.asarray, state), custom
+
+    # -- save ----------------------------------------------------------------
+
+    def _manifests(self) -> dict[int, Path]:
+        out = {}
+        for f in self.directory.glob("manifest_*.json"):
+            try:
+                out[int(f.stem.split("_", 1)[1])] = f
+            except ValueError:
+                continue
+        return out
+
+    def latest_step(self) -> int | None:
+        steps = self._manifests()
+        return max(steps) if steps else None
+
+    def save(self, trainer) -> dict:
+        """Write a delta checkpoint; returns ``{written_bytes,
+        reused_bytes, written_leaves, reused_leaves}`` so callers can see
+        the delta actually saving bytes."""
+        import hashlib
+        import json
+
+        host, custom = self._capture(trainer)
+        flat = self._flatten(host)
+        manifest = {
+            "step": int(trainer.step_num),
+            "custom": custom,
+            "leaves": {},
+        }
+        stats = dict(
+            written_bytes=0, reused_bytes=0, written_leaves=0, reused_leaves=0
+        )
+        for key, leaf in flat.items():
+            arr = np.asarray(leaf)
+            sha = hashlib.sha256(
+                arr.tobytes() + str((arr.dtype, arr.shape)).encode()
+            ).hexdigest()
+            blob = self.blobs / f"{sha}.npy"
+            if blob.exists():
+                stats["reused_bytes"] += arr.nbytes
+                stats["reused_leaves"] += 1
+            else:
+                tmp = blob.with_suffix(".tmp")
+                with open(tmp, "wb") as f:  # np.save(path) appends .npy
+                    np.save(f, arr, allow_pickle=False)
+                tmp.replace(blob)  # atomic publish
+                stats["written_bytes"] += arr.nbytes
+                stats["written_leaves"] += 1
+            manifest["leaves"][key] = sha
+        tmp = self.directory / f".manifest_{trainer.step_num}.tmp"
+        tmp.write_text(json.dumps(manifest))
+        # atomic rename: a crash mid-save leaves old manifests + maybe some
+        # orphan blobs, never a torn manifest
+        tmp.replace(self.directory / f"manifest_{trainer.step_num}.json")
+        self._prune()
+        return stats
+
+    def _prune(self) -> None:
+        import json
+
+        manifests = self._manifests()
+        for step in sorted(manifests)[: -self.max_to_keep]:
+            manifests.pop(step).unlink()
+        live = set()
+        for f in manifests.values():
+            live.update(json.loads(f.read_text())["leaves"].values())
+        for blob in self.blobs.glob("*.npy"):
+            if blob.stem not in live:
+                blob.unlink()
+        # a crash between tmp write and atomic publish leaves orphans a
+        # normal prune would never reclaim — sweep them here (the current
+        # save has already published its blobs by the time prune runs)
+        for stale in self.blobs.glob("*.tmp"):
+            stale.unlink()
+        for stale in self.directory.glob(".manifest_*.tmp"):
+            stale.unlink()
+
+    # -- restore -------------------------------------------------------------
+
+    def restore(self, trainer, step: int | None = None) -> int:
+        import json
+
+        import jax.tree_util as jtu
+
+        manifests = self._manifests()
+        step = max(manifests) if step is None and manifests else step
+        if step is None or step not in manifests:
+            raise FileNotFoundError(
+                f"no delta checkpoint for step {step} under {self.directory}"
+            )
+        manifest = json.loads(manifests[step].read_text())
+        leaves = manifest["leaves"]
+
+        def load(path, _template):
+            key = jtu.keystr(path)
+            if key not in leaves:
+                raise KeyError(
+                    f"checkpoint at step {step} has no leaf {key!r} (trainer "
+                    "structure mismatch)"
+                )
+            return np.load(self.blobs / f"{leaves[key]}.npy", allow_pickle=False)
+
+        if manifest["custom"]:
+            if not hasattr(trainer, "restore_checkpoint_state"):
+                raise TypeError(
+                    "delta checkpoint was captured through a trainer-defined "
+                    "checkpoint protocol; the restore target has none"
+                )
+            template_fn = getattr(
+                trainer, "checkpoint_template", trainer.checkpoint_state
+            )
+            state = jtu.tree_map_with_path(load, dict(template_fn()))
+            trainer.restore_checkpoint_state(state)
+        else:
+            target = {"params": trainer.params, "opt_state": trainer.opt_state}
+            has_ef = getattr(trainer, "_ef", None) is not None
+            if has_ef and any(k.startswith("['ef']") for k in leaves):
+                target["ef"] = trainer._ef
+            state = jtu.tree_map_with_path(load, target)
+            p_sh, o_sh = state_shardings(trainer)
+            trainer.params = place_on(state["params"], p_sh)
+            trainer.opt_state = place_on(state["opt_state"], o_sh)
+            if "ef" in state:
+                _restore_ef(trainer, state["ef"])
+        trainer.step_num = int(manifest["step"])
+        return trainer.step_num
+
+
+class AsyncTrainerCheckpointer(TrainerCheckpointer):
+    """Checkpoints that do not stall the step loop (VERDICT r3 next-round
+    #2: "checkpoint cost is part of the recovery story").
+
+    ``save`` splits into a cheap capture phase in the step gap and a
+    background phase off-thread:
+
+    - **pytree-state trainers** (DP / MoE / Pipeline-less LM / LongContext):
+      capture = ONE on-device copy of the state (HBM-to-HBM, microseconds
+      to milliseconds) + launching ``copy_to_host_async`` on every leaf.
+      The training loop resumes immediately and keeps donating its own
+      buffers — the copy is independent — while the device-to-host
+      transfer (minutes for the 4.8 GB flagship state over a tunneled
+      link) overlaps the subsequent steps. The background thread blocks on
+      the transfers and then runs the Orbax write.
+    - **trainers with the custom checkpoint protocol** (ZeRO-1, FSDP,
+      Pipeline): ``checkpoint_state()`` gathers to host numpy itself, so
+      the capture phase pays that gather synchronously; only the disk
+      serialization moves off-thread. (Their gathers reshard 1/n state —
+      an async rework belongs to the trainers, not this wrapper.)
+
+    Crash safety: the background write goes through the same Orbax
+    manager, which finalizes each step directory atomically — a crash
+    mid-save leaves the previous checkpoint as ``latest_step`` and the
+    partial step invisible to restore (tested by SIGKILLing a writer
+    mid-save in tests/test_checkpoint.py).
+
+    One save is in flight at a time: a ``save`` while busy returns False
+    (callers keep training and retry next interval) unless ``block=True``.
+    Restores and ``close`` drain the in-flight save first.
+    """
+
+    def __init__(self, directory, *, max_to_keep: int = 3) -> None:
+        super().__init__(directory, max_to_keep=max_to_keep)
+        import threading
+
+        self._lock = threading.Lock()  # serializes Orbax manager access
+        self._inflight: "threading.Thread | None" = None
+        self._errors: list = []
+
+    # -- capture -------------------------------------------------------------
+
+    @staticmethod
+    def _device_copy(tree):
+        """Donation-proof on-device copy: new buffers with the same
+        shardings, so the training loop's donated originals can die while
+        the copy's device-to-host transfer is still in flight."""
+        import jax.numpy as jnp
+
+        def copy_leaf(x):
+            if isinstance(x, jax.Array):
+                y = jnp.copy(x)
+                y.copy_to_host_async()
+                return y
+            return x
+
+        return jax.tree.map(copy_leaf, tree)
+
+    def _drain(self) -> None:
+        t = self._inflight
+        if t is not None:
+            t.join()
+            self._inflight = None
+        if self._errors:
+            err = self._errors[:]
+            self._errors.clear()
+            raise RuntimeError(f"background checkpoint save failed: {err[0]}")
+
+    def busy(self) -> bool:
+        t = self._inflight
+        if t is not None and not t.is_alive():
+            self._drain()  # reap + surface any background error
+        return self._inflight is not None
+
+    def save(self, trainer, *, force: bool = False, block: bool = False) -> bool:
+        import threading
+
+        if self.busy():
+            if not block:
+                return False
+            self._drain()
+        with self._lock:
+            if trainer.step_num in self._mgr.all_steps():
+                return False
+        step = trainer.step_num
+        state, custom = capture_state(trainer)
+        state["step"] = step
+        if custom:
+            # custom protocol: the gather inside checkpoint_state was the
+            # synchronous part; hand the host tree straight to the writer
+            captured = state
+        else:
+            captured = self._device_copy(state)
+
+        def write():
+            try:
+                host = jax.tree.map(
+                    lambda x: np.asarray(x)
+                    if isinstance(x, (jax.Array, np.ndarray))
+                    else x,
+                    captured,
+                )
+                with self._lock:
+                    self._mgr.save(
+                        step, args=ocp.args.StandardSave(host), force=force
+                    )
+                    self._mgr.wait_until_finished()
+            except Exception as e:  # surfaced on the next save/drain
+                self._errors.append(e)
+
+        t = threading.Thread(target=write, name=f"ckpt-save-{step}", daemon=True)
+        self._inflight = t
+        t.start()
+        if block:
+            self._drain()
+        return True
+
+    def wait_until_finished(self) -> None:
+        """Block until the in-flight save (if any) is durable; re-raise a
+        background failure."""
+        self._drain()
+
+    def restore(self, trainer, step: int | None = None) -> int:
+        self._drain()  # a restore must see the freshest durable step
+        return super().restore(trainer, step)
+
+    def latest_step(self) -> int | None:
+        with self._lock:
+            return self._mgr.latest_step()
+
+    def close(self) -> None:
+        try:
+            self._drain()
+        finally:
+            super().close()

@@ -250,3 +250,270 @@ class TestErrorFeedbackCheckpoint:
             rtol=1e-5,
             atol=1e-7,
         )
+
+
+class TestAsyncCheckpointer:
+    """Async, non-stalling saves (VERDICT r3 next-round #2): capture is an
+    on-device copy + async device-to-host launch; serialization runs
+    off-thread; training keeps stepping (and donating its buffers) while
+    the save is in flight. Crash mid-save must leave the previous
+    checkpoint intact."""
+
+    def test_state_is_capture_time_not_write_time(self, tmp_path):
+        from akka_allreduce_tpu.train import AsyncTrainerCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 2))
+        ref = t.get_flat_params().copy()
+        with AsyncTrainerCheckpointer(tmp_path / "a") as ckpt:
+            assert ckpt.save(t)
+            # training continues immediately; step buffers are donated,
+            # which must not corrupt the in-flight copy
+            t.train(ds.batches(32, 3, seed_offset=5))
+            assert not np.allclose(t.get_flat_params(), ref)
+            ckpt.wait_until_finished()
+            fresh = make_trainer(line_mesh(8), seed=3)
+            step = ckpt.restore(fresh)
+        assert step == 2
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+
+    def test_second_save_skipped_while_busy(self, tmp_path, monkeypatch):
+        import threading
+
+        from akka_allreduce_tpu.train import AsyncTrainerCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        with AsyncTrainerCheckpointer(tmp_path / "b") as ckpt:
+            # hold the background write at a gate so busy() is deterministic
+            gate = threading.Event()
+            real_save = ckpt._mgr.save
+
+            def slow_save(*a, **k):
+                assert gate.wait(30)
+                return real_save(*a, **k)
+
+            monkeypatch.setattr(ckpt._mgr, "save", slow_save)
+            assert ckpt.save(t)
+            t.train(ds.batches(32, 1, seed_offset=1))
+            assert not ckpt.save(t)  # busy -> skipped, not queued
+            gate.set()
+            ckpt.wait_until_finished()
+            assert ckpt.latest_step() == 1
+            # not busy anymore: the next interval's save goes through
+            assert ckpt.save(t, block=True)
+            assert ckpt.latest_step() == 2
+
+    def test_custom_protocol_trainer_async(self, tmp_path):
+        from akka_allreduce_tpu.models import MLP
+        from akka_allreduce_tpu.train import (
+            AsyncTrainerCheckpointer,
+            Zero1DPTrainer,
+        )
+
+        t = Zero1DPTrainer(
+            MLP(hidden=(16,), classes=10),
+            line_mesh(8),
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.adam(1e-3),
+            seed=0,
+        )
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(32, 1)))
+        t.train_step(x, y)
+        ref = t.get_flat_params().copy()
+        with AsyncTrainerCheckpointer(tmp_path / "z") as ckpt:
+            assert ckpt.save(t)
+            t.train_step(x, y)  # keep going while the write runs
+            ckpt.wait_until_finished()
+            fresh = Zero1DPTrainer(
+                MLP(hidden=(16,), classes=10),
+                line_mesh(8),
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.adam(1e-3),
+                seed=7,
+            )
+            assert ckpt.restore(fresh) == 1
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+
+    def test_background_failure_surfaces(self, tmp_path, monkeypatch):
+        from akka_allreduce_tpu.train import AsyncTrainerCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        ckpt = AsyncTrainerCheckpointer(tmp_path / "f")
+        monkeypatch.setattr(
+            ckpt._mgr, "save",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+        )
+        assert ckpt.save(t)
+        with pytest.raises(RuntimeError, match="disk full"):
+            ckpt.wait_until_finished()
+
+    def test_crash_mid_save_preserves_old_checkpoint(self, tmp_path):
+        """SIGKILL a writer process mid-save: the previous step must stay
+        the latest durable checkpoint and restore cleanly (Orbax finalizes
+        step directories atomically)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+        import time as _time
+
+        d = tmp_path / "crash"
+        script = textwrap.dedent(f"""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np, optax, jax
+            jax.config.update("jax_platforms", "cpu")
+            from akka_allreduce_tpu.models import MLP, data
+            from akka_allreduce_tpu.parallel import line_mesh
+            from akka_allreduce_tpu.train import (
+                AsyncTrainerCheckpointer, DPTrainer,
+            )
+            t = DPTrainer(
+                MLP(hidden=(256, 256), classes=10), line_mesh(1),
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.adam(1e-3), seed=0,
+            )
+            ds = data.mnist_like()
+            t.train(ds.batches(8, 1))
+            ckpt = AsyncTrainerCheckpointer({str(d)!r})
+            ckpt.save(t, block=True)   # step 1: durable baseline
+            t.train(ds.batches(8, 1, seed_offset=1))
+            ckpt.save(t)               # step 2: async, about to be killed
+            print("SAVING", flush=True)
+            import time; time.sleep(30)
+        """)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        try:
+            line = proc.stdout.readline().decode()
+            assert "SAVING" in line, line
+            # kill while the step-2 write is (likely) in flight
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        _time.sleep(0.2)
+        ckpt = TrainerCheckpointer(d)
+        latest = ckpt.latest_step()
+        assert latest is not None, "baseline checkpoint lost"
+        fresh = DPTrainer_for_crash_test()
+        step = ckpt.restore(fresh, latest)
+        assert step == latest >= 1
+        assert np.isfinite(fresh.get_flat_params()).all()
+
+
+def DPTrainer_for_crash_test():
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.train import DPTrainer
+
+    return DPTrainer(
+        MLP(hidden=(256, 256), classes=10),
+        line_mesh(1),
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        optimizer=optax.adam(1e-3),
+        seed=5,
+    )
+
+
+class TestDeltaCheckpointer:
+    """Per-leaf content-addressed delta saves: unchanged leaves cost zero
+    bytes, blobs dedupe across steps, pruning drops unreferenced blobs."""
+
+    def test_roundtrip_and_dedup(self, tmp_path):
+        from akka_allreduce_tpu.train import DeltaCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        store = DeltaCheckpointer(tmp_path / "d")
+        s1 = store.save(t)
+        assert s1["written_leaves"] > 0 and s1["reused_leaves"] == 0
+        ref = t.get_flat_params().copy()
+
+        # an IDENTICAL immediate re-save reuses every blob
+        s2 = store.save(t)
+        assert s2["written_bytes"] == 0
+        assert s2["reused_leaves"] == s1["written_leaves"]
+
+        # another step changes params + both adam moments, but count-like
+        # scalars and unchanged leaves still dedupe partially or fully;
+        # at minimum the manifest-level roundtrip must hold
+        t.train(ds.batches(32, 1, seed_offset=1))
+        store.save(t)
+        fresh = make_trainer(line_mesh(8), seed=3)
+        assert store.restore(fresh, 1) == 1
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+
+    def test_partial_change_writes_only_delta(self, tmp_path):
+        from akka_allreduce_tpu.train import DeltaCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        store = DeltaCheckpointer(tmp_path / "p")
+        store.save(t)
+        # mutate ONE leaf only (a frozen-most-of-the-model scenario)
+        import jax
+
+        leaves, treedef = jax.tree.flatten(t.params)
+        leaves[0] = leaves[0] + 1.0
+        t.params = jax.tree.unflatten(treedef, leaves)
+        t.step_num += 1
+        s = store.save(t)
+        assert s["written_leaves"] == 1, s
+        assert s["reused_leaves"] > 0
+
+    def test_prune_drops_unreferenced_blobs(self, tmp_path):
+        from akka_allreduce_tpu.train import DeltaCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        store = DeltaCheckpointer(tmp_path / "k", max_to_keep=2)
+        for i in range(4):
+            t.train(ds.batches(32, 1, seed_offset=i))
+            store.save(t)
+        steps = sorted(store._manifests())
+        assert steps == [3, 4]
+        # every kept blob is referenced by a kept manifest
+        import json
+
+        live = set()
+        for f in store._manifests().values():
+            live.update(json.loads(f.read_text())["leaves"].values())
+        on_disk = {b.stem for b in store.blobs.glob("*.npy")}
+        assert on_disk == live
+
+    def test_custom_protocol_trainer(self, tmp_path):
+        from akka_allreduce_tpu.models import MLP
+        from akka_allreduce_tpu.train import DeltaCheckpointer, Zero1DPTrainer
+
+        def mk(seed):
+            return Zero1DPTrainer(
+                MLP(hidden=(16,), classes=10),
+                line_mesh(8),
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.adam(1e-3),
+                seed=seed,
+            )
+
+        t = mk(0)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(32, 1)))
+        t.train_step(x, y)
+        ref = t.get_flat_params().copy()
+        store = DeltaCheckpointer(tmp_path / "z")
+        store.save(t)
+        fresh = mk(7)
+        assert store.restore(fresh) == 1
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
