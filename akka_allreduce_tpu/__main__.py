@@ -1656,7 +1656,9 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
     base = [step() for _ in range(args.baseline_steps)]
     base_ms = statistics.median(base) * 1e3
 
-    d = args.dir or tempfile.mkdtemp(prefix="ckpt_bench_")
+    # always a FRESH subdir: re-running against an existing directory would
+    # hit the step-dedup early return and measure no save at all
+    d = tempfile.mkdtemp(prefix="ckpt_bench_", dir=args.dir)
     sync_s = None
     if not args.skip_sync:
         with TrainerCheckpointer(f"{d}/sync") as ck:

@@ -329,6 +329,14 @@ class DeltaCheckpointer:
 
     def _capture(self, trainer) -> tuple[dict, bool]:
         state, custom = capture_state(trainer)
+        for leaf in jax.tree.leaves(state):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                raise NotImplementedError(
+                    "DeltaCheckpointer is a per-host store; state sharded "
+                    "over a mesh that spans OS processes cannot be "
+                    "host-gathered here — use TrainerCheckpointer (Orbax "
+                    "coordinates cross-process saves) on pod meshes"
+                )
         return jax.tree.map(np.asarray, state), custom
 
     # -- save ----------------------------------------------------------------
@@ -365,9 +373,19 @@ class DeltaCheckpointer:
         )
         for key, leaf in flat.items():
             arr = np.asarray(leaf)
-            sha = hashlib.sha256(
-                arr.tobytes() + str((arr.dtype, arr.shape)).encode()
-            ).hexdigest()
+            # hash the raw buffer via memoryview (no tobytes copy — the
+            # all-leaves-unchanged case this store optimizes would
+            # otherwise double host memory traffic). NB ascontiguousarray
+            # promotes 0-d to 1-d, so only use it as a hashing VIEW and
+            # save the original
+            buf = (
+                arr
+                if arr.flags["C_CONTIGUOUS"]
+                else np.ascontiguousarray(arr)
+            )
+            h = hashlib.sha256(str((arr.dtype, arr.shape)).encode())
+            h.update(buf.data)
+            sha = h.hexdigest()
             blob = self.blobs / f"{sha}.npy"
             if blob.exists():
                 stats["reused_bytes"] += arr.nbytes
@@ -545,6 +563,18 @@ class AsyncTrainerCheckpointer(TrainerCheckpointer):
                 return False
         step = trainer.step_num
         state, custom = capture_state(trainer)
+        if not all(
+            x.is_fully_addressable
+            for x in jax.tree.leaves(state)
+            if isinstance(x, jax.Array)
+        ):
+            # a mesh spanning OS processes: Orbax's cross-process save
+            # coordinates ALL processes, and per-process background threads
+            # can disagree on busy-skip (one process skips while another
+            # enters the barrier — deadlock). Take the multihost-aware
+            # synchronous path instead; async capture stays a
+            # single-controller optimization.
+            return super().save(trainer, force=force)
         state["step"] = step
         if custom:
             # custom protocol: the gather inside checkpoint_state was the

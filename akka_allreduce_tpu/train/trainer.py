@@ -133,9 +133,10 @@ def place_batch(x, y, n_devices: int, data_sharding):
     runtime — the sharding's mesh spans OS processes): each process passes
     its HOST-LOCAL rows and they assemble into one global sharded batch via
     ``parallel.multihost.host_local_to_global`` — the pod form of the
-    reference's per-worker dataSource pull (SURVEY.md §4.4). train_step and
-    accuracy ride this seam on every trainer that uses it;
-    ``train_step_accum``'s microbatch layout does not (it guards).
+    reference's per-worker dataSource pull (SURVEY.md §4.4). train_step,
+    accuracy, and ``train_step_accum`` (which builds its
+    (devices·accum, micro, ...) layout host-locally per process) all ride
+    this seam.
     """
     if not data_sharding.is_fully_addressable:
         # the mesh spans OS processes (a fully-local mesh — e.g. a
@@ -711,38 +712,66 @@ class DPTrainer:
                 "per-leaf collectives could never run behind the backward; "
                 "use the accumulation path without overlap"
             )
-        n = self.n_devices * accum_steps
-        if x.shape[0] % n:
-            raise ValueError(
-                f"global batch {x.shape[0]} not divisible by "
-                f"{self.n_devices} devices x {accum_steps} accumulation steps"
-            )
         if accum_steps not in self._accum_steps_fns:
             self._accum_steps_fns[accum_steps] = self._build_accum_step(
                 accum_steps
             )
-        micro = x.shape[0] // n
-        # (global_batch, ...) -> (n_dev*accum, micro, ...): the data sharding
-        # splits the leading axis, so device d gets its contiguous
-        # (accum, micro, ...) block — the same rows train_step would give it
-        def rearrange(a):
-            a = np.asarray(a)
-            return a.reshape(n, micro, *a.shape[1:])
-
-        if not self._data_sharding.is_fully_addressable:
-            raise NotImplementedError(
-                "train_step_accum is single-controller only: the microbatch "
-                "rearrange places a (devices, accum*micro, ...) layout with "
-                "a plain device_put, which a pod mesh cannot accept; use "
-                "train_step (whose placement seam is pod-aware) per "
-                "microbatch instead"
-            )
+        sh = self._data_sharding
         valid_arr = self._normalize_valid(valid)
-        xd = jax.device_put(
-            rearrange(np.asarray(x, np.float32)), self._data_sharding
-        )
-        yd = jax.device_put(rearrange(np.asarray(y, np.int32)), self._data_sharding)
-        vd = place_mask(valid_arr, self._data_sharding)
+        if sh.is_fully_addressable:
+            n = self.n_devices * accum_steps
+            if x.shape[0] % n:
+                raise ValueError(
+                    f"global batch {x.shape[0]} not divisible by "
+                    f"{self.n_devices} devices x {accum_steps} accumulation "
+                    "steps"
+                )
+            micro = x.shape[0] // n
+            # (global_batch, ...) -> (n_dev*accum, micro, ...): the data
+            # sharding splits the leading axis, so device d gets its
+            # contiguous (accum, micro, ...) block — the same rows
+            # train_step would give it
+            def rearrange(a, dt):
+                a = np.asarray(a, dt)
+                return a.reshape(n, micro, *a.shape[1:])
+
+            xd = jax.device_put(rearrange(x, np.float32), sh)
+            yd = jax.device_put(rearrange(y, np.int32), sh)
+        else:
+            # pod runtime (VERDICT r3 next-round #3): each process passes
+            # its HOST-LOCAL rows; the (local_devices*accum, micro, ...)
+            # layout is built locally and assembled into the global
+            # microbatch array along the sharded leading axis —
+            # jax.devices() is process-contiguous, so the assembly gives
+            # every device the same contiguous block the single-controller
+            # rearrange would
+            from akka_allreduce_tpu.parallel import multihost
+
+            mesh, spec = sh.mesh, sh.spec
+            pid = jax.process_index()
+            local_share = sum(
+                1 for d in mesh.devices.flat if d.process_index == pid
+            )
+            ln = local_share * accum_steps
+            if local_share == 0 or x.shape[0] % ln:
+                raise ValueError(
+                    f"host-local batch {x.shape[0]} not divisible by this "
+                    f"process's {local_share} mesh devices x {accum_steps} "
+                    "accumulation steps"
+                )
+            micro = x.shape[0] // ln
+
+            def rearrange_local(a, dt):
+                a = np.asarray(a, dt)
+                return a.reshape(ln, micro, *a.shape[1:])
+
+            xd = multihost.host_local_to_global(
+                rearrange_local(x, np.float32), mesh, spec
+            )
+            yd = multihost.host_local_to_global(
+                rearrange_local(y, np.int32), mesh, spec
+            )
+        vd = place_mask(valid_arr, sh)
         fn = self._accum_steps_fns[accum_steps]
         if self.error_feedback:
             self.params, self.opt_state, self._ef, loss, cnt = fn(

@@ -115,6 +115,9 @@ def test_multiprocess_jax_distributed_cpu():
         # the cross-process TRAINING step (DPTrainer + ZeRO-1 on the global
         # mesh vs the valid-subset single-device oracle) also ran
         assert f"MULTIHOST_TRAIN_OK {i}" in out, f"worker {i} output:\n{out}"
+        # gradient accumulation's (devices*accum, micro) layout assembled
+        # from host-local rows across processes (pod accum path)
+        assert f"MULTIHOST_ACCUM_OK {i}" in out, f"worker {i} output:\n{out}"
         # and the token LM on a (data, seq) mesh spanning processes
         assert f"MULTIHOST_LM_OK {i}" in out, f"worker {i} output:\n{out}"
         # and the MoE / pipeline trainers through the same seam
