@@ -539,8 +539,9 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         action="store_true",
         help="software-pipeline the gathers: layer k+1's all_gather issues "
         "before layer k's compute so the latency-hiding scheduler can "
-        "overlap them (same math, one extra gathered layer live; "
-        "excludes --remat)",
+        "overlap them (same math, one extra gathered layer live). With "
+        "--remat params the trunk unrolls so BACKWARD re-gathers overlap "
+        "too; excludes --remat full",
     )
     p.add_argument(
         "--device-data",

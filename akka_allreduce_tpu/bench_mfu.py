@@ -365,6 +365,7 @@ def run_fsdp(args) -> dict:
         seq_len=args.seq_len,
         compute_dtype=jnp.bfloat16,
         remat=args.remat,
+        prefetch=args.prefetch,
         learning_rate=1e-3,
     )
     rows = max(1, args.batch // trainer.dp)
@@ -438,6 +439,13 @@ def main(argv: list[str] | None = None) -> int:
         help="'full' = recompute layers on backward; 'params' (FSDP only) "
         "= re-gather params on backward, keep activations",
     )
+    p.add_argument(
+        "--prefetch",
+        action="store_true",
+        help="FSDP only: software-pipeline the param gathers (with "
+        "--remat params the trunk unrolls so backward re-gathers overlap "
+        "too)",
+    )
     p.add_argument("--hidden", type=int, nargs="+", default=[2048, 2048])
     p.add_argument("--image-size", type=int, default=64)
     p.add_argument("--classes", type=int, default=1000)
@@ -449,6 +457,8 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     if args.remat == "params" and args.workload != "fsdp":
         p.error("--remat params is FSDP's regather mode; use --remat full")
+    if args.prefetch and args.workload != "fsdp":
+        p.error("--prefetch is FSDP's gather pipeline; fsdp workload only")
     rec = WORKLOADS[args.workload](args)
     print(json.dumps(rec))
     return 0

@@ -178,14 +178,15 @@ class FSDPLMTrainer:
             raise ValueError(
                 f"compress must be None or 'bf16', got {compress!r}"
             )
-        if prefetch and remat:
+        if prefetch and remat == "full":
             raise ValueError(
-                "prefetch and remat do not compose: the prefetched gathered "
-                "layer rides the scan CARRY, and scan saves every "
+                "prefetch and full remat do not compose: the prefetched "
+                "gathered layer rides the scan CARRY, and scan saves every "
                 "iteration's carry as a backward residual — all L gathered "
                 "layers would stay resident, defeating exactly the memory "
-                "profile remat buys; pick one (prefetch = bandwidth "
-                "overlap, remat = memory)"
+                "profile full remat buys. prefetch DOES compose with "
+                "remat='params' (the trunk unrolls so forward AND backward "
+                "re-gathers can run behind neighboring layers' matmuls)"
             )
         self.compress = compress
         self.prefetch = prefetch
@@ -378,7 +379,39 @@ class FSDPLMTrainer:
                     size = int(np.prod(shape[1:]))
                     return full[:size].reshape(shape[1:])
 
-                if prefetch:
+                if prefetch and remat == "params":
+                    # Prefetch x regather remat (VERDICT r3 #5, closing the
+                    # old exclusion): the trunk UNROLLS — without a loop
+                    # boundary the latency-hiding scheduler is free to run
+                    # layer k+1's forward gather behind layer k's matmuls
+                    # AND layer k-1's backward RE-gather behind layer k's
+                    # backward matmuls (the regathers already run twice
+                    # under remat='params'; hiding the second copy is pure
+                    # win). Each layer keeps its own
+                    # jax.checkpoint(dots_saveable), so the residual
+                    # profile is exactly scan-mode remat='params': matmul
+                    # outputs saved, gathered params + cheap elementwise
+                    # recomputed. Cost: n_layers copies of the layer in the
+                    # program (compile time), fine at trunk depths that fit
+                    # one chip.
+                    trunk = p["trunk"]
+                    n_l = jax.tree.leaves(trunk)[0].shape[0]
+
+                    def one_layer(hh, layer_shards):
+                        layer_p = jax.tree.map(
+                            gather_leaf, layer_shards, trunk_shapes
+                        )
+                        return block_apply({"params": layer_p}, hh)
+
+                    layer_fn = jax.checkpoint(
+                        one_layer,
+                        policy=jax.checkpoint_policies.dots_saveable,
+                    )
+                    for i in range(n_l):
+                        h = layer_fn(
+                            h, jax.tree.map(lambda s: s[i], trunk)
+                        )
+                elif prefetch:
                     # Software-pipelined parameter prefetch (the FSDP form
                     # of SURVEY §8.4 overlap): iteration k issues layer
                     # k+1's all_gather BEFORE computing layer k, and the
@@ -387,10 +420,10 @@ class FSDPLMTrainer:
                     # layer's compute. A plain scan-over-xs serializes them
                     # (a layer's gather can only start in its own
                     # iteration). Same math; the trade is the gathered
-                    # layer riding the scan carry (hence the remat guard in
-                    # __init__). The scan covers n_l - 1 iterations and the
-                    # last layer applies AFTER it, so no iteration gathers
-                    # a layer it then discards.
+                    # layer riding the scan carry (hence the full-remat
+                    # guard in __init__). The scan covers n_l - 1
+                    # iterations and the last layer applies AFTER it, so no
+                    # iteration gathers a layer it then discards.
                     trunk = p["trunk"]
                     n_l = jax.tree.leaves(trunk)[0].shape[0]
 

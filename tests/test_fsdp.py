@@ -191,9 +191,9 @@ def test_prefetch_matches_plain(line8):
     x, y = next(ds.batches(8, 1))
     m = t2.train_step(x, y, [1.0, 0.0])
     assert m.contributors == 1.0 and np.isfinite(m.loss)
-    # prefetch + remat is rejected loudly: the carried gathered layer
+    # prefetch + FULL remat is rejected loudly: the carried gathered layer
     # becomes a per-iteration scan residual, defeating remat's point
-    with pytest.raises(ValueError, match="prefetch and remat"):
+    with pytest.raises(ValueError, match="prefetch and full remat"):
         _mk(line8, prefetch=True, remat=True)
 
 
@@ -413,11 +413,69 @@ class TestParamsRemat:
         m = t.train_step(x, y)
         assert np.isfinite(m.loss) and m.contributors == 2.0
 
-    def test_params_remat_rejects_prefetch_and_bad_mode(self, line8):
-        with pytest.raises(ValueError, match="prefetch and remat"):
-            _mk(line8, remat="params", prefetch=True)
+    def test_params_remat_rejects_bad_mode(self, line8):
         with pytest.raises(ValueError, match="remat must be"):
             _mk(line8, remat="granular")
+        with pytest.raises(ValueError, match="prefetch and full remat"):
+            _mk(line8, remat="full", prefetch=True)
+
+    def test_prefetch_params_matches_scan_mode(self, line8):
+        """prefetch x remat='params' (VERDICT r3 #5, the closed exclusion):
+        the trunk unrolls so backward re-gathers can run behind neighboring
+        layers' backward matmuls. Same math as scan-mode params remat and
+        as the plain path — losses to 1e-6, params to float tolerance."""
+        t_u = _mk(line8, remat="params", prefetch=True)
+        t_s = _mk(line8, remat="params")
+        t_p = _mk(line8)
+        ds = data.lm_copy_task(32, vocab=16)
+        valid = np.ones(8, np.float32)
+        valid[5] = 0.0
+        for i, (x, y) in enumerate(ds.batches(8, 3)):
+            v = valid if i == 1 else None
+            m_u = t_u.train_step(x, y, v)
+            m_s = t_s.train_step(x, y, v)
+            m_p = t_p.train_step(x, y, v)
+            assert abs(m_u.loss - m_s.loss) < 1e-6, (m_u.loss, m_s.loss)
+            assert abs(m_u.loss - m_p.loss) < 1e-6, (m_u.loss, m_p.loss)
+        np.testing.assert_allclose(
+            _flat(t_u.gathered_params()), _flat(t_s.gathered_params()),
+            rtol=1e-5, atol=1e-7,
+        )
+
+    def test_prefetch_params_unrolls(self):
+        """Structural evidence for the overlap-capable form: the trunk
+        loop is UNROLLED — the lowered HLO carries no while loop (the scan
+        modes have one) and >= n_layers all-gathers, so the scheduler can
+        move each backward re-gather behind another layer's matmuls (loop
+        trips could never overlap).
+
+        The MEMORY profile is a property of the TPU memory-aware
+        scheduler, not of the graph: on the real chip the unrolled form
+        compiles to 2.36 GB temp at the 404M flagship vs 4.96 GB for
+        scan-mode params remat and 5.61 GB plain (BENCHMARKS.md, round
+        4) — the CPU scheduler instead hoists every gather to the front
+        and inflates past no-remat, which is why there is no CPU memory
+        assertion here."""
+        kw = dict(
+            vocab=16, d_model=256, n_heads=4, n_layers=6, seq_len=32,
+        )
+
+        def build(**f):
+            t = FSDPLMTrainer(
+                line_mesh(8), optimizer=optax.sgd(1e-2), seed=0, **f, **kw
+            )
+            xd = jax.device_put(np.zeros((8, 32), np.int32), t._data_sharding)
+            yd = jax.device_put(np.zeros((8, 32), np.int32), t._data_sharding)
+            vd = jax.device_put(np.ones((8,), np.float32), t._valid_sharding)
+            return t._step.lower(t.params, t.opt_state, xd, yd, vd).compile()
+
+        unrolled = build(remat="params", prefetch=True)
+        scanned = build(remat="params")
+        hlo_u = unrolled.as_text()
+        hlo_s = scanned.as_text()
+        assert "while(" not in hlo_u, "trunk loop not unrolled"
+        assert "while(" in hlo_s  # the scan modes keep the loop
+        assert hlo_u.count("all-gather") >= kw["n_layers"]
 
     def test_params_remat_drops_gathered_trunk_from_residuals(self):
         """XLA's allocator evidence: with a trunk big enough to dominate,
