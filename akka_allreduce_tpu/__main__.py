@@ -1200,6 +1200,13 @@ def _cmd_train_moe(argv: list[str]) -> int:
     p.add_argument("--experts", type=int, default=4)
     p.add_argument("--capacity-factor", type=float, default=1.25)
     p.add_argument(
+        "--mu-bf16",
+        action="store_true",
+        help="adam first moment in bf16: halves the biggest traffic "
+        "stream of the all-expert optimizer update (BENCHMARKS.md round "
+        "4); the variance stays f32",
+    )
+    p.add_argument(
         "--topk", type=int, choices=(1, 2), default=1,
         help="router: 1 = Switch top-1, 2 = GShard top-2",
     )
@@ -1226,6 +1233,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
     args = p.parse_args(argv)
 
     import jax
+    import jax.numpy as jnp
 
     from akka_allreduce_tpu.models import data
     from akka_allreduce_tpu.parallel import data_seq_model_mesh
@@ -1259,6 +1267,7 @@ def _cmd_train_moe(argv: list[str]) -> int:
         compress=args.compress,
         overlap=args.overlap,
         dispatch_impl=args.dispatch,
+        mu_dtype=jnp.bfloat16 if args.mu_bf16 else None,
     )
     print(
         f"MoE params: {trainer.param_count / 1e6:.2f}M "

@@ -298,6 +298,7 @@ def run_moe(args) -> dict:
         learning_rate=1e-3,
         compute_dtype=jnp.bfloat16,
         dispatch_impl=args.dispatch,
+        mu_dtype=jnp.bfloat16 if args.mu_bf16 else None,
     )
     rows = max(1, args.batch // trainer.n_devices)
     batch = rows * trainer.n_devices
@@ -328,6 +329,7 @@ def run_moe(args) -> dict:
             "dispatch": args.dispatch,
             "experts": args.experts,
             "topk": args.topk,
+            "mu_bf16": args.mu_bf16,
             "d_model": args.d_model,
             "n_layers": args.layers,
             "seq_len": args.seq_len,
@@ -397,6 +399,7 @@ def run_fsdp(args) -> dict:
             "seq_len": args.seq_len,
             "batch": batch,
             "remat": args.remat,
+            "prefetch": args.prefetch,
             "compute_dtype": "bf16",
         },
     )
@@ -450,6 +453,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--image-size", type=int, default=64)
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--experts", type=int, default=8)
+    p.add_argument(
+        "--mu-bf16",
+        action="store_true",
+        help="moe only: adam first moment in bf16 — halves the biggest "
+        "traffic stream of the all-expert optimizer update",
+    )
     p.add_argument("--topk", type=int, choices=(1, 2), default=1)
     p.add_argument(
         "--dispatch", choices=("auto", "einsum", "scatter"), default="auto"
@@ -459,6 +468,8 @@ def main(argv: list[str] | None = None) -> int:
         p.error("--remat params is FSDP's regather mode; use --remat full")
     if args.prefetch and args.workload != "fsdp":
         p.error("--prefetch is FSDP's gather pipeline; fsdp workload only")
+    if args.mu_bf16 and args.workload != "moe":
+        p.error("--mu-bf16 is the MoE optimizer knob; moe workload only")
     rec = WORKLOADS[args.workload](args)
     print(json.dumps(rec))
     return 0

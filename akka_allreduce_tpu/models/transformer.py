@@ -39,6 +39,13 @@ def rope(x: jax.Array, offset: jax.Array | int, *, base: float = 10000.0):
 
     ``x``: (B, T, H, D). Pure elementwise after a cos/sin table build, so XLA
     fuses it into the surrounding projections.
+
+    The ANGLES (position · frequency) and the trig tables are always
+    computed in float32 — position precision is what long-context rope
+    depends on — but the elementwise rotation runs in ``x``'s own dtype:
+    under bf16 compute the (B, T, H, D) tensors would otherwise make four
+    f32 round trips per projection, a measured ~2.8 ms/step of pure cast
+    traffic at the MoE bench shape (BENCHMARKS.md round 4).
     """
     d = x.shape[-1]
     if d % 2:
@@ -46,11 +53,12 @@ def rope(x: jax.Array, offset: jax.Array | int, *, base: float = 10000.0):
     pos = offset + jnp.arange(x.shape[1])
     freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     ang = pos[:, None].astype(jnp.float32) * freqs[None, :]  # (T, D/2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate((x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1)
-    return out.astype(x.dtype)
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate(
+        (x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1
+    )
 
 
 class Attention(nn.Module):

@@ -73,6 +73,7 @@ class MoETrainer:
         aux_coef: float = 0.01,
         optimizer: optax.GradientTransformation | None = None,
         learning_rate: float = 1e-2,
+        mu_dtype=None,
         seed: int = 0,
         compute_dtype=jnp.float32,
         compress: str | None = None,
@@ -137,7 +138,12 @@ class MoETrainer:
             seq_impl=seq_impl,
             dispatch_impl=dispatch_impl,
         )
-        self.tx = optimizer or optax.adam(learning_rate)
+        # mu_dtype=bfloat16 halves the first-moment read+write traffic of
+        # the adam update — the LARGEST single cost of a single-chip MoE
+        # step, because the optimizer touches ALL E experts' params every
+        # step while only the active ones did compute (xprof breakdown in
+        # BENCHMARKS.md round 4); nu (the variance) stays f32
+        self.tx = optimizer or optax.adam(learning_rate, mu_dtype=mu_dtype)
 
         # full-shape init (ep=1 twin); shard_map in_specs slice expert leaves
         init_model = MoETransformerLM(

@@ -359,3 +359,48 @@ class TestScatterDispatch:
         hist = t.train_chain(sampler, 4, 2)
         assert all(np.isfinite(h.loss) for h in hist)
         assert hist[-1].loss < hist[0].loss + 1e-6
+
+
+class TestMuBf16:
+    """adam mu_dtype=bfloat16: halves the first-moment traffic of the
+    all-expert optimizer update (the largest single cost of a single-chip
+    MoE step — BENCHMARKS.md round 4). Numerics must track the f32-moment
+    run within bf16 tolerance, and the moment leaves must actually be
+    bf16 (so the bandwidth saving is real, not a silent upcast)."""
+
+    def _mk(self, mu):
+        import jax.numpy as jnp
+
+        from akka_allreduce_tpu.parallel import line_mesh
+        from akka_allreduce_tpu.train import MoETrainer
+
+        return MoETrainer(
+            line_mesh(8, axis="data"),
+            vocab=16, d_model=32, n_heads=2, n_layers=1, n_experts=4,
+            seq_len=32, learning_rate=1e-2, seed=0,
+            mu_dtype=jnp.bfloat16 if mu else None,
+        )
+
+    def test_tracks_f32_moments(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from akka_allreduce_tpu.models import data
+
+        t_b, t_f = self._mk(True), self._mk(False)
+        ds = data.lm_copy_task(32, vocab=16)
+        for i, (x, y) in enumerate(ds.batches(8, 10)):
+            m_b = t_b.train_step(x, y)
+            m_f = t_f.train_step(x, y)
+            # same routing decisions, bf16-moment drift only
+            assert abs(m_b.loss - m_f.loss) < 5e-2, (i, m_b.loss, m_f.loss)
+        p_b = t_b.get_flat_params()
+        p_f = t_f.get_flat_params()
+        drift = np.abs(p_b - p_f).max() / (np.abs(p_f).max() + 1e-9)
+        assert drift < 2e-2, drift
+        # the mu leaves really are bf16 (and nu stayed f32)
+        mu_leaves = jax.tree.leaves(t_b.opt_state[0].mu)
+        nu_leaves = jax.tree.leaves(t_b.opt_state[0].nu)
+        assert all(l.dtype == jnp.bfloat16 for l in mu_leaves)
+        assert all(l.dtype == jnp.float32 for l in nu_leaves)
