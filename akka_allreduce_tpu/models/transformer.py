@@ -81,7 +81,9 @@ class Attention(nn.Module):
     inference win) — and each call appends its chunk at the running
     ``cache_index`` and attends over the whole cache causally. Init the
     cache with ``model.init`` on any-length tokens; apply with
-    ``mutable=["cache"]``. Single device only (no seq/tensor sharding).
+    ``mutable=["cache"]``. Composes with tensor parallelism (each model
+    shard caches its kv_local heads — run inside shard_map over the
+    ``model`` axis); sequence sharding does not compose.
 
     ``cache_quant="int8"`` stores the cache quantized per (token, head)
     row — int8 payload + one f32 scale per row, ~4× fewer cache bytes
@@ -120,10 +122,12 @@ class Attention(nn.Module):
             raise ValueError(
                 f"n_kv_heads={kv_heads} not divisible by {self.tp_size=}"
             )
-        if self.decode and (self.seq_axis is not None or self.tp_size > 1):
+        if self.decode and self.seq_axis is not None:
             raise ValueError(
-                "decode=True is the single-device KV-cache path; it does "
-                "not compose with seq/tensor sharding"
+                "decode=True does not compose with sequence sharding (the "
+                "KV cache is whole-sequence per shard); tensor parallelism "
+                "IS supported — each model shard caches its kv_local heads "
+                "and the out-projection psum completes the partials"
             )
         if self.decode and self.max_decode_len < 1:
             raise ValueError("decode=True needs max_decode_len >= 1")

@@ -88,11 +88,24 @@ class TestGenerate:
             gen.generate(params, jnp.zeros((1, 6), jnp.int32), 4)
         with pytest.raises(ValueError, match="steps"):
             gen.generate(params, jnp.zeros((1, 2), jnp.int32), 0)
-        sharded = TransformerLM(
-            vocab=16, d_model=32, n_heads=4, seq_axis="seq"
+
+    def test_training_sharding_is_normalized_away(self):
+        """A training-configured model (seq/tensor sharding set) builds a
+        generator directly: the decode twin drops the training layout (the
+        generator's own mesh decides decode sharding), and logits equal
+        the unsharded config's."""
+        model, params, tokens = mk(2)
+        sharded_cfg = TransformerLM(
+            vocab=16, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+            seq_axis="seq",
         )
-        with pytest.raises(ValueError, match="single-device"):
-            LMGenerator(sharded, max_len=8)
+        a = LMGenerator(model, max_len=16).decode_logits(
+            params, tokens, chunk=1
+        )
+        b = LMGenerator(sharded_cfg, max_len=16).decode_logits(
+            params, tokens, chunk=1
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_trained_copy_model_copies_at_decode(self):
         """End to end: train a small LM on the copy task (first half of the
@@ -227,3 +240,64 @@ class TestInt8Cache:
         gen = LMGenerator(model, max_len=16, cache_quant="fp4")
         with pytest.raises(ValueError, match="cache_quant"):
             gen.decode_logits(params, tokens[:, :2], chunk=1)
+
+
+class TestTensorParallelDecode:
+    """TP-sharded decode (VERDICT r3 #8): params shard per tp_param_specs,
+    the KV cache shards its H_kv head dim over the model axis, and the
+    out-projection psum completes each layer — logits must equal the
+    single-device decode exactly (same reduction tree per head)."""
+
+    def _mesh(self, tp=2, dp=1):
+        return jax.make_mesh(
+            (dp, tp), ("data", "model"), devices=jax.devices()[: dp * tp]
+        )
+
+    @pytest.mark.parametrize("n_kv", [None, 2])
+    def test_logits_match_single_device(self, n_kv):
+        model, params, tokens = mk(n_kv)
+        g1 = LMGenerator(model, max_len=16)
+        gtp = LMGenerator(model, max_len=16, mesh=self._mesh(2))
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=1))
+        b = np.asarray(
+            gtp.decode_logits(gtp.place_params(params), tokens, chunk=1)
+        )
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+    def test_cache_is_sharded_over_model_axis(self):
+        model, params, tokens = mk(2)
+        gtp = LMGenerator(model, max_len=16, mesh=self._mesh(2))
+        cache = gtp.init_cache(batch=2)
+        ck = cache["Block_0"]["Attention_0"]["cached_k"]
+        assert ck.shape == (2, 16, 2, 8)  # GLOBAL H_kv=2
+        # each shard holds 1 of the 2 KV heads
+        assert ck.addressable_shards[0].data.shape == (2, 16, 1, 8)
+
+    def test_generate_matches_single_device(self):
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16)
+        gtp = LMGenerator(model, max_len=16, mesh=self._mesh(2))
+        a = np.asarray(g1.generate(params, tokens[:, :4], 8))
+        b = np.asarray(gtp.generate(gtp.place_params(params), tokens[:, :4], 8))
+        np.testing.assert_array_equal(a, b)
+
+    def test_int8_cache_tp(self):
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16, cache_quant="int8")
+        gtp = LMGenerator(
+            model, max_len=16, cache_quant="int8", mesh=self._mesh(2)
+        )
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=1))
+        b = np.asarray(
+            gtp.decode_logits(gtp.place_params(params), tokens, chunk=1)
+        )
+        # int8 per-(token, head) row scales are shard-local and identical
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_rejects_mesh_without_model_axis(self):
+        model, _, _ = mk()
+        with pytest.raises(ValueError, match="model"):
+            LMGenerator(
+                model, max_len=16,
+                mesh=jax.make_mesh((2,), ("data",), devices=jax.devices()[:2]),
+            )
