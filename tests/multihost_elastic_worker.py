@@ -1,0 +1,163 @@
+"""Subprocess body for the 4-process elastic re-mesh test (VERDICT r3
+next-round #6): the first elastic cycle to cross OS processes on the XLA
+plane.
+
+Generation 1: 4 processes x 2 virtual CPU devices join a loopback
+coordinator, run the HIERARCHICAL BUTTERFLY schedule over
+``slice_grid_mesh`` — rows = processes (the DCN analog), cols = each
+process's devices (the ICI analog) — and train a DPTrainer on the global
+8-device mesh through the pod seam, each step writing a host snapshot
+(process 0; DP state is replicated, hence addressable per process).
+
+The driver then SIGKILLs process 3 (tests/test_multihost.py plays the
+bootstrap master: detect, order re-mesh) and starts generation 2: THREE
+processes with fresh ranks on a NEW coordinator port restore the latest
+snapshot and continue on the 6-device global mesh — butterfly again over
+the shrunken (3, 2) slice grid. A single-process oracle replays both
+phases' batches to pin the numerics (the re-mesh is
+checkpoint-restore-equivalent, as in tests/test_elastic.py).
+
+Usage: python tests/multihost_elastic_worker.py <pid> <nprocs> <port> \
+    <snapdir> <phase> [<start_step>]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+LOCAL_DEVICES = 2
+
+
+def main() -> None:
+    process_id, num_processes, port = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+    )
+    snapdir = sys.argv[4]
+    phase = int(sys.argv[5])
+    start_step = int(sys.argv[6]) if len(sys.argv) > 6 else 0
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from akka_allreduce_tpu.comm.allreduce import threshold_allreduce
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.parallel import multihost
+    from akka_allreduce_tpu.train import DPTrainer
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    n = len(jax.devices())
+    assert n == LOCAL_DEVICES * num_processes, n
+
+    # ---- hierarchical butterfly over the slice grid -----------------------
+    # rows = one per process (cross-host / DCN-analog stage), cols = the
+    # process's own devices (intra-host / ICI-analog stage): the butterfly
+    # reduces along cols first, then rows — the 2D grid schedule of
+    # SURVEY.md §4.3 at pod scale.
+    grid = multihost.slice_grid_mesh()
+    assert dict(grid.shape) == {"rows": num_processes, "cols": LOCAL_DEVICES}
+    rng = np.random.default_rng(phase)
+    xs_global = rng.standard_normal((n, 2048)).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    mask[-1] = 0.0
+    lo, hi = process_id * LOCAL_DEVICES, (process_id + 1) * LOCAL_DEVICES
+    # payload layout is (n_devices, data) sharded over BOTH grid axes on
+    # dim 0; the grid flattens row-major in jax.devices() order
+    # (process-contiguous), so this process's rows are its devices' block
+    xs = multihost.host_local_to_global(
+        xs_global[lo:hi], grid, P(("rows", "cols"))
+    )
+    valid = multihost.host_local_to_global(
+        mask[lo:hi], grid, P(("rows", "cols"))
+    )
+    res = threshold_allreduce(grid, xs, valid, schedule="butterfly")
+    avg = np.asarray(jax.device_get(res.average()))
+    oracle = (xs_global * mask[:, None]).sum(0) / mask.sum()
+    np.testing.assert_allclose(avg, oracle, rtol=1e-5, atol=1e-6)
+    print(f"BUTTERFLY_OK {phase} {process_id}", flush=True)
+
+    # ---- DP training through the pod seam, snapshot every step ------------
+    mesh = multihost.global_line_mesh()
+    ex = np.zeros((1, 8, 8, 1), np.float32)
+    trainer = DPTrainer(
+        MLP(hidden=(16,), classes=4),
+        mesh,
+        example_input=ex,
+        optimizer=optax.sgd(0.1),
+        seed=7,
+    )
+    snap_path = os.path.join(snapdir, "snap.npz")
+    if phase == 2:
+        # restore the generation-1 snapshot onto the SHRUNKEN mesh: the
+        # elastic cycle's "re-mesh = checkpoint-restore" semantics, now
+        # crossing OS processes
+        with np.load(snap_path) as z:
+            flat, step = z["flat"], int(z["step"])
+        assert step == start_step, (step, start_step)
+        trainer.set_flat_params(flat)  # the binder/cluster restore seam
+        trainer.step_num = step
+        # optimizer state: plain SGD carries no moments; trace-equal restart
+
+    steps = 3 if phase == 1 else 2
+    per_dev = 4
+    batch_rng = np.random.default_rng(100 + phase)
+    for s in range(steps):
+        xb = batch_rng.standard_normal((n * per_dev, 8, 8, 1)).astype(
+            np.float32
+        )
+        yb = batch_rng.integers(0, 4, size=(n * per_dev,)).astype(np.int32)
+        share = xb.shape[0] // num_processes
+        sl = slice(process_id * share, (process_id + 1) * share)
+        m = trainer.train_step(xb[sl], yb[sl])
+        assert np.isfinite(m.loss)
+        if phase == 1 and process_id == 0:
+            flat = trainer.get_flat_params()
+            tmp = snap_path + ".tmp"
+            with open(tmp, "wb") as f:  # np.savez(path) appends .npz
+                np.savez(f, flat=flat, step=trainer.step_num)
+            os.replace(tmp, snap_path)
+        print(
+            f"STEP_OK {phase} {process_id} {trainer.step_num} {m.loss:.6f}",
+            flush=True,
+        )
+
+    final = trainer.get_flat_params()
+    np.save(os.path.join(snapdir, f"final_p{phase}_{process_id}.npy"), final)
+    print(f"ELASTIC_PHASE_OK {phase} {process_id}", flush=True)
+
+    if phase == 1:
+        # keep TRAINING as a live job (no more snapshots): the driver
+        # (playing the bootstrap master) SIGKILLs process 3 while steps —
+        # and their cross-process collectives — are genuinely in flight,
+        # then orders the survivors down for the re-mesh; generation 2
+        # restarts them as a 3-process job from the step-3 snapshot
+        while True:
+            xb = batch_rng.standard_normal((n * per_dev, 8, 8, 1)).astype(
+                np.float32
+            )
+            yb = batch_rng.integers(0, 4, size=(n * per_dev,)).astype(
+                np.int32
+            )
+            share = xb.shape[0] // num_processes
+            sl = slice(process_id * share, (process_id + 1) * share)
+            trainer.train_step(xb[sl], yb[sl])
+
+
+if __name__ == "__main__":
+    main()

@@ -124,3 +124,162 @@ def test_multiprocess_jax_distributed_cpu():
         assert f"MULTIHOST_MOE_PP_OK {i}" in out, f"worker {i} output:\n{out}"
         # and FSDP: per-layer param gathers crossing OS processes
         assert f"MULTIHOST_FSDP_OK {i}" in out, f"worker {i} output:\n{out}"
+
+
+def test_four_process_elastic_remesh_cycle(tmp_path):
+    """VERDICT r3 next-round #6: 4 x 2-device processes run the
+    hierarchical butterfly over slice_grid_mesh (rows = processes / DCN
+    analog, cols = devices / ICI analog) and train through the pod seam;
+    the driver — playing the bootstrap master — SIGKILLs process 3
+    mid-run and restarts the survivors as a 3-process job that restores
+    the latest snapshot and continues on the shrunken mesh: the first
+    elastic cycle to cross OS processes on the XLA plane. A
+    single-process oracle replaying both phases' global batches pins the
+    numerics (re-mesh == checkpoint-restore)."""
+    import os
+    import re
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+
+    import numpy as np
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo_root, "tests", "multihost_elastic_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    snapdir = str(tmp_path)
+
+    def port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def launch(nprocs, phase, start_step, to_files=False):
+        p = port()
+        procs = []
+        for i in range(nprocs):
+            if to_files:
+                out = open(os.path.join(snapdir, f"g{phase}_{i}.log"), "w")
+            else:
+                out = subprocess.PIPE
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, worker, str(i), str(nprocs), str(p),
+                        snapdir, str(phase), str(start_step),
+                    ],
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    env=env,
+                    cwd=repo_root,
+                )
+            )
+            if to_files:
+                out.close()
+        return procs
+
+    def logs(phase, nprocs):
+        out = {}
+        for i in range(nprocs):
+            path = os.path.join(snapdir, f"g{phase}_{i}.log")
+            out[i] = open(path).read() if os.path.exists(path) else ""
+        return out
+
+    # ---- generation 1: 4 processes, kill one mid-run ----------------------
+    # the ephemeral-port pick is racy (see the sibling test above): retry
+    # the whole generation once if the coordinator never came up
+    for attempt in range(2):
+        procs = launch(4, phase=1, start_step=0, to_files=True)
+        try:
+            # wait until every process has snapshotted step 3 and entered
+            # the live training loop
+            deadline = _time.monotonic() + 240
+            seen = set()
+            while len(seen) < 4 and _time.monotonic() < deadline:
+                buf = logs(1, 4)
+                seen = {
+                    i
+                    for i in range(4)
+                    if f"ELASTIC_PHASE_OK 1 {i}" in buf[i]
+                }
+                if any(p.poll() not in (None, 0) for p in procs):
+                    break  # a worker crashed (e.g. lost the port race)
+                _time.sleep(0.3)
+            if len(seen) < 4:
+                continue  # retry the generation on a fresh port
+            # let the endless loop get steps (and their cross-process
+            # collectives) genuinely in flight, then: process 3 dies hard
+            _time.sleep(1.0)
+            os.kill(procs[3].pid, signal.SIGKILL)
+            # the master orders the survivors down for the re-mesh (they
+            # may be wedged in a collective missing a peer — the finally
+            # escalates to SIGKILL)
+            for p in procs[:3]:
+                p.send_signal(signal.SIGTERM)
+            break
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    _time.sleep(0.5)
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+    assert len(seen) == 4, f"phase 1 incomplete: {seen}\n{logs(1, 4)}"
+    buf1 = logs(1, 4)
+    for i in range(4):
+        assert f"BUTTERFLY_OK 1 {i}" in buf1[i], buf1[i]
+
+    # snapshot from the killed generation is the restore point
+    with np.load(os.path.join(snapdir, "snap.npz")) as z:
+        assert int(z["step"]) == 3
+
+    # ---- generation 2: 3 processes restore and continue -------------------
+    procs2 = launch(3, phase=2, start_step=3)
+    outs = []
+    for p in procs2:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs2:
+                if q.poll() is None:
+                    q.kill()
+            out, _ = p.communicate()
+            out = f"[TIMED OUT]\n{out}"
+        outs.append((p.returncode, out))
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"gen-2 worker {i} rc={rc}:\n{out}"
+        assert f"BUTTERFLY_OK 2 {i}" in out, out
+        assert f"ELASTIC_PHASE_OK 2 {i}" in out, out
+        m = re.findall(r"STEP_OK 2 \d+ (\d+)", out)
+        assert m and int(m[-1]) == 5, out  # 3 restored + 2 new steps
+
+    # ---- single-process oracle: replay both phases' global batches --------
+    import optax
+
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.parallel import line_mesh
+    from akka_allreduce_tpu.train import DPTrainer
+    from akka_allreduce_tpu.binder.api import flatten_pytree
+
+    oracle = DPTrainer(
+        MLP(hidden=(16,), classes=4),
+        line_mesh(1),
+        example_input=np.zeros((1, 8, 8, 1), np.float32),
+        optimizer=optax.sgd(0.1),
+        seed=7,
+    )
+    for phase, nprocs, steps in ((1, 4, 3), (2, 3, 2)):
+        n = 2 * nprocs
+        rng = np.random.default_rng(100 + phase)
+        for _ in range(steps):
+            xb = rng.standard_normal((n * 4, 8, 8, 1)).astype(np.float32)
+            yb = rng.integers(0, 4, size=(n * 4,)).astype(np.int32)
+            oracle.train_step(xb, yb)
+    want = flatten_pytree(oracle.params)[0]
+    got = np.load(os.path.join(snapdir, "final_p2_0.npy"))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
