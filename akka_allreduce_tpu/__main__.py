@@ -528,11 +528,14 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
     )
     p.add_argument(
         "--compress",
-        choices=("bf16",),
+        choices=("bf16", "int8"),
         default=None,
-        help="run the per-layer param all_gather (and its reduce-scatter "
-        "transpose) in bf16 — half of FSDP's collective bytes; master "
-        "params/moments stay f32",
+        help="per-layer collective compression: bf16 halves FSDP's "
+        "collective bytes (gather + reduce-scatter transpose); int8 "
+        "quarters them — one quantization per shard on the forward "
+        "gather, the explicit per-hop-scaled ring reduce-scatter on "
+        "backward (single gather axis only; master params/moments stay "
+        "f32 either way)",
     )
     p.add_argument(
         "--prefetch",

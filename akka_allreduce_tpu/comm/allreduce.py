@@ -431,6 +431,24 @@ def _decompress_seg(payload: jax.Array, scale: jax.Array, mode: str) -> jax.Arra
     return payload.astype(jnp.float32) * scale
 
 
+def _compressed_hop(block, axis_name: str, fwd, compress: str | None):
+    """One ring hop: (optionally compress,) ppermute(, decompress).
+
+    THE hop protocol — every ring stage (reduce-scatter steps, the owner
+    requantization's gather, all-gather steps, the reduce-scatter
+    alignment hop) moves payloads through here, so a change to the wire
+    format happens exactly once. int8 rides a second ppermute for the
+    per-segment scale; bf16 has no scale to carry.
+    """
+    if compress is None:
+        return lax.ppermute(block, axis_name, fwd)
+    payload, scale = _compress_seg(block, compress)
+    payload = lax.ppermute(payload, axis_name, fwd)
+    if compress == "int8":
+        scale = lax.ppermute(scale, axis_name, fwd)
+    return _decompress_seg(payload, scale, compress)
+
+
 def ring_allreduce_sum(
     x: jax.Array,
     axis_name: str,
@@ -468,14 +486,7 @@ def ring_allreduce_sum(
     def rs_step(s, segs):
         send_i = jnp.mod(idx - s, n)
         block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
-        if compress is None:
-            recv = lax.ppermute(block, axis_name, fwd)
-        else:
-            payload, scale = _compress_seg(block, compress)
-            payload = lax.ppermute(payload, axis_name, fwd)
-            if compress == "int8":  # bf16 has no scale to carry
-                scale = lax.ppermute(scale, axis_name, fwd)
-            recv = _decompress_seg(payload, scale, compress)
+        recv = _compressed_hop(block, axis_name, fwd, compress)
         recv_i = jnp.mod(idx - s - 1, n)
         cur = lax.dynamic_slice_in_dim(segs, recv_i, 1, axis=0)
         return lax.dynamic_update_slice_in_dim(segs, cur + recv, recv_i, axis=0)
@@ -495,19 +506,57 @@ def ring_allreduce_sum(
     def ag_step(s, segs):
         send_i = jnp.mod(idx + 1 - s, n)
         block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
-        if compress is None:
-            recv = lax.ppermute(block, axis_name, fwd)
-        else:
-            payload, scale = _compress_seg(block, compress)
-            payload = lax.ppermute(payload, axis_name, fwd)
-            if compress == "int8":  # bf16 has no scale to carry
-                scale = lax.ppermute(scale, axis_name, fwd)
-            recv = _decompress_seg(payload, scale, compress)
+        recv = _compressed_hop(block, axis_name, fwd, compress)
         recv_i = jnp.mod(idx - s, n)
         return lax.dynamic_update_slice_in_dim(segs, recv, recv_i, axis=0)
 
     segs = lax.fori_loop(0, n - 1, ag_step, segs)
     return segs.reshape(-1)[:data]
+
+
+def ring_reduce_scatter_sum(
+    x: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    *,
+    compress: str | None = None,
+) -> jax.Array:
+    """Ring REDUCE-SCATTER of ``x`` over ``axis_name``: device ``i``
+    returns the fully-reduced segment ``i`` (shape ``(ceil(data/n),)``,
+    zero-padded tail when ``data % n != 0``).
+
+    The reduce half of :func:`ring_allreduce_sum` — same per-hop
+    ``compress`` ("bf16" | "int8" with per-segment scales on a second
+    ppermute), same per-hop requantization trade — plus one final
+    (compressed) hop that moves each reduced segment from its ring owner
+    ``(i+1) mod n`` back to device ``i``, aligning with the tiled
+    ``all_gather`` layout whose transpose this implements (FSDP's int8
+    backward — VERDICT r3 next-round #7b).
+    """
+    n = axis_size
+    data = x.shape[0]
+    seg = math.ceil(data / n)
+    if n == 1:
+        return jnp.pad(x, (0, seg * n - data))
+    if compress not in (None, "bf16", "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}")
+    segs = jnp.pad(x, (0, n * seg - data)).reshape(n, seg)
+    idx = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def rs_step(s, segs):
+        send_i = jnp.mod(idx - s, n)
+        block = lax.dynamic_slice_in_dim(segs, send_i, 1, axis=0)
+        recv = _compressed_hop(block, axis_name, fwd, compress)
+        recv_i = jnp.mod(idx - s - 1, n)
+        cur = lax.dynamic_slice_in_dim(segs, recv_i, 1, axis=0)
+        return lax.dynamic_update_slice_in_dim(segs, cur + recv, recv_i, axis=0)
+
+    segs = lax.fori_loop(0, n - 1, rs_step, segs)
+    # device i owns reduced segment (i + 1) mod n; one more hop hands
+    # segment j to device j
+    own = lax.dynamic_slice_in_dim(segs, jnp.mod(idx + 1, n), 1, axis=0)
+    return _compressed_hop(own, axis_name, fwd, compress).reshape(-1)
 
 
 # --------------------------------------------------------------------------

@@ -174,9 +174,17 @@ class FSDPLMTrainer:
                 "axis, then any of 'model' (Megatron TP) and 'seq' — got "
                 f"{axes}"
             )
-        if compress not in (None, "bf16"):
+        if compress not in (None, "bf16", "int8"):
             raise ValueError(
-                f"compress must be None or 'bf16', got {compress!r}"
+                f"compress must be None, 'bf16' or 'int8', got {compress!r}"
+            )
+        if compress == "int8" and len(
+            tuple(a for a in axes if a != "model")
+        ) != 1:
+            raise ValueError(
+                "compress='int8' rides the explicit ring reduce-scatter, "
+                "which reduces over ONE gather axis; FSDP x SP gathers "
+                "over (data, seq) — use bf16 there"
             )
         if prefetch and remat == "full":
             raise ValueError(
@@ -203,6 +211,7 @@ class FSDPLMTrainer:
         self.tp = int(mesh.shape[self.model_axis]) if self.model_axis else 1
         self.n_devices = self.dp * self.sp * self.tp
         n = self.dp * self.sp  # FSDP shards per tp-local slice
+        self.gather_shards = n
         self.data_shards = self.dp
         if seq_len % self.sp:
             raise ValueError(
@@ -343,6 +352,42 @@ class FSDPLMTrainer:
         head_apply = head.apply
         tx = self.tx
 
+        int8_gather = None
+        if compress == "int8":
+            from akka_allreduce_tpu.comm.allreduce import (
+                ring_reduce_scatter_sum,
+            )
+            from akka_allreduce_tpu.ops.ring import int8_quantize
+
+            ring_axis = g_axes[0]
+            n_shards = self.gather_shards
+
+            @jax.custom_vjp
+            def int8_gather(flat):
+                q, sc = int8_quantize(flat)
+                qf = lax.all_gather(q, ring_axis, tiled=True)
+                scf = lax.all_gather(sc.reshape(1), ring_axis, tiled=True)
+                return (
+                    qf.reshape(n_shards, -1).astype(jnp.float32)
+                    * scf[:, None]
+                ).reshape(-1)
+
+            def _fwd(flat):
+                return int8_gather(flat), None
+
+            def _bwd(_, ct):
+                # the all_gather's transpose is reduce-scatter; ride the
+                # explicit int8 ring so the backward wire is quarter-width
+                # too (per-hop scales; ct length = n * shard, so segments
+                # align with the tiled gather layout exactly)
+                return (
+                    ring_reduce_scatter_sum(
+                        ct, ring_axis, n_shards, compress="int8"
+                    ),
+                )
+
+            int8_gather.defvjp(_fwd, _bwd)
+
         def step(params, opt_state, x, y, valid):
             v0 = valid.reshape(())
             v = v0
@@ -370,10 +415,19 @@ class FSDPLMTrainer:
                     # transpose then reduce-scatters the grads in bf16 too
                     # (FSDP's collectives ARE its bandwidth cost), while
                     # the stored master params and moments stay f32.
+                    # compress="int8" quarters the wire both ways:
+                    # forward = ONE quantization per shard (int8 payload +
+                    # a per-shard f32 scale on a second all_gather — no
+                    # per-hop requantization: all_gather forwards original
+                    # payloads); backward = the explicit int8 ring
+                    # reduce-scatter (per-hop scales, custom transpose).
                     flat = s.reshape(-1)
                     if compress == "bf16":
                         flat = flat.astype(jnp.bfloat16)
-                    full = lax.all_gather(flat, g_axes, tiled=True)
+                    if compress == "int8":
+                        full = int8_gather(flat)
+                    else:
+                        full = lax.all_gather(flat, g_axes, tiled=True)
                     if compress == "bf16":
                         full = full.astype(s.dtype)
                     size = int(np.prod(shape[1:]))
@@ -500,8 +554,13 @@ class FSDPLMTrainer:
         # with sp == 1 (or Ulysses) the blocks run FULL local attention, so
         # the flash kernel can dispatch; its outputs carry no varying-axes
         # annotation (same check_vma gate as LongContext/MoE/Pipeline)
-        self._check_vma = not flash_vma_relax(
-            seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
+        # int8's ring ppermute loop erases varying-axes typing (the same
+        # relaxation every int8 trainer path needs)
+        self._check_vma = (
+            not flash_vma_relax(
+                seq_len, d_model // n_heads, sp=self.sp, seq_impl=seq_impl
+            )
+            and compress != "int8"
         )
         self._step = jax.jit(
             jax.shard_map(

@@ -46,11 +46,47 @@ def ef_fold(flat: jax.Array, ef) -> jax.Array:
     return flat if ef is None else flat + ef.reshape(-1)
 
 
-def ef_residual(c: jax.Array, v: jax.Array, ef) -> jax.Array:
-    """``e' = c - sent`` where ``sent`` mirrors masked_psum's mask-then-cast
-    EXACTLY (what the bf16 collective actually summed from this device) —
-    all of ``c`` carries forward when the device was masked out."""
-    sent = (c * v).astype(jnp.bfloat16).astype(jnp.float32)
+def ef_residual(
+    c: jax.Array,
+    v: jax.Array,
+    ef,
+    *,
+    compress: str = "bf16",
+    n_segments: int | None = None,
+) -> jax.Array:
+    """``e' = c - sent``; all of ``c`` carries forward when the device was
+    masked out.
+
+    ``compress="bf16"``: ``sent`` mirrors masked_psum's mask-then-cast
+    EXACTLY (what the bf16 collective actually summed from this device).
+
+    ``compress="int8"`` (VERDICT r3 next-round #7a): ``sent`` mirrors the
+    ring's FIRST-HOP quantization of this device's contribution — the
+    same per-segment max-abs int8 formula over the same ``n_segments``
+    (= ring length) segmentation, computed locally. This captures the
+    device's OWN quantization error, the only part that is locally
+    computable; the ring additionally re-quantizes partial SUMS at every
+    later hop, and that per-hop noise has no local residual — it remains
+    uncompensated. It is bounded by the hop scale (max|sum|/127 per
+    element per hop, ~linear in ring length) and has no systematic sign,
+    whereas the first-hop error EF recovers is the per-device bias that
+    would otherwise accumulate step over step.
+    """
+    m = c * v
+    if compress == "int8":
+        from akka_allreduce_tpu.ops.ring import int8_quantize
+
+        if not n_segments:
+            raise ValueError("int8 residual needs n_segments (ring length)")
+        data = m.shape[0]
+        seg = -(-data // n_segments)
+        segs = jnp.pad(m, (0, n_segments * seg - data)).reshape(
+            n_segments, seg
+        )
+        q, s = jax.vmap(int8_quantize)(segs)
+        sent = (q.astype(jnp.float32) * s[:, None]).reshape(-1)[:data]
+    else:
+        sent = m.astype(jnp.bfloat16).astype(jnp.float32)
     return (c - sent).reshape(ef.shape)
 
 
@@ -313,12 +349,14 @@ class DPTrainer:
                 "int8 grad sync rides the explicit ring schedule, which "
                 f"reduces over ONE mesh axis; got axes {mesh.axis_names}"
             )
-        if error_feedback and compress != "bf16":
+        if error_feedback and compress not in ("bf16", "int8"):
             raise ValueError(
-                "error_feedback requires compress='bf16': the bf16 cast "
-                "error is locally computable; the int8 ring re-quantizes "
-                "per hop (no exact local residual), and lossless sync has "
-                "no residual to carry"
+                "error_feedback requires compress='bf16' or 'int8' "
+                "(lossless sync has no residual to carry). bf16's cast "
+                "error is exactly local; int8 EF compensates the FIRST-HOP "
+                "quantization of this device's contribution — the ring's "
+                "later per-hop requantization of partial sums has no local "
+                "residual and remains (see ef_residual)"
             )
         self.model = model
         self.mesh = mesh
@@ -401,7 +439,9 @@ class DPTrainer:
                     bucket_size=b,
                     wire_dtype=jnp.bfloat16 if wire_bf16 else None,
                 )
-            new_ef = None if ef is None else ef_residual(c, v, ef)
+            new_ef = None if ef is None else ef_residual(
+                c, v, ef, compress=compress, n_segments=n_devices_static
+            )
             denom_el = jnp.maximum(expand_counts(cnt, flat.shape[0], b), 1.0)
             gavg = unravel(gsum / denom_el)
             loss_avg = lax.psum(loss * v, axis_names) / denom
@@ -499,6 +539,9 @@ class DPTrainer:
                         P(), P(), data_spec, data_spec, data_spec, data_spec
                     ),
                     out_specs=(P(), P(), data_spec, P(), P()),
+                    # the int8 ring's ppermute loop erases varying-axes
+                    # typing (same relaxation as the non-EF step above)
+                    check_vma=compress != "int8",
                 ),
                 donate_argnums=(0, 1, 2),
             )
@@ -630,7 +673,8 @@ class DPTrainer:
                 # the accumulated mean gradient — the same explicit
                 # collective the plain step uses, amortized over the whole
                 # accumulation (VERDICT r3 #5a). Counts reuse the scalar
-                # psum; EF is structurally excluded (EF requires bf16).
+                # psum. EF composes (round 4): ef_residual below mirrors
+                # this ring's first-hop quantization of c.
                 total = ring_allreduce_sum(
                     c * v.astype(c.dtype),
                     axis_names[0],
@@ -653,7 +697,10 @@ class DPTrainer:
                 denom_el = jnp.maximum(
                     expand_counts(cnt, flat.shape[0], bucket), 1.0
                 )
-            new_ef = None if ef is None else ef_residual(c, v, ef)
+            new_ef = None if ef is None else ef_residual(
+                c, v, ef, compress=self.compress,
+                n_segments=self.n_devices,
+            )
             gavg = unravel(total / denom_el)
             loss_avg = lax.psum(lsum * v / accum_steps, axis_names) / denom
             updates, new_opt = tx.update(gavg, opt_state, params)
@@ -821,6 +868,9 @@ class DPTrainer:
                 mesh=self.mesh,
                 in_specs=(P(), P(), self._data_spec, P(), self._data_spec),
                 out_specs=(P(), P(), self._data_spec, P(), P()),
+                # same int8-ring caveat as the step's shard_map (EF
+                # excludes overlap, so only the ring relaxation applies)
+                check_vma=self.compress != "int8",
             )
             return jax.jit(mapped, donate_argnums=(0, 1, 2))
 

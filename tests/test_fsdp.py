@@ -513,3 +513,58 @@ class TestParamsRemat:
             pytest.skip("memory_analysis unavailable on this backend")
         assert params < 0.6 * plain, (params, plain)
         assert full <= params * 1.2, (full, params)
+
+
+class TestInt8Collectives:
+    """compress='int8' (VERDICT r3 #7b): quarter-width FSDP wire — forward
+    all_gather carries int8 payloads + per-shard f32 scales (quantized
+    ONCE per shard; all_gather forwards originals, no per-hop requant),
+    backward rides the explicit int8 ring reduce-scatter (per-hop
+    scales). Numerics stay in an int8 band of the f32 run; the lowered
+    HLO must actually carry s8 collectives."""
+
+    def test_tracks_f32_within_band(self, line8):
+        t0 = _mk(line8)
+        t8 = _mk(line8, compress="int8")
+        ds = data.lm_copy_task(32, vocab=16)
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0
+        for i, (x, y) in enumerate(ds.batches(8, 5)):
+            v = valid if i == 2 else None
+            m0 = t0.train_step(x, y, v)
+            m8 = t8.train_step(x, y, v)
+            assert np.isfinite(m8.loss)
+            assert abs(m8.loss - m0.loss) < 0.2, (i, m8.loss, m0.loss)
+        p0, p8 = _flat(t0.gathered_params()), _flat(t8.gathered_params())
+        drift = np.abs(p8 - p0).max() / (np.abs(p0).max() + 1e-9)
+        assert 0 < drift < 5e-2, drift  # quantized, but tracking
+
+    def test_hlo_carries_s8_collectives(self, line8):
+        t = _mk(line8, compress="int8")
+        xd = jax.device_put(np.zeros((8, 32), np.int32), t._data_sharding)
+        yd = jax.device_put(np.zeros((8, 32), np.int32), t._data_sharding)
+        vd = jax.device_put(np.ones((8,), np.float32), t._valid_sharding)
+        hlo = t._step.lower(t.params, t.opt_state, xd, yd, vd).as_text()
+        assert "xi8>" in hlo, "no int8 tensors on the wire"
+        assert "all_gather" in hlo
+        # the backward ring's hops are collective_permutes of i8 payloads
+        assert "collective_permute" in hlo
+        import re
+
+        assert re.search(r"all_gather.*xi8>", hlo), "gather payload not i8"
+
+    def test_composes_with_remat_and_tp(self):
+        mesh = jax.make_mesh(
+            (4, 2), ("data", "model"), devices=jax.devices()
+        )
+        t = _mk(mesh, compress="int8", remat="params")
+        ds = data.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(4, 1))
+        m = t.train_step(x, y)
+        assert np.isfinite(m.loss)
+
+    def test_rejects_multi_axis_gather(self):
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+
+        with pytest.raises(ValueError, match="ONE gather axis"):
+            _mk(data_seq_mesh(2, 4), compress="int8")

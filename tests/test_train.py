@@ -494,16 +494,85 @@ class TestInt8GradSync:
         a, b = t8.get_flat_params(), tf.get_flat_params()
         assert np.abs(a - b).max() / np.abs(b).max() < 0.1
 
-    def test_int8_rejects_grid_mesh_and_ef(self, line8):
+    def test_int8_rejects_grid_mesh(self, line8):
         from akka_allreduce_tpu.parallel import grid_mesh
 
         with pytest.raises(ValueError, match="ONE mesh axis"):
             self._make(grid_mesh(2, 4), "int8")
-        with pytest.raises(ValueError, match="error_feedback"):
-            DPTrainer(
-                MLP(hidden=(8,), classes=10),
+
+    def test_int8_ef_trains_and_tightens_drift(self, line8):
+        """EF for the int8 ring (VERDICT r3 #7a): the residual compensates
+        each device's FIRST-HOP quantization (the locally computable
+        part); per-hop requantization of partial sums remains. Training
+        must stay inside the int8 band of the f32 run and the residual
+        must be live."""
+        import optax
+
+        def mk(compress=None, ef=False):
+            return DPTrainer(
+                MLP(hidden=(32,), classes=10),
                 line8,
                 example_input=np.zeros((1, 28, 28, 1), np.float32),
-                compress="int8",
-                error_feedback=True,
+                optimizer=optax.sgd(0.1),
+                seed=0,
+                compress=compress,
+                error_feedback=ef,
             )
+
+        t_f32, t_ef = mk(), mk("int8", True)
+        ds = data.mnist_like()
+        h = []
+        for x, y in ds.batches(64, 15):
+            t_f32.train_step(x, y)
+            h.append(t_ef.train_step(x, y))
+        assert h[-1].loss < h[0].loss
+        drift = np.abs(t_ef.get_flat_params() - t_f32.get_flat_params()).max()
+        scale = np.abs(t_f32.get_flat_params()).max()
+        assert drift / scale < 5e-2, drift / scale
+        assert float(np.abs(np.asarray(t_ef._ef)).max()) > 0
+
+    def test_int8_ef_chain_runs(self, line8):
+        """The EF chain's shard_map needs the int8 check_vma relaxation
+        (the ring's ppermute loop erases varying-axes typing) — pin that
+        train_chain composes with compress='int8' + EF."""
+        import optax
+
+        t = DPTrainer(
+            MLP(hidden=(16,), classes=10),
+            line8,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.sgd(0.1),
+            compress="int8",
+            error_feedback=True,
+        )
+        h = t.train_chain(data.mnist_like().device_sampler(), 3, 4)
+        assert len(h) == 3 and np.isfinite(h[-1].loss)
+        assert float(np.abs(np.asarray(t._ef)).max()) > 0
+
+    def test_int8_ef_masked_device_carries_full_contribution(self, line8):
+        """A masked device sends dq(q(0)) = 0, so its residual is its
+        ENTIRE folded contribution — threshold dropout delays the
+        gradient, never loses it (same invariant as bf16 EF)."""
+        import optax
+
+        t = DPTrainer(
+            MLP(hidden=(32,), classes=10),
+            line8,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.sgd(0.1),
+            seed=0,
+            compress="int8",
+            error_feedback=True,
+        )
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 7.0
+        ef = np.asarray(t._ef)
+        masked_norm = np.linalg.norm(ef[3])
+        other = max(np.linalg.norm(ef[i]) for i in range(8) if i != 3)
+        # contributors carry only first-hop int8 crumbs (coarser than
+        # bf16's, hence the looser ratio)
+        assert masked_norm > 10 * other, (masked_norm, other)
