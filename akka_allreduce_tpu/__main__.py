@@ -818,11 +818,28 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     p.add_argument("--size", type=int, default=1_000_000)
     p.add_argument("--chunk", type=int, default=262_144)
     p.add_argument("--rounds", type=int, default=20, help="-1 = run forever")
+    p.add_argument(
+        "--round-window", type=int, default=2,
+        help="line rounds in flight (max 4 = the workers' out-of-order "
+        "buffer window): deeper windows overlap the per-round "
+        "master<->node RTT chain (the latency-bound share of the pair "
+        "wall — BENCHMARKS.md round 4)",
+    )
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=1.0, help="interval (s)")
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
     _add_wire_dtype_flag(p)
     args = p.parse_args(argv)
+    from akka_allreduce_tpu.config import WorkerConfig
+
+    worker_window = WorkerConfig().round_window
+    if not 1 <= args.round_window <= worker_window:
+        # past the workers' bounded out-of-order buffer, fast-forwarding
+        # silently corrupts round accounting (measured collapse at 8)
+        p.error(
+            f"--round-window must be in [1, {worker_window}] (the "
+            f"workers' out-of-order buffer window), got {args.round_window}"
+        )
     return _run_cluster_master(args)
 
 
@@ -850,7 +867,9 @@ def _run_cluster_master(args) -> int:
             max_chunk_size=args.chunk,
             wire_dtype=getattr(args, "wire_dtype", "f32"),
         ),
-        line_master=LineMasterConfig(round_window=2, max_rounds=args.rounds),
+        line_master=LineMasterConfig(
+            round_window=args.round_window, max_rounds=args.rounds
+        ),
         master=MasterConfig(
             node_num=args.nodes,
             dimensions=args.dims,
@@ -867,9 +886,12 @@ def _run_cluster_master(args) -> int:
         ep = await master.start()
         print(f"master listening on {ep}", flush=True)
         try:
+            t0, c0 = time.perf_counter(), time.process_time()
             await master.run_until_done()
             print(
-                f"master done: {master.rounds_completed} line-rounds completed",
+                f"master done: {master.rounds_completed} line-rounds "
+                f"completed (wall {time.perf_counter() - t0:.2f}s, own cpu "
+                f"{time.process_time() - c0:.2f}s over the round window)",
                 flush=True,
             )
             await asyncio.sleep(2 * args.heartbeat)  # let Shutdown flush
@@ -896,8 +918,9 @@ def _cmd_cluster_node(argv: list[str]) -> int:
     p.add_argument(
         "--metrics-out", default=None,
         help="JSONL path for the node's per-stage protocol timing "
-        "(fields encode/socket_write/decode/handler — where the wire "
-        "budget goes)",
+        "(fields encode/socket_write/decode/handler as wall spans, plus "
+        "cpu_s/wall_s — the on-cpu/off-cpu partition of the round "
+        "window)",
     )
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -937,12 +960,14 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             np.random.default_rng(seed).standard_normal(size).astype(np.float32)
         )
         state["t0"] = time.perf_counter()
+        cpu0 = time.process_time()
         print(f"node {nid} joined {args.seed}", flush=True)
         try:
             reason = await node.run_until_shutdown()
         finally:
             await node.stop()
         dt = time.perf_counter() - state["t0"]
+        cpu = time.process_time() - cpu0
         mbs = state["flushes"] * size * 4 / max(dt, 1e-9) / 1e6
         stages = dict(node.transport.stage_seconds)
         accounted = sum(stages.values())
@@ -954,10 +979,18 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             f"{mbs:.1f} MB/s reduced",
             flush=True,
         )
+        # wall decomposition (VERDICT r3 #9). Two views, different units:
+        # the PARTITION of wall is own-cpu vs off-cpu (process_time —
+        # off-cpu = the OS ran someone else, e.g. the peer/master on a
+        # shared core, or the socket was idle); the stage timers are
+        # WALL SPANS (they include awaits and any preemption inside a
+        # stage), an overlay for locating where time passes, not a
+        # disjoint part of the partition.
         print(
             f"node {nid} stage times over {dt:.2f}s wall: {stage_note} "
-            f"(accounted {accounted:.2f}s; the rest is event-loop wait "
-            "and peer I/O)",
+            f"(wall spans, {accounted:.2f}s total; partition: own cpu "
+            f"{cpu:.2f}s, off-cpu {max(dt - cpu, 0.0):.2f}s = "
+            "peer/master scheduled or socket idle)",
             flush=True,
         )
         if args.metrics_out:
@@ -966,6 +999,7 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             m = MetricsLogger(args.metrics_out)
             m.log_event(
                 kind="node_stage_times", node=nid, wall_s=round(dt, 3),
+                cpu_s=round(cpu, 3),
                 rounds=state["flushes"], mb_per_s=round(mbs, 1),
                 **{k: round(v, 4) for k, v in stages.items()},
             )
