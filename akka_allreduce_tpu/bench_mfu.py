@@ -295,6 +295,7 @@ def run_moe(args) -> dict:
         n_experts=args.experts,
         seq_len=args.seq_len,
         router_topk=args.topk,
+        capacity_factor=args.capacity_factor,
         learning_rate=1e-3,
         compute_dtype=jnp.bfloat16,
         dispatch_impl=args.dispatch,
@@ -318,7 +319,7 @@ def run_moe(args) -> dict:
         d_model=args.d_model,
         n_layers=args.layers,
     )
-    return _chain_mfu_record(
+    rec = _chain_mfu_record(
         "moe",
         timed,
         flops,
@@ -330,6 +331,7 @@ def run_moe(args) -> dict:
             "experts": args.experts,
             "topk": args.topk,
             "mu_bf16": args.mu_bf16,
+            "capacity_factor": args.capacity_factor,
             "d_model": args.d_model,
             "n_layers": args.layers,
             "seq_len": args.seq_len,
@@ -337,6 +339,15 @@ def run_moe(args) -> dict:
             "compute_dtype": "bf16",
         },
     )
+    # the capacity trade must ride the record: tighter capacity_factor
+    # trims empty-slot FFN compute but drops more assignments. Sampled
+    # AFTER the timing with the lo=2 chain length so the (2, rows) cache
+    # entry from the timed runs is reused — no extra compile
+    drop_sample = trainer.train_chain(sampler, 2, rows_per_device=rows)
+    rec["dropped_frac"] = round(
+        float(sum(m.dropped for m in drop_sample) / len(drop_sample)), 4
+    )
+    return rec
 
 
 def run_fsdp(args) -> dict:
@@ -454,6 +465,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--experts", type=int, default=8)
     p.add_argument(
+        "--capacity-factor", type=float, default=None,
+        help="moe only: expert slot slack (E*C = cf*topk*T). 1.0 removes "
+        "the 25%% of expert FFN compute the default spends on empty "
+        "slots, at the cost of more dropped assignments (recorded)",
+    )
+    p.add_argument(
         "--mu-bf16",
         action="store_true",
         help="moe only: adam first moment in bf16 — halves the biggest "
@@ -470,6 +487,10 @@ def main(argv: list[str] | None = None) -> int:
         p.error("--prefetch is FSDP's gather pipeline; fsdp workload only")
     if args.mu_bf16 and args.workload != "moe":
         p.error("--mu-bf16 is the MoE optimizer knob; moe workload only")
+    if args.capacity_factor is not None and args.workload != "moe":
+        p.error("--capacity-factor is the MoE slot knob; moe workload only")
+    if args.capacity_factor is None:
+        args.capacity_factor = 1.25  # MoETrainer's default
     rec = WORKLOADS[args.workload](args)
     print(json.dumps(rec))
     return 0
