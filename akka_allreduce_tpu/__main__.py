@@ -133,7 +133,8 @@ def _checkpoint_flags(p: argparse.ArgumentParser) -> None:
     the same set (including --async-checkpoint)."""
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
-    p.add_argument(
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument(
         "--async-checkpoint",
         action="store_true",
         help="save checkpoints WITHOUT stalling the step loop: capture is "
@@ -141,15 +142,27 @@ def _checkpoint_flags(p: argparse.ArgumentParser) -> None:
         "runs off-thread (a save still in flight at the next interval is "
         "skipped, not queued)",
     )
+    mode.add_argument(
+        "--delta-checkpoint",
+        action="store_true",
+        help="per-leaf content-addressed store instead of Orbax: a save "
+        "writes only leaves whose bytes changed since any kept checkpoint "
+        "(unchanged leaves cost one hash, zero bytes — size saves to a "
+        "slow link); delta saves are synchronous by design",
+    )
 
 
 def _make_checkpointer(args):
-    """The checkpointer the --checkpoint-* flags ask for (sync or async)."""
+    """The checkpointer the --checkpoint-* flags ask for."""
     from akka_allreduce_tpu.train import (
         AsyncTrainerCheckpointer,
+        DeltaCheckpointer,
         TrainerCheckpointer,
     )
 
+    if getattr(args, "delta_checkpoint", False):
+        # argparse enforces exclusivity with --async-checkpoint at parse
+        return DeltaCheckpointer(args.checkpoint_dir)
     cls = (
         AsyncTrainerCheckpointer
         if getattr(args, "async_checkpoint", False)

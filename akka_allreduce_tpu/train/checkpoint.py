@@ -354,10 +354,13 @@ class DeltaCheckpointer:
         steps = self._manifests()
         return max(steps) if steps else None
 
-    def save(self, trainer) -> dict:
+    def save(self, trainer, *, force: bool = False, block: bool = True) -> dict:
         """Write a delta checkpoint; returns ``{written_bytes,
         reused_bytes, written_leaves, reused_leaves}`` so callers can see
-        the delta actually saving bytes."""
+        the delta actually saving bytes. ``force``/``block`` exist for
+        signature parity with the Orbax checkpointers (a delta save is
+        always synchronous and never step-deduped — an identical re-save
+        just reuses every blob)."""
         import hashlib
         import json
 
@@ -475,6 +478,15 @@ class DeltaCheckpointer:
                 _restore_ef(trainer, state["ef"])
         trainer.step_num = int(manifest["step"])
         return trainer.step_num
+
+    def close(self) -> None:
+        """Nothing to flush (saves are synchronous); CLI-loop parity."""
+
+    def __enter__(self) -> "DeltaCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class AsyncTrainerCheckpointer(TrainerCheckpointer):

@@ -63,6 +63,25 @@ class TestCLI:
         assert [l["step"] for l in steps] == [1, 2]
         assert all(l["contributors"] == 2.0 for l in steps)
 
+    def test_delta_checkpoint_cli_roundtrip(self, tmp_path, capsys):
+        d = str(tmp_path / "delta")
+        args = [
+            "train-mlp", "--steps", "2", "--batch", "16", "--hidden", "8",
+            "--checkpoint-dir", d, "--checkpoint-every", "1",
+            "--delta-checkpoint",
+        ]
+        assert main(args) == 0
+        capsys.readouterr()
+        assert main(args) == 0  # second run resumes from the delta store
+        assert "resumed from step 2" in capsys.readouterr().out
+        import pytest
+
+        # argparse mutually-exclusive group rejects the pair at parse time
+        with pytest.raises(SystemExit) as e:
+            main(args + ["--async-checkpoint"])
+        assert e.value.code == 2
+        assert "not allowed with" in capsys.readouterr().err
+
     def test_train_pp_rejects_bad_virtual_schedule(self, capsys):
         import pytest
 
