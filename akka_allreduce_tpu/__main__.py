@@ -1177,13 +1177,28 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--drop-at", type=int, default=10, help="step the last node dies")
     p.add_argument("--rejoin-at", type=int, default=20, help="step it comes back")
+    p.add_argument(
+        "--family",
+        choices=("dp", "moe", "pp", "lc"),
+        default="dp",
+        help="which elastic trainer rides the cycle: dp = MLP DPTrainer; "
+        "moe / pp / lc = the round-4 families whose expert / pipe / seq "
+        "mesh axes RE-SHAPE with membership (the same experts "
+        "redistribute, the same logical layers re-chunk, sequences "
+        "re-split)",
+    )
     args = p.parse_args(argv)
 
     import jax
     import numpy as np
 
     from akka_allreduce_tpu.models import MLP, data
-    from akka_allreduce_tpu.train import ElasticDPTrainer
+    from akka_allreduce_tpu.train import (
+        ElasticDPTrainer,
+        ElasticLongContextTrainer,
+        ElasticMoETrainer,
+        ElasticPipelineTrainer,
+    )
 
     devices = jax.devices()
     per = max(1, len(devices) // args.nodes)
@@ -1191,13 +1206,46 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
         n: devices[n * per : (n + 1) * per] for n in range(args.nodes)
     }
     now = {"t": 0.0}
-    trainer = ElasticDPTrainer(
-        MLP(hidden=(32,), classes=10),
-        assignment,
-        example_input=np.zeros((1, 28, 28, 1), np.float32),
+    seq_len = 32
+    fam_kw = dict(
+        vocab=16, d_model=32, n_heads=2, learning_rate=1e-2, seed=0,
         clock=lambda: now["t"],
     )
-    ds = data.mnist_like()
+    if args.family == "dp":
+        trainer = ElasticDPTrainer(
+            MLP(hidden=(32,), classes=10),
+            assignment,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            clock=lambda: now["t"],
+        )
+        ds = data.mnist_like()
+        batch_rows = lambda t: args.batch_per_device * t.n_devices  # noqa: E731
+        shape_of = lambda t: f"{t.n_devices} devices"  # noqa: E731
+    elif args.family == "moe":
+        trainer = ElasticMoETrainer(
+            assignment, n_experts=4, n_layers=1, seq_len=seq_len,
+            capacity_factor=4.0, **fam_kw,
+        )
+        ds = data.lm_copy_task(seq_len, vocab=16)
+        batch_rows = lambda t: t.dp * t.ep * args.batch_per_device  # noqa: E731
+        shape_of = lambda t: f"dp{t.dp} x ep{t.ep}"  # noqa: E731
+    elif args.family == "pp":
+        trainer = ElasticPipelineTrainer(
+            assignment, n_layers=4, microbatches=2, seq_len=seq_len,
+            **fam_kw,
+        )
+        ds = data.lm_copy_task(seq_len, vocab=16)
+        batch_rows = (  # noqa: E731
+            lambda t: t.dp * t.microbatches * args.batch_per_device
+        )
+        shape_of = lambda t: f"dp{t.dp} x pp{t.stages}"  # noqa: E731
+    else:  # lc
+        trainer = ElasticLongContextTrainer(
+            assignment, seq_len=seq_len, max_sp=4, n_layers=1, **fam_kw,
+        )
+        ds = data.lm_copy_task(seq_len, vocab=16)
+        batch_rows = lambda t: t.dp * args.batch_per_device  # noqa: E731
+        shape_of = lambda t: f"dp{t.dp} x sp{t.sp}"  # noqa: E731
     dead = args.nodes - 1
     for step in range(args.steps):
         live = set(trainer.member_nodes)
@@ -1212,9 +1260,10 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
         if trainer.poll():
             print(
                 f"step {step}: re-meshed to {trainer.n_nodes} nodes / "
-                f"{trainer.n_devices} devices (generation {trainer.generation})"
+                f"{shape_of(trainer.trainer)} "
+                f"(generation {trainer.generation})"
             )
-        x, y = next(iter(ds.batches(args.batch_per_device * trainer.n_devices, 1,
+        x, y = next(iter(ds.batches(batch_rows(trainer.trainer), 1,
                                     seed_offset=step)))
         m = trainer.train_step(x, y)
         if step % 5 == 0 or set(trainer.member_nodes) != live:

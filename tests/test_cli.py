@@ -112,6 +112,22 @@ class TestCLI:
             ["train-pp", "--schedule", "1f1b", "--overlap"]
         )
 
+    def test_elastic_demo_family_reshapes_mesh(self, capsys):
+        """--family moe: the expert axis re-shapes with membership
+        (ep 4 -> 2 -> 4 on the 8-device mesh) through the demo loop."""
+        assert (
+            main(
+                [
+                    "elastic-demo", "--family", "moe", "--steps", "10",
+                    "--drop-at", "2", "--rejoin-at", "8",
+                ]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "re-meshed to 3 nodes / dp3 x ep2" in out
+        assert "re-meshed to 4 nodes / dp2 x ep4" in out
+
     def test_elastic_demo(self, capsys):
         # the drop window must outlast the phi detector's suspicion ramp
         # (~3-4 silent intervals at threshold 8), hence drop at 2, rejoin at 8
