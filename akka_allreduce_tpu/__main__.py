@@ -133,41 +133,39 @@ def _checkpoint_flags(p: argparse.ArgumentParser) -> None:
     the same set (including --async-checkpoint)."""
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
-    mode = p.add_mutually_exclusive_group()
-    mode.add_argument(
+    p.add_argument(
         "--async-checkpoint",
         action="store_true",
         help="save checkpoints WITHOUT stalling the step loop: capture is "
-        "an on-device copy + async device-to-host launch, serialization "
-        "runs off-thread (a save still in flight at the next interval is "
-        "skipped, not queued)",
+        "an on-device copy + async device-to-host launch (shard-local for "
+        "ZeRO-1/FSDP/PP — no gather), serialization runs off-thread (a "
+        "save still in flight at the next interval is skipped, not queued)",
     )
-    mode.add_argument(
+    p.add_argument(
         "--delta-checkpoint",
         action="store_true",
         help="per-leaf content-addressed store instead of Orbax: a save "
         "writes only leaves whose bytes changed since any kept checkpoint "
         "(unchanged leaves cost one hash, zero bytes — size saves to a "
-        "slow link); delta saves are synchronous by design",
+        "slow link); composes with --async-checkpoint for non-stalling "
+        "link-sized saves",
     )
 
 
 def _make_checkpointer(args):
     """The checkpointer the --checkpoint-* flags ask for."""
     from akka_allreduce_tpu.train import (
+        AsyncDeltaCheckpointer,
         AsyncTrainerCheckpointer,
         DeltaCheckpointer,
         TrainerCheckpointer,
     )
 
+    is_async = getattr(args, "async_checkpoint", False)
     if getattr(args, "delta_checkpoint", False):
-        # argparse enforces exclusivity with --async-checkpoint at parse
-        return DeltaCheckpointer(args.checkpoint_dir)
-    cls = (
-        AsyncTrainerCheckpointer
-        if getattr(args, "async_checkpoint", False)
-        else TrainerCheckpointer
-    )
+        cls = AsyncDeltaCheckpointer if is_async else DeltaCheckpointer
+    else:
+        cls = AsyncTrainerCheckpointer if is_async else TrainerCheckpointer
     return cls(args.checkpoint_dir)
 
 
@@ -1719,6 +1717,17 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument(
+        "--trainer", choices=("lm", "fsdp", "zero1", "pipeline"),
+        default="lm",
+        help="trainer family under test: the sharded-state families "
+        "(fsdp/zero1/pipeline) exercise the shard-local async capture "
+        "path (VERDICT r4 #1)",
+    )
+    p.add_argument(
+        "--store", choices=("orbax", "delta"), default="orbax",
+        help="delta: content-addressed per-leaf store (async hashing)",
+    )
     p.add_argument("--baseline-steps", type=int, default=5)
     p.add_argument("--max-steps-during", type=int, default=200)
     p.add_argument("--dir", default=None, help="default: a temp dir")
@@ -1730,31 +1739,72 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
     import statistics
     import tempfile
 
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
     from akka_allreduce_tpu.models import data
-    from akka_allreduce_tpu.parallel import data_seq_mesh
+    from akka_allreduce_tpu.parallel import data_seq_mesh, line_mesh
     from akka_allreduce_tpu.train import (
+        AsyncDeltaCheckpointer,
         AsyncTrainerCheckpointer,
+        DeltaCheckpointer,
+        FSDPLMTrainer,
         LongContextTrainer,
+        PipelineLMTrainer,
         TrainerCheckpointer,
+        Zero1DPTrainer,
     )
 
     heads = args.heads or max(1, args.d_model // 128)
-    trainer = LongContextTrainer(
-        data_seq_mesh(1, 1),
+    lm_kw = dict(
         vocab=args.vocab,
         d_model=args.d_model,
         n_heads=heads,
         n_layers=args.layers,
         seq_len=args.seq_len,
-        learning_rate=1e-3,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
+    n_dev = len(jax.devices())
+    if args.trainer == "lm":
+        trainer = LongContextTrainer(
+            data_seq_mesh(1, 1), learning_rate=1e-3, **lm_kw
+        )
+    elif args.trainer == "fsdp":
+        trainer = FSDPLMTrainer(line_mesh(n_dev), **lm_kw)
+    elif args.trainer == "pipeline":
+        pp = n_dev  # all devices as stages (1 on the real chip)
+        pp_kw = dict(lm_kw)
+        pp_kw.pop("n_layers")
+        trainer = PipelineLMTrainer(
+            jax.make_mesh((1, pp), ("data", "pipe")),
+            layers_per_stage=-(-args.layers // pp),
+            microbatches=2,
+            learning_rate=1e-3,
+            **pp_kw,
+        )
+    else:  # zero1: MLP classification family, width scaled by --d-model
+        import optax
+
+        from akka_allreduce_tpu.models import MLP
+
+        trainer = Zero1DPTrainer(
+            MLP(hidden=(args.d_model,) * args.layers, classes=10),
+            line_mesh(n_dev),
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.adam(1e-3),
+        )
     state_gb = trainer.param_count * 4 * 3 / 1e9  # f32 params + adam mu/nu
-    ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
-    batches = ds.batches(args.batch, 10_000)
+    # round the batch up to what the family's data placement divides by
+    # (fsdp/zero1 spread rows over all devices; pipeline needs microbatches)
+    div = {"fsdp": n_dev, "zero1": n_dev, "pipeline": 2}.get(args.trainer, 1)
+    batch = -(-args.batch // div) * div
+    if args.trainer == "zero1":
+        ds = data.mnist_like()
+        batches = ds.batches(batch, 10_000)
+    else:
+        ds = data.lm_copy_task(args.seq_len, vocab=args.vocab)
+        batches = ds.batches(batch, 10_000)
 
     def step():
         t0 = time.perf_counter()
@@ -1768,14 +1818,20 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
     # always a FRESH subdir: re-running against an existing directory would
     # hit the step-dedup early return and measure no save at all
     d = tempfile.mkdtemp(prefix="ckpt_bench_", dir=args.dir)
+    sync_cls, async_cls = (
+        (DeltaCheckpointer, AsyncDeltaCheckpointer)
+        if args.store == "delta"
+        else (TrainerCheckpointer, AsyncTrainerCheckpointer)
+    )
     sync_s = None
     if not args.skip_sync:
-        with TrainerCheckpointer(f"{d}/sync") as ck:
+        with sync_cls(f"{d}/sync") as ck:
             t0 = time.perf_counter()
             ck.save(trainer)
             sync_s = time.perf_counter() - t0
 
-    with AsyncTrainerCheckpointer(f"{d}/async") as ck:
+    delta_stats = None
+    with async_cls(f"{d}/async") as ck:
         t0 = time.perf_counter()
         ck.save(trainer)
         capture_s = time.perf_counter() - t0  # the only stall the loop sees
@@ -1788,9 +1844,13 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
         # waits, so this can exceed stepped_s
         save_wall_s = time.perf_counter() - t0
         saved_step = ck.latest_step()
+        delta_stats = getattr(ck, "last_stats", None)
     during_ms = statistics.median(during) * 1e3 if during else None
     rec = {
         "metric": "checkpoint_stall",
+        "trainer": args.trainer,
+        "store": args.store,
+        "delta_stats": delta_stats,
         "params_m": round(trainer.param_count / 1e6, 1),
         "state_gb": round(state_gb, 2),
         "baseline_ms_per_step": round(base_ms, 1),
@@ -1802,7 +1862,7 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
         ),
         "sync_save_stall_s": round(sync_s, 1) if sync_s is not None else None,
         "saved_step": saved_step,
-        "platform": __import__("jax").devices()[0].platform,
+        "platform": jax.devices()[0].platform,
     }
     print(json.dumps(rec))
     return 0

@@ -124,17 +124,30 @@ class LMGenerator:
 
     @staticmethod
     def _cache_specs(cache) -> dict:
-        """PartitionSpec tree for the cache: K/V payloads (B, L, H_kv, D)
-        and int8 scales (B, L, H_kv) shard their HEAD dim over ``model``;
-        the scalar cache_index replicates."""
-        def spec(leaf):
-            if leaf.ndim == 4:
-                return P(None, None, "model", None)
-            if leaf.ndim == 3:
-                return P(None, None, "model")
-            return P()
+        """PartitionSpec tree for the cache: K/V payloads ``cached_k/v``
+        (B, L, H_kv, D) and int8 scales ``k/v_scale`` (B, L, H_kv) shard
+        their HEAD dim over ``model``; ``cache_index`` replicates.
 
-        return jax.tree.map(spec, cache)
+        Keyed on the VARIABLE NAME, not leaf rank (ADVICE r4: a future
+        cache variable with a coincidental ndim must not be silently
+        mis-sharded) — an unknown name fails loudly here."""
+        import jax.tree_util as jtu
+
+        def spec(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in ("cached_k", "cached_v"):
+                return P(None, None, "model", None)
+            if name in ("k_scale", "v_scale"):
+                return P(None, None, "model")
+            if name == "cache_index":
+                return P()
+            raise ValueError(
+                f"unknown cache variable {name!r} (shape {leaf.shape}): "
+                "add its decode-mesh PartitionSpec to LMGenerator."
+                "_cache_specs before sharding it"
+            )
+
+        return jtu.tree_map_with_path(spec, cache)
 
     def place_params(self, params):
         """Shard FULL-shape trained params onto the decode mesh

@@ -2,6 +2,7 @@
 
 from akka_allreduce_tpu.train.trainer import DPTrainer, TrainStepMetrics  # noqa: F401
 from akka_allreduce_tpu.train.checkpoint import (  # noqa: F401
+    AsyncDeltaCheckpointer,
     AsyncTrainerCheckpointer,
     DeltaCheckpointer,
     Snapshot,

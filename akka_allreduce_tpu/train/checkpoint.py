@@ -104,6 +104,122 @@ def capture_state(trainer) -> tuple[dict, bool]:
     return state, False
 
 
+_POD_MESH_MSG = (
+    "DeltaCheckpointer is a per-host store; state sharded over a mesh "
+    "that spans OS processes cannot be host-gathered here — use "
+    "TrainerCheckpointer (Orbax coordinates cross-process saves) on pod "
+    "meshes"
+)
+
+
+def _fully_addressable(tree) -> bool:
+    """True when every jax.Array leaf is visible to THIS process — the
+    precondition for host-side capture without Orbax's cross-process
+    coordination."""
+    return all(
+        x.is_fully_addressable
+        for x in jax.tree.leaves(tree)
+        if isinstance(x, jax.Array)
+    )
+
+
+def _copy_tree_async(tree):
+    """Donation-proof on-device copy with device-to-host transfers
+    launched: new buffers with the same shardings, so the training loop's
+    donated originals can die while the copy's transfer is still in
+    flight. The background writer's ``np.asarray`` then merely joins the
+    transfer instead of starting it."""
+    import jax.numpy as jnp
+
+    def copy_leaf(x):
+        if isinstance(x, jax.Array):
+            y = jnp.copy(x)
+            y.copy_to_host_async()
+            return y
+        return x
+
+    return jax.tree.map(copy_leaf, tree)
+
+
+def async_capture(trainer):
+    """``(captured, assemble, custom)`` for the non-stalling checkpoint
+    paths, or ``None`` when the state is not fully addressable from this
+    process (pod meshes — the Orbax caller falls back to its
+    multihost-aware synchronous save; the per-host delta store raises).
+
+    Trainers exposing the shard-local protocol
+    (``checkpoint_capture``/``checkpoint_assemble`` — ZeRO-1, FSDP,
+    Pipeline) capture as on-device copies of their OWN shards, no gather
+    (VERDICT r4 #1); ``assemble`` converts the host tree into the
+    serialized form on the writer thread. Pytree-state trainers capture
+    ``{params, opt_state[, ef]}`` the same way with ``assemble=None``
+    (the host tree IS the serialized form). Custom-protocol trainers
+    WITHOUT the shard-local seam pay a synchronous ``checkpoint_state()``
+    gather here and hand the host tree to the writer. ``custom`` mirrors
+    :func:`capture_state`'s flag (the delta manifest records it)."""
+    if hasattr(trainer, "checkpoint_capture"):
+        live = dict(trainer.checkpoint_capture())
+        if not _fully_addressable(live):
+            return None
+        return _copy_tree_async(live), trainer.checkpoint_assemble, True
+    state, custom = capture_state(trainer)
+    if custom:
+        # the gather inside checkpoint_state was the synchronous part;
+        # the tree is already host numpy
+        return state, None, True
+    if not _fully_addressable(state):
+        return None
+    return _copy_tree_async(state), None, False
+
+
+class _BackgroundWriter:
+    """One-save-in-flight background machinery shared by the async
+    checkpointers. Subclasses call :meth:`_writer_init` in ``__init__``
+    and :meth:`_launch` with the write closure; a background failure is
+    re-raised on the next ``busy``/``save``/``restore``/``close``."""
+
+    def _writer_init(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()  # serializes store access
+        self._inflight: "threading.Thread | None" = None
+        self._errors: list = []
+
+    def _launch(self, write, name: str) -> None:
+        import threading
+
+        def guarded():
+            try:
+                write()
+            except Exception as e:  # surfaced on the next save/drain
+                self._errors.append(e)
+
+        t = threading.Thread(target=guarded, name=name, daemon=True)
+        self._inflight = t
+        t.start()
+
+    def _drain(self) -> None:
+        t = self._inflight
+        if t is not None:
+            t.join()
+            self._inflight = None
+        if self._errors:
+            err = self._errors[:]
+            self._errors.clear()
+            raise RuntimeError(f"background checkpoint save failed: {err[0]}")
+
+    def busy(self) -> bool:
+        t = self._inflight
+        if t is not None and not t.is_alive():
+            self._drain()  # reap + surface any background error
+        return self._inflight is not None
+
+    def wait_until_finished(self) -> None:
+        """Block until the in-flight save (if any) is durable; re-raise a
+        background failure."""
+        self._drain()
+
+
 @dataclasses.dataclass
 class Snapshot:
     """In-memory (host RAM) snapshot of trainer state for fast re-mesh resume.
@@ -164,8 +280,16 @@ class Snapshot:
         trainer.params = place_on(self.params, p_sh)
         trainer.opt_state = place_on(self.opt_state, o_sh)
         trainer.step_num = self.step
-        if self.ef is not None and getattr(trainer, "_ef", None) is not None:
-            _restore_ef(trainer, self.ef)
+        if getattr(trainer, "_ef", None) is not None:
+            if self.ef is not None:
+                _restore_ef(trainer, self.ef)
+            else:
+                # snapshot carries no residual: a stale live one would
+                # re-inject the PRE-restore trajectory's withheld mass
+                # (ADVICE r4) — zero it so restore fully determines state
+                _restore_ef(
+                    trainer, np.zeros(trainer._ef.shape, np.float32)
+                )
 
 
 class TrainerCheckpointer:
@@ -311,6 +435,10 @@ class DeltaCheckpointer:
     """
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3) -> None:
+        if max_to_keep < 1:
+            # sorted(manifests)[:-0] would be an empty slice — pruning
+            # silently off and the store growing unboundedly (ADVICE r4)
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         self.directory = Path(directory).absolute()
         self.blobs = self.directory / "blobs"
         self.blobs.mkdir(parents=True, exist_ok=True)
@@ -329,14 +457,8 @@ class DeltaCheckpointer:
 
     def _capture(self, trainer) -> tuple[dict, bool]:
         state, custom = capture_state(trainer)
-        for leaf in jax.tree.leaves(state):
-            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-                raise NotImplementedError(
-                    "DeltaCheckpointer is a per-host store; state sharded "
-                    "over a mesh that spans OS processes cannot be "
-                    "host-gathered here — use TrainerCheckpointer (Orbax "
-                    "coordinates cross-process saves) on pod meshes"
-                )
+        if not _fully_addressable(state):
+            raise NotImplementedError(_POD_MESH_MSG)
         return jax.tree.map(np.asarray, state), custom
 
     # -- save ----------------------------------------------------------------
@@ -358,16 +480,23 @@ class DeltaCheckpointer:
         """Write a delta checkpoint; returns ``{written_bytes,
         reused_bytes, written_leaves, reused_leaves}`` so callers can see
         the delta actually saving bytes. ``force``/``block`` exist for
-        signature parity with the Orbax checkpointers (a delta save is
-        always synchronous and never step-deduped — an identical re-save
-        just reuses every blob)."""
+        signature parity with the Orbax checkpointers (this save is
+        synchronous — :class:`AsyncDeltaCheckpointer` moves the hash/write
+        off-thread — and never step-deduped: an identical re-save just
+        reuses every blob)."""
+        host, custom = self._capture(trainer)
+        return self._write_delta(host, custom, int(trainer.step_num))
+
+    def _write_delta(self, host: dict, custom: bool, step: int) -> dict:
+        """Hash every leaf, write the new blobs + manifest, prune. Pure
+        host-side work on an already-host tree — the half a background
+        writer thread can run."""
         import hashlib
         import json
 
-        host, custom = self._capture(trainer)
         flat = self._flatten(host)
         manifest = {
-            "step": int(trainer.step_num),
+            "step": step,
             "custom": custom,
             "leaves": {},
         }
@@ -401,11 +530,11 @@ class DeltaCheckpointer:
                 stats["written_bytes"] += arr.nbytes
                 stats["written_leaves"] += 1
             manifest["leaves"][key] = sha
-        tmp = self.directory / f".manifest_{trainer.step_num}.tmp"
+        tmp = self.directory / f".manifest_{step}.tmp"
         tmp.write_text(json.dumps(manifest))
         # atomic rename: a crash mid-save leaves old manifests + maybe some
         # orphan blobs, never a torn manifest
-        tmp.replace(self.directory / f"manifest_{trainer.step_num}.json")
+        tmp.replace(self.directory / f"manifest_{step}.json")
         self._prune()
         return stats
 
@@ -476,6 +605,11 @@ class DeltaCheckpointer:
             trainer.opt_state = place_on(state["opt_state"], o_sh)
             if "ef" in state:
                 _restore_ef(trainer, state["ef"])
+            elif has_ef:
+                # the checkpoint carries no residual: keeping the live
+                # (possibly nonzero, stale) one would make post-restore
+                # state not purely the saved state (ADVICE r4) — zero it
+                _restore_ef(trainer, np.zeros(trainer._ef.shape, np.float32))
         trainer.step_num = int(manifest["step"])
         return trainer.step_num
 
@@ -489,26 +623,27 @@ class DeltaCheckpointer:
         self.close()
 
 
-class AsyncTrainerCheckpointer(TrainerCheckpointer):
+class AsyncTrainerCheckpointer(_BackgroundWriter, TrainerCheckpointer):
     """Checkpoints that do not stall the step loop (VERDICT r3 next-round
     #2: "checkpoint cost is part of the recovery story").
 
     ``save`` splits into a cheap capture phase in the step gap and a
-    background phase off-thread:
+    background phase off-thread (see :func:`async_capture`):
 
-    - **pytree-state trainers** (DP / MoE / Pipeline-less LM / LongContext):
-      capture = ONE on-device copy of the state (HBM-to-HBM, microseconds
-      to milliseconds) + launching ``copy_to_host_async`` on every leaf.
+    - **pytree-state trainers** (DP / MoE / LongContext): capture = ONE
+      on-device copy of the state (HBM-to-HBM, microseconds to
+      milliseconds) + launching ``copy_to_host_async`` on every leaf.
       The training loop resumes immediately and keeps donating its own
       buffers — the copy is independent — while the device-to-host
       transfer (minutes for the 4.8 GB flagship state over a tunneled
       link) overlaps the subsequent steps. The background thread blocks on
       the transfers and then runs the Orbax write.
-    - **trainers with the custom checkpoint protocol** (ZeRO-1, FSDP,
-      Pipeline): ``checkpoint_state()`` gathers to host numpy itself, so
-      the capture phase pays that gather synchronously; only the disk
-      serialization moves off-thread. (Their gathers reshard 1/n state —
-      an async rework belongs to the trainers, not this wrapper.)
+    - **trainers with the shard-local protocol** (ZeRO-1, FSDP, Pipeline —
+      ``checkpoint_capture``/``checkpoint_assemble``): same on-device copy
+      of each shard, NO gather in the capture phase (VERDICT r4 #1); the
+      writer thread drains the shards and runs the trainer's pure-host
+      ``checkpoint_assemble`` (unshard / unpad / re-order) before the
+      Orbax write.
 
     Crash safety: the background write goes through the same Orbax
     manager, which finalizes each step directory atomically — a crash
@@ -523,49 +658,9 @@ class AsyncTrainerCheckpointer(TrainerCheckpointer):
 
     def __init__(self, directory, *, max_to_keep: int = 3) -> None:
         super().__init__(directory, max_to_keep=max_to_keep)
-        import threading
-
-        self._lock = threading.Lock()  # serializes Orbax manager access
-        self._inflight: "threading.Thread | None" = None
-        self._errors: list = []
-
-    # -- capture -------------------------------------------------------------
-
-    @staticmethod
-    def _device_copy(tree):
-        """Donation-proof on-device copy: new buffers with the same
-        shardings, so the training loop's donated originals can die while
-        the copy's device-to-host transfer is still in flight."""
-        import jax.numpy as jnp
-
-        def copy_leaf(x):
-            if isinstance(x, jax.Array):
-                y = jnp.copy(x)
-                y.copy_to_host_async()
-                return y
-            return x
-
-        return jax.tree.map(copy_leaf, tree)
-
-    def _drain(self) -> None:
-        t = self._inflight
-        if t is not None:
-            t.join()
-            self._inflight = None
-        if self._errors:
-            err = self._errors[:]
-            self._errors.clear()
-            raise RuntimeError(f"background checkpoint save failed: {err[0]}")
-
-    def busy(self) -> bool:
-        t = self._inflight
-        if t is not None and not t.is_alive():
-            self._drain()  # reap + surface any background error
-        return self._inflight is not None
+        self._writer_init()
 
     def save(self, trainer, *, force: bool = False, block: bool = False) -> bool:
-        import threading
-
         if self.busy():
             if not block:
                 return False
@@ -574,12 +669,8 @@ class AsyncTrainerCheckpointer(TrainerCheckpointer):
             if trainer.step_num in self._mgr.all_steps():
                 return False
         step = trainer.step_num
-        state, custom = capture_state(trainer)
-        if not all(
-            x.is_fully_addressable
-            for x in jax.tree.leaves(state)
-            if isinstance(x, jax.Array)
-        ):
+        cap = async_capture(trainer)
+        if cap is None:
             # a mesh spanning OS processes: Orbax's cross-process save
             # coordinates ALL processes, and per-process background threads
             # can disagree on busy-skip (one process skips while another
@@ -587,41 +678,27 @@ class AsyncTrainerCheckpointer(TrainerCheckpointer):
             # synchronous path instead; async capture stays a
             # single-controller optimization.
             return super().save(trainer, force=force)
-        state["step"] = step
-        if custom:
-            # custom protocol: the gather inside checkpoint_state was the
-            # synchronous part; hand the host tree straight to the writer
-            captured = state
-        else:
-            captured = self._device_copy(state)
+        captured, assemble, _ = cap
 
         def write():
-            try:
-                host = jax.tree.map(
-                    lambda x: np.asarray(x)
-                    if isinstance(x, (jax.Array, np.ndarray))
-                    else x,
-                    captured,
+            host = jax.tree.map(
+                lambda x: np.asarray(x)
+                if isinstance(x, (jax.Array, np.ndarray))
+                else x,
+                captured,
+            )
+            state = assemble(host) if assemble is not None else host
+            state["step"] = step
+            with self._lock:
+                self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force
                 )
-                with self._lock:
-                    self._mgr.save(
-                        step, args=ocp.args.StandardSave(host), force=force
-                    )
-                    self._mgr.wait_until_finished()
-            except Exception as e:  # surfaced on the next save/drain
-                self._errors.append(e)
+                self._mgr.wait_until_finished()
 
-        t = threading.Thread(target=write, name=f"ckpt-save-{step}", daemon=True)
-        self._inflight = t
-        t.start()
+        self._launch(write, f"ckpt-save-{step}")
         if block:
             self._drain()
         return True
-
-    def wait_until_finished(self) -> None:
-        """Block until the in-flight save (if any) is durable; re-raise a
-        background failure."""
-        self._drain()
 
     def restore(self, trainer, step: int | None = None) -> int:
         self._drain()  # a restore must see the freshest durable step
@@ -636,3 +713,65 @@ class AsyncTrainerCheckpointer(TrainerCheckpointer):
             self._drain()
         finally:
             super().close()
+
+
+class AsyncDeltaCheckpointer(_BackgroundWriter, DeltaCheckpointer):
+    """Delta checkpoints whose hashing and blob writes run off-thread —
+    link-sized saves AND non-stalling saves at once (VERDICT r4 #1: the
+    round-4 store made them mutually exclusive).
+
+    Capture is the same non-gathering phase as
+    :class:`AsyncTrainerCheckpointer` (on-device copies, shard-local for
+    the ZeRO-1/FSDP/Pipeline protocol); the writer thread drains, runs the
+    trainer's ``checkpoint_assemble``, then hashes leaves and writes only
+    the changed blobs. ``save`` returns True when a background save was
+    launched (False while one is still in flight); the per-save byte
+    stats land in :attr:`last_stats` once it completes (``busy()`` →
+    False, or after ``wait_until_finished``). Still a per-host store:
+    non-fully-addressable state raises, as in the sync class."""
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3) -> None:
+        super().__init__(directory, max_to_keep=max_to_keep)
+        self._writer_init()
+        #: stats dict of the most recently COMPLETED save (None before any)
+        self.last_stats: dict | None = None
+
+    def save(
+        self, trainer, *, force: bool = False, block: bool = False
+    ) -> bool:
+        if self.busy():
+            if not block:
+                return False
+            self._drain()
+        step = int(trainer.step_num)
+        cap = async_capture(trainer)
+        if cap is None:
+            raise NotImplementedError(_POD_MESH_MSG)
+        captured, assemble, custom = cap
+
+        def write():
+            host = jax.tree.map(
+                lambda x: np.asarray(x)
+                if isinstance(x, (jax.Array, np.ndarray))
+                else x,
+                captured,
+            )
+            state = assemble(host) if assemble is not None else host
+            with self._lock:
+                self.last_stats = self._write_delta(state, custom, step)
+
+        self._launch(write, f"delta-save-{step}")
+        if block:
+            self._drain()
+        return True
+
+    def latest_step(self) -> int | None:
+        with self._lock:
+            return super().latest_step()
+
+    def restore(self, trainer, step: int | None = None) -> int:
+        self._drain()  # a restore must see the freshest durable step
+        return super().restore(trainer, step)
+
+    def close(self) -> None:
+        self._drain()

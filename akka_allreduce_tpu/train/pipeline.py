@@ -920,15 +920,31 @@ class PipelineLMTrainer:
             is_leaf=self._is_params_container,
         )
 
+    def checkpoint_capture(self) -> dict:
+        """Shard-local device state for the async checkpoint path: trunk
+        leaves stage-sharded, still on device. The async checkpointer
+        copies these HBM-to-HBM and drains them to host in the background
+        (VERDICT r4 #1); :meth:`checkpoint_assemble` un-permutes on the
+        writer thread."""
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def checkpoint_assemble(self, host: dict) -> dict:
+        """Pure-host (numpy) re-order of a captured tree into LOGICAL
+        layer order. Runs on the checkpoint writer thread — must not touch
+        a device."""
+        return self._map_trunk_order(
+            {"params": host["params"], "opt_state": host["opt_state"]},
+            self._layer_perm_inv,
+        )
+
     def checkpoint_state(self) -> dict:
         """Serialize with trunk leaves in LOGICAL layer order, so a
         checkpoint written under any schedule (gpipe / 1f1b / interleaved,
         any virtual_chunks) restores under any other — the device-storage
-        permutation never leaks into the format."""
-        host = jax.tree.map(lambda x: np.asarray(x), dict(
-            params=self.params, opt_state=self.opt_state
-        ))
-        return self._map_trunk_order(host, self._layer_perm_inv)
+        permutation never leaks into the format. Synchronous — the async
+        checkpointer uses capture/assemble directly."""
+        host = jax.tree.map(lambda x: np.asarray(x), self.checkpoint_capture())
+        return self.checkpoint_assemble(host)
 
     def checkpoint_template(self) -> dict:
         """ShapeDtypeStruct twin (reordering preserves shapes/dtypes)."""

@@ -20,6 +20,14 @@ def make_trainer(mesh, seed=0):
     )
 
 
+def _flat_tree(tree) -> np.ndarray:
+    import jax
+
+    return np.concatenate(
+        [np.ravel(np.asarray(l)) for l in jax.tree.leaves(tree)]
+    )
+
+
 class TestSnapshot:
     def test_capture_restore_roundtrip(self):
         mesh = line_mesh(8)
@@ -426,6 +434,221 @@ def DPTrainer_for_crash_test():
     )
 
 
+class TestAsyncShardLocalCapture:
+    """VERDICT r4 #1: sharded-state trainers (ZeRO-1 / FSDP / Pipeline)
+    checkpoint asynchronously WITHOUT a capture-phase gather — capture is
+    an on-device copy of each trainer's own shards; the unshard/serialize
+    (``checkpoint_assemble``) runs on the writer thread."""
+
+    def _fsdp(self, seed=0):
+        from akka_allreduce_tpu.train import FSDPLMTrainer
+
+        return FSDPLMTrainer(
+            line_mesh(8), vocab=16, d_model=32, n_heads=4, n_layers=2,
+            seq_len=32, optimizer=optax.adam(1e-3), seed=seed,
+        )
+
+    def _pp(self, seed=0):
+        import jax
+
+        from akka_allreduce_tpu.train import PipelineLMTrainer
+
+        mesh = jax.make_mesh((2, 4), ("data", "pipe"))
+        return PipelineLMTrainer(
+            mesh, layers_per_stage=1, vocab=16, d_model=32, n_heads=4,
+            microbatches=2, seq_len=32, learning_rate=1e-2, seed=seed,
+        )
+
+    def _no_sync_gather(self, monkeypatch, t):
+        """Fail the test if the synchronous gathering path runs on the
+        caller thread during an async save."""
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "checkpoint_state (sync gather) called during async save"
+            )
+
+        monkeypatch.setattr(t, "checkpoint_state", boom)
+
+    def test_fsdp_async_no_gather_in_capture(self, tmp_path, monkeypatch):
+        from akka_allreduce_tpu.models import data as mdata
+        from akka_allreduce_tpu.train import AsyncTrainerCheckpointer
+
+        t = self._fsdp()
+        ds = mdata.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(8, 1))
+        t.train_step(x, y)
+        ref = _flat_tree(t.gathered_params())
+        self._no_sync_gather(monkeypatch, t)
+        with AsyncTrainerCheckpointer(tmp_path / "f") as ckpt:
+            assert ckpt.save(t)
+            t.train_step(x, y)  # donation while the transfer is in flight
+            ckpt.wait_until_finished()
+            fresh = self._fsdp(seed=9)
+            assert ckpt.restore(fresh) == 1
+        np.testing.assert_array_equal(_flat_tree(fresh.gathered_params()), ref)
+        # capture really was shard-local: every captured trunk leaf is a
+        # device array sharded over the mesh, not a host gather
+        import jax
+
+        cap = t.checkpoint_capture()
+        trunk = jax.tree.leaves(cap["params"]["trunk"])
+        assert all(isinstance(l, jax.Array) for l in trunk)
+        # each device holds strictly less than the full leaf (no gather)
+        assert all(
+            l.addressable_shards[0].data.shape[1] < l.shape[1] for l in trunk
+        )
+
+    def test_pipeline_async_roundtrip(self, tmp_path, monkeypatch):
+        from akka_allreduce_tpu.models import data as mdata
+        from akka_allreduce_tpu.train import AsyncTrainerCheckpointer
+
+        t = self._pp()
+        ds = mdata.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(4, 1))
+        t.train_step(x, y)
+        ref = t.get_flat_params().copy()
+        self._no_sync_gather(monkeypatch, t)
+        with AsyncTrainerCheckpointer(tmp_path / "p") as ckpt:
+            assert ckpt.save(t)
+            t.train_step(x, y)
+            ckpt.wait_until_finished()
+            fresh = self._pp(seed=9)
+            assert ckpt.restore(fresh) == 1
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+
+    def test_zero1_async_no_gather_with_ef(self, tmp_path, monkeypatch):
+        from akka_allreduce_tpu.models import MLP
+        from akka_allreduce_tpu.train import (
+            AsyncTrainerCheckpointer,
+            Zero1DPTrainer,
+        )
+
+        def mk(seed):
+            return Zero1DPTrainer(
+                MLP(hidden=(16,), classes=10), line_mesh(8),
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.adam(1e-3), seed=seed,
+                compress="bf16", error_feedback=True,
+            )
+
+        t = mk(0)
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        t.train_step(x, y, valid=[1, 1, 1, 0, 1, 1, 1, 1])
+        ref = t.get_flat_params().copy()
+        ef_sum = np.asarray(t._ef).sum(axis=0)[: t.param_count].copy()
+        self._no_sync_gather(monkeypatch, t)
+        with AsyncTrainerCheckpointer(tmp_path / "z") as ckpt:
+            assert ckpt.save(t)
+            ckpt.wait_until_finished()
+            fresh = mk(9)
+            assert ckpt.restore(fresh) == 1
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+        np.testing.assert_allclose(
+            np.asarray(fresh._ef).sum(axis=0)[: fresh.param_count],
+            ef_sum, rtol=1e-6, atol=1e-7,
+        )
+
+
+class TestAsyncDeltaCheckpointer:
+    """VERDICT r4 #1 second half: link-sized (delta) saves that also do
+    not stall — hashing and blob writes run on the writer thread over the
+    same non-gathering capture."""
+
+    def test_roundtrip_stats_and_dedup(self, tmp_path):
+        from akka_allreduce_tpu.train import AsyncDeltaCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        ref = t.get_flat_params().copy()
+        store = AsyncDeltaCheckpointer(tmp_path / "ad")
+        assert store.save(t)
+        store.wait_until_finished()
+        s1 = store.last_stats
+        assert s1["written_leaves"] > 0 and s1["reused_leaves"] == 0
+
+        # identical immediate re-save: every blob reused, zero bytes
+        assert store.save(t, block=True)
+        s2 = store.last_stats
+        assert s2["written_bytes"] == 0
+        assert s2["reused_leaves"] == s1["written_leaves"]
+
+        t.train(ds.batches(32, 2, seed_offset=5))  # diverge
+        fresh = make_trainer(line_mesh(8), seed=3)
+        assert store.restore(fresh, 1) == 1
+        np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+
+    def test_busy_skip_then_next_save(self, tmp_path, monkeypatch):
+        import threading
+
+        from akka_allreduce_tpu.train import AsyncDeltaCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        store = AsyncDeltaCheckpointer(tmp_path / "busy")
+        gate = threading.Event()
+        real = store._write_delta
+
+        def slow(*a, **k):
+            assert gate.wait(30)
+            return real(*a, **k)
+
+        monkeypatch.setattr(store, "_write_delta", slow)
+        assert store.save(t)
+        assert not store.save(t)  # busy -> skipped, not queued
+        gate.set()
+        store.wait_until_finished()
+        assert store.latest_step() == 1
+
+    def test_fsdp_shard_local_delta(self, tmp_path, monkeypatch):
+        from akka_allreduce_tpu.models import data as mdata
+        from akka_allreduce_tpu.train import (
+            AsyncDeltaCheckpointer,
+            FSDPLMTrainer,
+        )
+
+        def mk(seed):
+            return FSDPLMTrainer(
+                line_mesh(8), vocab=16, d_model=32, n_heads=4, n_layers=2,
+                seq_len=32, optimizer=optax.adam(1e-3), seed=seed,
+            )
+
+        t = mk(0)
+        ds = mdata.lm_copy_task(32, vocab=16)
+        x, y = next(ds.batches(8, 1))
+        t.train_step(x, y)
+        ref = _flat_tree(t.gathered_params())
+
+        def boom(*a, **k):
+            raise AssertionError("sync gather during async delta save")
+
+        monkeypatch.setattr(t, "checkpoint_state", boom)
+        store = AsyncDeltaCheckpointer(tmp_path / "fd")
+        assert store.save(t, block=True)
+        assert store.last_stats["written_leaves"] > 0
+        fresh = mk(9)
+        assert store.restore(fresh) == 1
+        np.testing.assert_array_equal(_flat_tree(fresh.gathered_params()), ref)
+
+    def test_background_failure_surfaces(self, tmp_path, monkeypatch):
+        from akka_allreduce_tpu.train import AsyncDeltaCheckpointer
+
+        t = make_trainer(line_mesh(8))
+        ds = data.mnist_like()
+        t.train(ds.batches(32, 1))
+        store = AsyncDeltaCheckpointer(tmp_path / "err")
+        monkeypatch.setattr(
+            store, "_write_delta",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+        )
+        assert store.save(t)
+        with pytest.raises(RuntimeError, match="disk full"):
+            store.wait_until_finished()
+
+
 class TestDeltaCheckpointer:
     """Per-leaf content-addressed delta saves: unchanged leaves cost zero
     bytes, blobs dedupe across steps, pruning drops unreferenced blobs."""
@@ -493,6 +716,47 @@ class TestDeltaCheckpointer:
             live.update(json.loads(f.read_text())["leaves"].values())
         on_disk = {b.stem for b in store.blobs.glob("*.npy")}
         assert on_disk == live
+
+    def test_max_to_keep_must_be_positive(self, tmp_path):
+        from akka_allreduce_tpu.train import DeltaCheckpointer
+
+        with pytest.raises(ValueError, match="max_to_keep"):
+            DeltaCheckpointer(tmp_path / "bad", max_to_keep=0)
+
+    def test_restore_zeroes_stale_ef_when_checkpoint_has_none(self, tmp_path):
+        """ADVICE r4: restoring a no-EF checkpoint into a trainer with a
+        live nonzero residual must zero it — post-restore state is purely
+        the saved state."""
+        from akka_allreduce_tpu.train import DeltaCheckpointer
+
+        def mk_ef(seed):
+            return DPTrainer(
+                MLP(hidden=(8,), classes=10), line_mesh(8),
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.sgd(0.1), seed=seed,
+                compress="bf16", error_feedback=True,
+            )
+
+        import jax
+
+        ds = data.mnist_like()
+        t = mk_ef(3)
+        x, y = next(iter(ds.batches(64, 1)))
+        t.train_step(x, y, valid=[1, 1, 1, 0, 1, 1, 1, 1])
+        assert np.linalg.norm(np.asarray(t._ef)) > 0  # live stale residual
+        # a checkpoint of the same structure but WITHOUT ef leaves
+        # (simulates an older no-EF save)
+        t2 = mk_ef(5)
+        t2.train_step(x, y)
+        store = DeltaCheckpointer(tmp_path / "ef1")
+        host = jax.tree.map(
+            np.asarray, {"params": t2.params, "opt_state": t2.opt_state}
+        )
+        store._write_delta(host, False, int(t2.step_num))
+
+        t.step_num = t2.step_num
+        store.restore(t)
+        assert np.linalg.norm(np.asarray(t._ef)) == 0.0
 
     def test_custom_protocol_trainer(self, tmp_path):
         from akka_allreduce_tpu.models import MLP
