@@ -544,8 +544,8 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         help="per-layer collective compression: bf16 halves FSDP's "
         "collective bytes (gather + reduce-scatter transpose); int8 "
         "quarters them — one quantization per shard on the forward "
-        "gather, the explicit per-hop-scaled ring reduce-scatter on "
-        "backward (single gather axis only; master params/moments stay "
+        "gather, sequential per-axis per-hop-scaled ring reduce-scatters "
+        "on backward (composes with --sp; master params/moments stay "
         "f32 either way)",
     )
     p.add_argument(
@@ -1728,6 +1728,11 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
         "--store", choices=("orbax", "delta"), default="orbax",
         help="delta: content-addressed per-leaf store (async hashing)",
     )
+    p.add_argument(
+        "--remat", choices=("full", "params"), default=None,
+        help="fsdp only: rematerialization mode (the flagship size OOMs "
+        "one chip without it — same flag as bench-mfu)",
+    )
     p.add_argument("--baseline-steps", type=int, default=5)
     p.add_argument("--max-steps-during", type=int, default=200)
     p.add_argument("--dir", default=None, help="default: a temp dir")
@@ -1771,7 +1776,9 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
             data_seq_mesh(1, 1), learning_rate=1e-3, **lm_kw
         )
     elif args.trainer == "fsdp":
-        trainer = FSDPLMTrainer(line_mesh(n_dev), **lm_kw)
+        trainer = FSDPLMTrainer(
+            line_mesh(n_dev), remat=args.remat or False, **lm_kw
+        )
     elif args.trainer == "pipeline":
         pp = n_dev  # all devices as stages (1 on the real chip)
         pp_kw = dict(lm_kw)

@@ -182,14 +182,6 @@ class FSDPLMTrainer:
             raise ValueError(
                 f"compress must be None, 'bf16' or 'int8', got {compress!r}"
             )
-        if compress == "int8" and len(
-            tuple(a for a in axes if a != "model")
-        ) != 1:
-            raise ValueError(
-                "compress='int8' rides the explicit ring reduce-scatter, "
-                "which reduces over ONE gather axis; FSDP x SP gathers "
-                "over (data, seq) — use bf16 there"
-            )
         if prefetch and remat == "full":
             raise ValueError(
                 "prefetch and full remat do not compose: the prefetched "
@@ -363,14 +355,21 @@ class FSDPLMTrainer:
             )
             from akka_allreduce_tpu.ops.ring import int8_quantize
 
-            ring_axis = g_axes[0]
             n_shards = self.gather_shards
+            # tile order of a multi-axis tiled all_gather is row-major over
+            # the axis tuple (first axis outermost), so its transpose
+            # decomposes into SEQUENTIAL per-axis rings: reduce-scatter the
+            # outer axis first (segments of inner_size*shard), then the
+            # inner axis — each ring carries int8 per-hop payloads. This
+            # closes the old FSDP x SP exclusion (VERDICT r4 #4b): gathers
+            # over (data, seq) now run quarter-width both ways.
+            axis_sizes = [int(self.mesh.shape[a]) for a in g_axes]
 
             @jax.custom_vjp
             def int8_gather(flat):
                 q, sc = int8_quantize(flat)
-                qf = lax.all_gather(q, ring_axis, tiled=True)
-                scf = lax.all_gather(sc.reshape(1), ring_axis, tiled=True)
+                qf = lax.all_gather(q, g_axes, tiled=True)
+                scf = lax.all_gather(sc.reshape(1), g_axes, tiled=True)
                 return (
                     qf.reshape(n_shards, -1).astype(jnp.float32)
                     * scf[:, None]
@@ -381,14 +380,16 @@ class FSDPLMTrainer:
 
             def _bwd(_, ct):
                 # the all_gather's transpose is reduce-scatter; ride the
-                # explicit int8 ring so the backward wire is quarter-width
-                # too (per-hop scales; ct length = n * shard, so segments
-                # align with the tiled gather layout exactly)
-                return (
-                    ring_reduce_scatter_sum(
-                        ct, ring_axis, n_shards, compress="int8"
-                    ),
-                )
+                # explicit int8 ring(s) so the backward wire is
+                # quarter-width too (per-hop scales; ct length =
+                # prod(axis_sizes) * shard, so segments align with the
+                # tiled gather layout exactly, outer axis first)
+                out = ct
+                for ax, sz in zip(g_axes, axis_sizes):
+                    out = ring_reduce_scatter_sum(
+                        out, ax, sz, compress="int8"
+                    )
+                return (out,)
 
             int8_gather.defvjp(_fwd, _bwd)
 

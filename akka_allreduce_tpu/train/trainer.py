@@ -28,9 +28,12 @@ from jax.sharding import PartitionSpec as P
 from akka_allreduce_tpu.binder.api import flatten_pytree
 from akka_allreduce_tpu.comm.allreduce import (
     backward_psum_sync,
+    backward_ring_sync,
+    backward_sync_ef,
     expand_counts,
     masked_psum,
     ring_allreduce_sum,
+    ring_ef_residual,
 )
 
 
@@ -52,41 +55,25 @@ def ef_residual(
     ef,
     *,
     compress: str = "bf16",
-    n_segments: int | None = None,
 ) -> jax.Array:
     """``e' = c - sent``; all of ``c`` carries forward when the device was
     masked out.
 
     ``compress="bf16"``: ``sent`` mirrors masked_psum's mask-then-cast
     EXACTLY (what the bf16 collective actually summed from this device).
-
-    ``compress="int8"`` (VERDICT r3 next-round #7a): ``sent`` mirrors the
-    ring's FIRST-HOP quantization of this device's contribution — the
-    same per-segment max-abs int8 formula over the same ``n_segments``
-    (= ring length) segmentation, computed locally. This captures the
-    device's OWN quantization error, the only part that is locally
-    computable; the ring additionally re-quantizes partial SUMS at every
-    later hop, and that per-hop noise has no local residual — it remains
-    uncompensated. It is bounded by the hop scale (max|sum|/127 per
-    element per hop, ~linear in ring length) and has no systematic sign,
-    whereas the first-hop error EF recovers is the per-device bias that
-    would otherwise accumulate step over step.
+    The bf16 cast error is entirely local, so this residual is the
+    complete compensation. The int8 ring no longer routes through here:
+    its residual comes from the ring itself
+    (``ring_allreduce_sum(..., return_residual=True)`` — per-hop
+    accounting including partial-sum requantization, VERDICT r4 #4c).
     """
-    m = c * v
-    if compress == "int8":
-        from akka_allreduce_tpu.ops.ring import int8_quantize
-
-        if not n_segments:
-            raise ValueError("int8 residual needs n_segments (ring length)")
-        data = m.shape[0]
-        seg = -(-data // n_segments)
-        segs = jnp.pad(m, (0, n_segments * seg - data)).reshape(
-            n_segments, seg
+    if compress != "bf16":
+        raise ValueError(
+            f"ef_residual is the bf16 mask-then-cast mirror; int8 uses the "
+            f"ring's per-hop residual (got compress={compress!r})"
         )
-        q, s = jax.vmap(int8_quantize)(segs)
-        sent = (q.astype(jnp.float32) * s[:, None]).reshape(-1)[:data]
-    else:
-        sent = m.astype(jnp.bfloat16).astype(jnp.float32)
+    m = c * v
+    sent = m.astype(jnp.bfloat16).astype(jnp.float32)
     return (c - sent).reshape(ef.shape)
 
 
@@ -312,8 +299,11 @@ class DPTrainer:
         on leaf k's backward subgraph, so the latency-hiding scheduler
         (TPU async all-reduce pairs) can hide it behind the remaining
         backward compute — SURVEY.md §8.4's overlap story. Composes with
-        ``compress="bf16"``; mutually exclusive with ``bucket_size``
-        (leaf granularity IS the bucketing), int8, and error_feedback.
+        ``compress`` (bf16 psums; int8 = one per-leaf ring,
+        ``backward_ring_sync``) AND ``error_feedback`` (the new residual
+        rides the same autodiff pass as each leaf's e-cotangent —
+        VERDICT r4 #4a); mutually exclusive only with ``bucket_size``
+        (leaf granularity IS the bucketing).
     """
 
     def __init__(
@@ -331,14 +321,11 @@ class DPTrainer:
         error_feedback: bool = False,
         overlap: bool = False,
     ) -> None:
-        if overlap and (bucket_size is not None or compress == "int8"
-                        or error_feedback):
+        if overlap and bucket_size is not None:
             raise ValueError(
                 "overlap issues ONE collective per param leaf inside the "
-                "backward pass — leaf granularity IS its bucketing, and "
-                "neither the int8 ring nor the EF residual fit a per-leaf "
-                "in-backward collective; use overlap with compress=None or "
-                "'bf16' only"
+                "backward pass — leaf granularity IS its bucketing; "
+                "bucket_size does not compose with it"
             )
         if compress not in (None, "bf16", "int8"):
             raise ValueError(
@@ -353,10 +340,11 @@ class DPTrainer:
             raise ValueError(
                 "error_feedback requires compress='bf16' or 'int8' "
                 "(lossless sync has no residual to carry). bf16's cast "
-                "error is exactly local; int8 EF compensates the FIRST-HOP "
-                "quantization of this device's contribution — the ring's "
-                "later per-hop requantization of partial sums has no local "
-                "residual and remains (see ef_residual)"
+                "error is exactly local (ef_residual); int8 EF is per-hop: "
+                "the ring returns every quantization error this device "
+                "injected — its own contribution's first hop AND its "
+                "requantization of relayed partial sums — and the full "
+                "amount is re-sent next step (VERDICT r4 #4c)"
             )
         self.model = model
         self.mesh = mesh
@@ -421,13 +409,27 @@ class DPTrainer:
                 # ring segments by DEVICE COUNT, so bucket_size only sets
                 # count granularity here, not wire chunking. Counts reuse
                 # the scalar psum already computed above — no extra
-                # collective on the hot path.
-                gsum = ring_allreduce_sum(
-                    c * v.astype(c.dtype),
-                    axis_names[0],
-                    n_devices_static,
-                    compress="int8",
-                )
+                # collective on the hot path. With EF, the ring also
+                # returns this device's PER-HOP injected quantization error
+                # (partial-sum requantization included — VERDICT r4 #4c),
+                # which becomes next step's residual: e' = c·(1−v) + hops.
+                if ef is None:
+                    gsum = ring_allreduce_sum(
+                        c * v.astype(c.dtype),
+                        axis_names[0],
+                        n_devices_static,
+                        compress="int8",
+                    )
+                    new_ef = None
+                else:
+                    gsum, hop_err = ring_allreduce_sum(
+                        c * v.astype(c.dtype),
+                        axis_names[0],
+                        n_devices_static,
+                        compress="int8",
+                        return_residual=True,
+                    )
+                    new_ef = ring_ef_residual(c, v, hop_err).reshape(ef.shape)
                 cnt = jnp.full((n_buckets,), scalar_cnt, jnp.float32)
             else:
                 # bf16 wire: masked_psum runs the payload collective at half
@@ -439,9 +441,9 @@ class DPTrainer:
                     bucket_size=b,
                     wire_dtype=jnp.bfloat16 if wire_bf16 else None,
                 )
-            new_ef = None if ef is None else ef_residual(
-                c, v, ef, compress=compress, n_segments=n_devices_static
-            )
+                new_ef = None if ef is None else ef_residual(
+                    c, v, ef, compress=compress
+                )
             denom_el = jnp.maximum(expand_counts(cnt, flat.shape[0], b), 1.0)
             gavg = unravel(gsum / denom_el)
             loss_avg = lax.psum(loss * v, axis_names) / denom
@@ -450,32 +452,68 @@ class DPTrainer:
             return new_params, new_opt, new_ef, loss_avg, scalar_cnt
 
         if overlap:
-            grad_sync = backward_psum_sync(
-                axis_names,
-                jnp.bfloat16 if wire_bf16 else None,
-            )
+            wire = jnp.bfloat16 if wire_bf16 else None
+            if compress == "int8":
+                # per-leaf int8 ring inside the backward (VERDICT r4 #4a)
+                grad_sync = backward_ring_sync(
+                    axis_names[0], n_devices_static, compress="int8"
+                )
+                grad_sync_ef = backward_ring_sync(
+                    axis_names[0], n_devices_static, compress="int8",
+                    error_feedback=True,
+                ) if error_feedback else None
+            else:
+                grad_sync = backward_psum_sync(axis_names, wire)
+                grad_sync_ef = (
+                    backward_sync_ef(axis_names, wire)
+                    if error_feedback
+                    else None
+                )
 
-            def overlapped_step(params, opt_state, x, y, v):
+            def overlapped_step(params, opt_state, x, y, v, ef=None):
                 """Per-leaf collectives issued INSIDE the backward pass:
-                leaf k's psum depends only on leaf k's backward subgraph, so
-                the latency-hiding scheduler can run it behind the rest of
-                the backward (SURVEY.md §8.4; backward_psum_sync)."""
+                leaf k's psum (or int8 ring) depends only on leaf k's
+                backward subgraph, so the latency-hiding scheduler can run
+                it behind the rest of the backward (SURVEY.md §8.4;
+                backward_psum_sync / backward_ring_sync). With EF, the
+                flat residual is unraveled into param-shaped leaves, each
+                leaf's sync folds its residual into the cotangent, and the
+                NEW residual comes back as the e-cotangent of the same
+                autodiff pass — e' = ravel of those leaves."""
                 scalar_cnt = lax.psum(v, axis_names)
                 denom = jnp.maximum(scalar_cnt, 1.0)
                 params_local = jax.tree.map(
                     lambda p: lax.pcast(p, axis_names, to="varying"), params
                 )
+                if ef is None:
 
-                def local_loss(pt):
-                    ps = jax.tree.map(lambda p: grad_sync(p, v), pt)
-                    return loss_impl(model_apply(ps, x), y)
+                    def local_loss(pt):
+                        ps = jax.tree.map(lambda p: grad_sync(p, v), pt)
+                        return loss_impl(model_apply(ps, x), y)
 
-                loss, gsum = jax.value_and_grad(local_loss)(params_local)
+                    loss, gsum = jax.value_and_grad(local_loss)(params_local)
+                    new_ef = None
+                else:
+                    _, unravel_p = ravel_pytree(params_local)
+                    ef_tree = unravel_p(ef.reshape(-1))
+
+                    def local_loss_ef(pt, et):
+                        ps = jax.tree.map(
+                            lambda p, e: grad_sync_ef(p, e, v), pt, et
+                        )
+                        return loss_impl(model_apply(ps, x), y)
+
+                    loss, (gsum, new_ef_tree) = jax.value_and_grad(
+                        local_loss_ef, argnums=(0, 1)
+                    )(params_local, ef_tree)
+                    new_ef = ravel_pytree(new_ef_tree)[0].reshape(ef.shape)
                 gavg = jax.tree.map(lambda g: g / denom, gsum)
                 loss_avg = lax.psum(loss * v, axis_names) / denom
                 updates, new_opt = tx.update(gavg, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
-                return new_params, new_opt, loss_avg, scalar_cnt
+                if ef is None:
+                    return new_params, new_opt, loss_avg, scalar_cnt
+                return new_params, new_opt, new_ef, loss_avg, scalar_cnt
 
         def step(params, opt_state, x, y, valid):
             v = valid.reshape(())
@@ -526,9 +564,10 @@ class DPTrainer:
             )
 
             def step_ef(params, opt_state, ef, x, y, valid):
-                return explicit_step(
-                    params, opt_state, x, y, valid.reshape(()), ef
-                )
+                v = valid.reshape(())
+                if overlap:
+                    return overlapped_step(params, opt_state, x, y, v, ef)
+                return explicit_step(params, opt_state, x, y, v, ef)
 
             self._raw_step_ef = step_ef  # reused by train_chain's EF loop
             self._step_ef = jax.jit(
@@ -539,9 +578,10 @@ class DPTrainer:
                         P(), P(), data_spec, data_spec, data_spec, data_spec
                     ),
                     out_specs=(P(), P(), data_spec, P(), P()),
-                    # the int8 ring's ppermute loop erases varying-axes
-                    # typing (same relaxation as the non-EF step above)
-                    check_vma=compress != "int8",
+                    # the int8 ring's ppermute loop and the overlap
+                    # custom_vjp erase varying-axes typing (same relaxation
+                    # as the non-EF step above)
+                    check_vma=(compress != "int8" and not overlap),
                 ),
                 donate_argnums=(0, 1, 2),
             )
@@ -673,18 +713,32 @@ class DPTrainer:
                 # the accumulated mean gradient — the same explicit
                 # collective the plain step uses, amortized over the whole
                 # accumulation (VERDICT r3 #5a). Counts reuse the scalar
-                # psum. EF composes (round 4): ef_residual below mirrors
-                # this ring's first-hop quantization of c.
-                total = ring_allreduce_sum(
-                    c * v.astype(c.dtype),
-                    axis_names[0],
-                    self.n_devices,
-                    compress="int8",
-                )
+                # psum. EF composes per-hop exactly as in the plain step
+                # (VERDICT r4 #4c): e' = c·(1−v) + ring hop errors.
+                if ef is None:
+                    total = ring_allreduce_sum(
+                        c * v.astype(c.dtype),
+                        axis_names[0],
+                        self.n_devices,
+                        compress="int8",
+                    )
+                    new_ef = None
+                else:
+                    total, hop_err = ring_allreduce_sum(
+                        c * v.astype(c.dtype),
+                        axis_names[0],
+                        self.n_devices,
+                        compress="int8",
+                        return_residual=True,
+                    )
+                    new_ef = ring_ef_residual(c, v, hop_err).reshape(ef.shape)
                 denom_el = denom  # per-element == scalar count (one ring)
             elif bucket is None:
                 total, cnt = masked_psum(c, v, axis_names, wire_dtype=wire)
                 denom_el = jnp.maximum(cnt, 1.0)
+                new_ef = None if ef is None else ef_residual(
+                    c, v, ef, compress=self.compress
+                )
             else:
                 n_buckets = -(-flat.shape[0] // bucket)
                 total, cnt = masked_psum(
@@ -697,10 +751,9 @@ class DPTrainer:
                 denom_el = jnp.maximum(
                     expand_counts(cnt, flat.shape[0], bucket), 1.0
                 )
-            new_ef = None if ef is None else ef_residual(
-                c, v, ef, compress=self.compress,
-                n_segments=self.n_devices,
-            )
+                new_ef = None if ef is None else ef_residual(
+                    c, v, ef, compress=self.compress
+                )
             gavg = unravel(total / denom_el)
             loss_avg = lax.psum(lsum * v / accum_steps, axis_names) / denom
             updates, new_opt = tx.update(gavg, opt_state, params)
@@ -868,9 +921,11 @@ class DPTrainer:
                 mesh=self.mesh,
                 in_specs=(P(), P(), self._data_spec, P(), self._data_spec),
                 out_specs=(P(), P(), self._data_spec, P(), P()),
-                # same int8-ring caveat as the step's shard_map (EF
-                # excludes overlap, so only the ring relaxation applies)
-                check_vma=self.compress != "int8",
+                # same relaxations as _step_ef's shard_map: the int8
+                # ring's ppermute loop and the overlap custom_vjp both
+                # erase varying-axes typing (overlap composes with EF
+                # since VERDICT r4 #4a)
+                check_vma=(self.compress != "int8" and not self.overlap),
             )
             return jax.jit(mapped, donate_argnums=(0, 1, 2))
 

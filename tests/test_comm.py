@@ -291,3 +291,129 @@ class TestRingReduceScatter:
         tol = {None: 1e-5, "bf16": 2e-2, "int8": 0.3}[compress]
         scale = np.abs(want).max()
         np.testing.assert_allclose(out, want, atol=tol * scale, rtol=0)
+
+
+class TestRingPerHopResidual:
+    """Per-hop error feedback (VERDICT r4 #4c): the compressed rings
+    return each device's locally-computed injected quantization error, and
+    the accounting is EXACT — summing every device's residual recovers the
+    f32 result from the compressed result, element by element. This is the
+    identity that makes re-sending the residual next round a full
+    compensation of the per-hop noise (not just the first hop)."""
+
+    N = 8
+
+    def _allreduce(self, xs, compress):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from akka_allreduce_tpu.comm.allreduce import ring_allreduce_sum
+
+        n = self.N
+        mesh = line_mesh(n)
+        fn = jax.jit(
+            jax.shard_map(
+                lambda x: tuple(
+                    a[None]
+                    for a in ring_allreduce_sum(
+                        x.reshape(-1), "line", n, compress=compress,
+                        return_residual=True,
+                    )
+                ),
+                mesh=mesh,
+                in_specs=P("line"),
+                out_specs=(P("line"), P("line")),
+                check_vma=False,
+            )
+        )
+        out, resid = fn(xs)
+        return np.asarray(out), np.asarray(resid)
+
+    @pytest.mark.parametrize("compress", ["bf16", "int8"])
+    @pytest.mark.parametrize("data", [4096, 4100])  # exact + padded tail
+    def test_allreduce_residual_accounting_identity(self, compress, data):
+        rng = np.random.default_rng(11)
+        xs = rng.standard_normal((self.N, data)).astype(np.float32)
+        out, resid = self._allreduce(xs, compress)
+        want = xs.sum(0, dtype=np.float64).astype(np.float32)
+        scale = np.abs(want).max()
+        # the compressed result alone is off by the per-hop noise...
+        assert np.abs(out[0] - want).max() > 1e-4 * scale
+        # ...and adding every device's residual recovers f32 exactly
+        # (up to reassociation dust + the gather's ~1-ulp scale drift)
+        recovered = out[0] + resid.sum(0)
+        np.testing.assert_allclose(
+            recovered, want, atol=5e-5 * scale, rtol=0
+        )
+
+    def test_residual_is_per_device_local(self):
+        """A device that contributes zeros still injects requantization
+        error while RELAYING others' partial sums — its residual must be
+        nonzero (what masked-device EF re-sends) and the identity must
+        still hold."""
+        rng = np.random.default_rng(12)
+        xs = rng.standard_normal((self.N, 2048)).astype(np.float32)
+        xs[3] = 0.0
+        out, resid = self._allreduce(xs, "int8")
+        assert np.abs(resid[3]).max() > 0.0
+        want = xs.sum(0, dtype=np.float64).astype(np.float32)
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(
+            out[0] + resid.sum(0), want, atol=5e-5 * scale, rtol=0
+        )
+
+    @pytest.mark.parametrize("data", [4096, 4100])
+    def test_reduce_scatter_residual_identity(self, data):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from akka_allreduce_tpu.comm.allreduce import ring_reduce_scatter_sum
+
+        n = self.N
+        mesh = line_mesh(n)
+        rng = np.random.default_rng(13)
+        xs = rng.standard_normal((n, data)).astype(np.float32)
+        fn = jax.jit(
+            jax.shard_map(
+                lambda x: tuple(
+                    a[None]
+                    for a in ring_reduce_scatter_sum(
+                        x.reshape(-1), "line", n, compress="int8",
+                        return_residual=True,
+                    )
+                ),
+                mesh=mesh,
+                in_specs=P("line"),
+                out_specs=(P("line"), P("line")),
+                check_vma=False,
+            )
+        )
+        out, resid = fn(xs)
+        out, resid = np.asarray(out), np.asarray(resid)
+        seg = -(-data // n)
+        want = np.pad(
+            xs.sum(0, dtype=np.float64).astype(np.float32),
+            (0, n * seg - data),
+        ).reshape(n, seg)
+        scale = np.abs(want).max()
+        # device i's segment + everyone's residual at segment i = f32
+        resid_segs = resid.sum(0).reshape(n, seg)
+        np.testing.assert_allclose(
+            out + resid_segs, want, atol=5e-5 * scale, rtol=0
+        )
+
+    def test_residual_requires_compress(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from akka_allreduce_tpu.comm.allreduce import ring_allreduce_sum
+
+        with pytest.raises(ValueError, match="compress"):
+            jax.shard_map(
+                lambda x: ring_allreduce_sum(
+                    x.reshape(-1), "line", 8, return_residual=True
+                )[None],
+                mesh=line_mesh(8),
+                in_specs=P("line"),
+                out_specs=P("line"),
+            )(rand(8, 64))

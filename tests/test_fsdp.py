@@ -563,8 +563,38 @@ class TestInt8Collectives:
         m = t.train_step(x, y)
         assert np.isfinite(m.loss)
 
-    def test_rejects_multi_axis_gather(self):
+    def test_fsdp_sp_multi_axis_int8_tracks_f32(self):
+        """FSDP x SP int8 (VERDICT r4 #4b — the old ONE-gather-axis
+        exclusion is closed): the (data, seq) tiled all_gather carries
+        int8 payloads, its transpose runs SEQUENTIAL per-axis int8 rings
+        (outer axis first). Numerics must track the f32 FSDP x SP run in
+        the int8 band, masked rows included."""
         from akka_allreduce_tpu.parallel import data_seq_mesh
 
-        with pytest.raises(ValueError, match="ONE gather axis"):
-            _mk(data_seq_mesh(2, 4), compress="int8")
+        mesh = data_seq_mesh(2, 4)
+        t0 = _mk(mesh)
+        t8 = _mk(mesh, compress="int8")
+        ds = data.lm_copy_task(32, vocab=16)
+        valid = np.ones(2, np.float32)
+        valid[1] = 0.0
+        for i, (x, y) in enumerate(ds.batches(4, 5)):
+            v = valid if i == 2 else None
+            m0 = t0.train_step(x, y, v)
+            m8 = t8.train_step(x, y, v)
+            assert np.isfinite(m8.loss)
+            assert abs(m8.loss - m0.loss) < 0.2, (i, m8.loss, m0.loss)
+        p0, p8 = _flat(t0.gathered_params()), _flat(t8.gathered_params())
+        drift = np.abs(p8 - p0).max() / (np.abs(p0).max() + 1e-9)
+        assert 0 < drift < 5e-2, drift
+
+    def test_fsdp_sp_tp_int8_runs(self):
+        """The full 3-axis composition: Megatron TP slices FSDP-shard over
+        (data, seq) with int8 collectives on the gather axes."""
+        from akka_allreduce_tpu.parallel import data_seq_model_mesh
+
+        t = _mk(data_seq_model_mesh(2, 2, 2), compress="int8")
+        ds = data.lm_copy_task(32, vocab=16)
+        losses = [
+            t.train_step(x, y).loss for x, y in ds.batches(4, 4)
+        ]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0], losses

@@ -109,19 +109,98 @@ class TestOverlapNumerics:
         assert len(hist) == 4 and hist[-1].loss < hist[0].loss
 
     def test_guards(self, line8):
-        for kw in (
-            dict(bucket_size=1000),
-            dict(compress="int8"),
-            dict(compress="bf16", error_feedback=True),
-        ):
-            with pytest.raises(ValueError, match="overlap"):
-                _make(line8, overlap=True, **kw)
+        # bucketing is the one remaining exclusion (leaf granularity IS
+        # the bucketing); int8 and EF compose since VERDICT r4 #4a
+        with pytest.raises(ValueError, match="overlap"):
+            _make(line8, overlap=True, bucket_size=1000)
         # accumulation makes every leaf depend on the whole scan: loud no
         t = _make(line8, overlap=True)
         ds = data.mnist_like()
         x, y = next(iter(ds.batches(64, 1)))
         with pytest.raises(NotImplementedError, match="overlap"):
             t.train_step_accum(x, y, accum_steps=2)
+
+    def test_overlap_int8_close_to_f32(self, line8):
+        """overlap x int8 (VERDICT r4 #4a): per-leaf rings must land in
+        the same band as the fused int8 ring, masked devices included."""
+        t8, tf = _make(line8, overlap=True, compress="int8"), _make(line8)
+        ds = data.mnist_like()
+        valid = np.ones(8, np.float32)
+        valid[5] = 0.0
+        for i, (x, y) in enumerate(ds.batches(64, 6)):
+            m8 = t8.train_step(x, y, valid if i == 2 else None)
+            mf = tf.train_step(x, y, valid if i == 2 else None)
+            assert m8.contributors == mf.contributors
+        drift = np.abs(t8.get_flat_params() - tf.get_flat_params()).max()
+        scale = np.abs(tf.get_flat_params()).max()
+        assert drift / scale < 5e-2, drift / scale
+
+    @pytest.mark.parametrize("compress", ["bf16", "int8"])
+    def test_overlap_ef_masked_device_carries_contribution(
+        self, line8, compress
+    ):
+        """overlap x error_feedback (VERDICT r4 #4a): the residual rides
+        the autodiff pass (e-cotangent). A masked device's whole folded
+        contribution must carry forward, same invariant as the fused EF
+        paths."""
+        t = _make(
+            line8, overlap=True, compress=compress, error_feedback=True
+        )
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        valid = np.ones(8, np.float32)
+        valid[3] = 0.0
+        m = t.train_step(x, y, valid)
+        assert m.contributors == 7.0
+        ef = np.asarray(t._ef)
+        masked_norm = np.linalg.norm(ef[3])
+        other = max(np.linalg.norm(ef[i]) for i in range(8) if i != 3)
+        assert masked_norm > 10 * other, (masked_norm, other)
+        # and training continues finite with the residual live
+        h = t.train(ds.batches(64, 3, seed_offset=2))
+        assert np.isfinite(h[-1].loss)
+        assert float(np.abs(np.asarray(t._ef)).max()) > 0
+
+    def test_overlap_int8_one_ring_per_leaf_in_hlo(self, line8):
+        """Structural evidence for overlap x int8: the lowered step holds
+        one int8 RING PER PARAM LEAF (a reduce-scatter while + an
+        all-gather while each, two ppermutes per body: payload + scale) —
+        leaf k's ring lives in leaf k's backward subgraph, not one fused
+        ring after the whole backward."""
+        t = _make(line8, overlap=True, compress="int8")
+        ds = data.mnist_like()
+        x, y = next(iter(ds.batches(64, 1)))
+        from akka_allreduce_tpu.train.trainer import place_mask
+
+        xd, yd = t._place_batch(x, y)
+        vd = place_mask(np.ones(8, np.float32), t._data_sharding)
+        txt = t._step.lower(t.params, t.opt_state, xd, yd, vd).as_text()
+        n_leaves = len(jax.tree.leaves(t.params))
+        assert txt.count("stablehlo.while") == 2 * n_leaves
+        assert txt.count("collective_permute") == 4 * n_leaves
+        # fused comparison: the explicit int8 path carries exactly ONE
+        # ring pair (flat buffer), regardless of leaf count
+        tf = _make(line8, compress="int8")
+        txtf = tf._step.lower(
+            tf.params, tf.opt_state, xd, yd, vd
+        ).as_text()
+        assert txtf.count("stablehlo.while") == 2
+
+    def test_overlap_ef_bf16_matches_fused_ef_band(self, line8):
+        """The overlapped bf16 EF step must stay in the same drift band vs
+        f32 as the fused bf16 EF path (same mask-then-cast semantics, just
+        per-leaf)."""
+        t_ov = _make(
+            line8, overlap=True, compress="bf16", error_feedback=True
+        )
+        t_f32 = _make(line8)
+        ds = data.mnist_like()
+        for x, y in ds.batches(64, 10):
+            t_ov.train_step(x, y)
+            t_f32.train_step(x, y)
+        drift = np.abs(t_ov.get_flat_params() - t_f32.get_flat_params()).max()
+        scale = np.abs(t_f32.get_flat_params()).max()
+        assert drift / scale < 2e-2, drift / scale
 
 
 class TestShardedTrainerOverlap:
