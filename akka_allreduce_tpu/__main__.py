@@ -1600,9 +1600,22 @@ def _cmd_lm_generate(argv: list[str]) -> int:
         help="quantize the KV cache to int8 + per-row scales (4x fewer "
         "cache bytes than f32; ~0.4%% per-element error)",
     )
+    p.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-sharded decode: shard the KV cache's SLOT dim over "
+        "an sp-device 'seq' mesh axis (split-K partial-softmax merge — "
+        "caches larger than one device)",
+    )
+    p.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel decode over a 'model' mesh axis (composes "
+        "with --sp)",
+    )
     args = p.parse_args(argv)
     if args.gen < 2:
         p.error("--gen must be >= 2 (the slope timing needs two lengths)")
+    if args.sp < 1 or args.tp < 1:
+        p.error("--sp and --tp must be >= 1")
 
     import time
 
@@ -1645,10 +1658,23 @@ def _cmd_lm_generate(argv: list[str]) -> int:
             jnp.zeros((1, args.prompt_len), jnp.int32),
         )
 
+    mesh = None
+    if args.sp > 1 or args.tp > 1:
+        shape, names = (), ()
+        if args.sp > 1:
+            shape, names = shape + (args.sp,), names + ("seq",)
+        if args.tp > 1:
+            shape, names = shape + (args.tp,), names + ("model",)
+        mesh = jax.make_mesh(
+            shape, names, devices=jax.devices()[: args.sp * args.tp]
+        )
+    max_len = args.prompt_len + args.gen
+    max_len = -(-max_len // args.sp) * args.sp  # whole slots per seq shard
     gen = LMGenerator(
-        model, max_len=args.prompt_len + args.gen,
-        cache_quant=args.cache_quant,
+        model, max_len=max_len, cache_quant=args.cache_quant, mesh=mesh,
     )
+    if mesh is not None:
+        params = gen.place_params(params)
     x, _ = next(ds.batches(args.batch, 1, seed_offset=123))
     prompt = jnp.asarray(x[:, : args.prompt_len])
 

@@ -39,33 +39,52 @@ class LMGenerator:
         any training-time seq/tensor sharding in the config is ignored —
         the generator's own ``mesh`` decides the decode layout).
       max_len: cache capacity = prompt length + generated tokens budget.
+        Must divide by the ``seq`` axis size when sequence-sharding.
       mesh: None = single device. A mesh with a ``model`` axis runs
         Megatron-style TENSOR-PARALLEL decode (VERDICT r3 #8): params
         shard per ``tp_param_specs``, each shard caches only its
         ``H_kv/tp`` heads (the KV cache — decode's bandwidth term —
         shards over the model axis; GQA already compacted it), and the
-        out-projection psum completes each layer. Prompts/tokens are
-        replicated; logits come back identical on every shard
-        (teacher-forced oracle in tests).
+        out-projection psum completes each layer. A mesh with a ``seq``
+        axis runs SEQUENCE-SHARDED decode (VERDICT r4 #5 — caches larger
+        than one device): each shard owns ``max_len/sp`` contiguous cache
+        SLOTS, scatter-writes the tokens it owns, and the shards' partial
+        softmaxes merge split-K style
+        (``ops.local_attention.seq_decode_attention``). The two compose
+        on a ("seq", "model") mesh; an extra "data" axis is allowed and
+        replicated. Prompts/tokens are replicated; logits come back
+        identical on every shard (teacher-forced oracle in tests).
     """
 
     model: TransformerLM
     max_len: int
     cache_quant: str | None = None  # "int8": quantized KV cache (4x vs f32)
-    mesh: object | None = None  # jax Mesh with a "model" axis for TP decode
+    #: jax Mesh for sharded decode: a "model" axis runs tensor-parallel
+    #: decode, a "seq" axis shards the KV cache over its SLOTS
+    #: (sequence-sharded decode, VERDICT r4 #5 — caches larger than one
+    #: device), and a ("seq", "model") mesh composes both.
+    mesh: object | None = None
 
     def __post_init__(self) -> None:
         base = dataclasses.replace(
             self.model, seq_axis=None, model_axis=None, tp_size=1
         )
         self.tp = 1
+        self.sp = 1
         if self.mesh is not None:
-            if "model" not in self.mesh.axis_names:
+            names = tuple(self.mesh.axis_names)
+            if not set(names) <= {"data", "seq", "model"} or not (
+                {"seq", "model"} & set(names)
+            ):
                 raise ValueError(
-                    f"decode mesh needs a 'model' axis, got "
-                    f"{self.mesh.axis_names}"
+                    f"decode mesh needs a 'seq' and/or 'model' axis "
+                    f"(plus an optional replicated 'data' axis), got "
+                    f"{names}"
                 )
-            self.tp = int(self.mesh.shape["model"])
+            self.tp = (
+                int(self.mesh.shape["model"]) if "model" in names else 1
+            )
+            self.sp = int(self.mesh.shape["seq"]) if "seq" in names else 1
             kv = (
                 self.model.n_heads
                 if self.model.n_kv_heads is None
@@ -79,16 +98,23 @@ class LMGenerator:
                     f"must both divide by the model axis size {self.tp} "
                     "for tensor-parallel decode"
                 )
+            if self.max_len % self.sp:
+                raise ValueError(
+                    f"max_len={self.max_len} must divide by the seq axis "
+                    f"size {self.sp} (each shard owns max_len/sp cache "
+                    "slots)"
+                )
         self.decoder = dataclasses.replace(
             base, decode=True, max_decode_len=self.max_len,
             remat=False, cache_quant=self.cache_quant,
             model_axis="model" if self.tp > 1 else None,
             tp_size=self.tp,
+            seq_axis="seq" if self.sp > 1 else None,
         )
-        # the tp=1 twin defines GLOBAL cache/param shapes; shard_map
+        # the unsharded twin defines GLOBAL cache/param shapes; shard_map
         # in_specs slice them to each shard's local geometry
         self._global_decoder = dataclasses.replace(
-            self.decoder, model_axis=None, tp_size=1
+            self.decoder, model_axis=None, tp_size=1, seq_axis=None
         )
         self._fns: dict = {}  # compiled generate loops, keyed by shape
         self._cache_tmpl: dict = {}  # zero-cache template per batch size
@@ -108,7 +134,7 @@ class LMGenerator:
                 jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
             )
             tmpl = variables["cache"]
-            if self.tp > 1:
+            if self.tp > 1 or self.sp > 1:
                 # shard the TEMPLATE once; zeros_like below then yields
                 # already-sharded zeros with no per-call re-scatter
                 tmpl = jax.device_put(
@@ -122,23 +148,27 @@ class LMGenerator:
             self._cache_tmpl[batch] = tmpl
         return jax.tree.map(jnp.zeros_like, self._cache_tmpl[batch])
 
-    @staticmethod
-    def _cache_specs(cache) -> dict:
+    def _cache_specs(self, cache) -> dict:
         """PartitionSpec tree for the cache: K/V payloads ``cached_k/v``
         (B, L, H_kv, D) and int8 scales ``k/v_scale`` (B, L, H_kv) shard
-        their HEAD dim over ``model``; ``cache_index`` replicates.
+        their SLOT dim over ``seq`` and their HEAD dim over ``model``
+        (whichever of the two this generator's mesh carries);
+        ``cache_index`` replicates.
 
         Keyed on the VARIABLE NAME, not leaf rank (ADVICE r4: a future
         cache variable with a coincidental ndim must not be silently
         mis-sharded) — an unknown name fails loudly here."""
         import jax.tree_util as jtu
 
+        seq = "seq" if self.sp > 1 else None
+        model = "model" if self.tp > 1 else None
+
         def spec(path, leaf):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
             if name in ("cached_k", "cached_v"):
-                return P(None, None, "model", None)
+                return P(None, seq, model, None)
             if name in ("k_scale", "v_scale"):
-                return P(None, None, "model")
+                return P(None, seq, model)
             if name == "cache_index":
                 return P()
             raise ValueError(
@@ -165,8 +195,8 @@ class LMGenerator:
         )
 
     def _apply(self, params, cache, tokens):
-        if self.tp > 1:
-            return self._apply_tp(params, cache, tokens)
+        if self.tp > 1 or self.sp > 1:
+            return self._apply_sharded(params, cache, tokens)
         logits, updated = self.decoder.apply(
             {"params": params["params"], "cache": cache},
             tokens,
@@ -174,10 +204,16 @@ class LMGenerator:
         )
         return logits, updated["cache"]
 
-    def _apply_tp(self, params, cache, tokens):
-        if getattr(self, "_tp_apply", None) is None:
+    def _apply_sharded(self, params, cache, tokens):
+        """shard_map'd apply for TP (params + cache heads over ``model``)
+        and/or sequence-sharded decode (cache slots over ``seq``; params
+        replicated along it). Logits come back replicated either way (the
+        TP out-psum / the seq split-K merge)."""
+        if getattr(self, "_sharded_apply", None) is None:
             decoder = self.decoder
-            p_specs = tp_param_specs(params, "model")
+            p_specs = (
+                tp_param_specs(params, "model") if self.tp > 1 else P()
+            )
             c_specs = self._cache_specs(cache)
 
             def shard_apply(p, c, tok):
@@ -190,7 +226,7 @@ class LMGenerator:
 
             # jit(shard_map): eager shard_map would need a mesh context,
             # and the jit also caches the partitioned executable
-            self._tp_apply = jax.jit(
+            self._sharded_apply = jax.jit(
                 jax.shard_map(
                     shard_apply,
                     mesh=self.mesh,
@@ -198,7 +234,7 @@ class LMGenerator:
                     out_specs=(P(), c_specs),
                 )
             )
-        return self._tp_apply(params, cache, jnp.asarray(tokens))
+        return self._sharded_apply(params, cache, jnp.asarray(tokens))
 
     def generate(
         self,

@@ -83,7 +83,11 @@ class Attention(nn.Module):
     cache with ``model.init`` on any-length tokens; apply with
     ``mutable=["cache"]``. Composes with tensor parallelism (each model
     shard caches its kv_local heads — run inside shard_map over the
-    ``model`` axis); sequence sharding does not compose.
+    ``model`` axis) AND with sequence sharding (``seq_axis`` set while
+    decoding: each seq shard owns a contiguous ``max_decode_len / n``
+    slice of the cache SLOTS, writes scatter to the owning shard, and
+    attention merges the shards' partial softmaxes split-K style over the
+    axis — ``ops.local_attention.seq_decode_attention``; VERDICT r4 #5).
 
     ``cache_quant="int8"`` stores the cache quantized per (token, head)
     row — int8 payload + one f32 scale per row, ~4× fewer cache bytes
@@ -122,13 +126,6 @@ class Attention(nn.Module):
             raise ValueError(
                 f"n_kv_heads={kv_heads} not divisible by {self.tp_size=}"
             )
-        if self.decode and self.seq_axis is not None:
-            raise ValueError(
-                "decode=True does not compose with sequence sharding (the "
-                "KV cache is whole-sequence per shard); tensor parallelism "
-                "IS supported — each model shard caches its kv_local heads "
-                "and the out-projection psum completes the partials"
-            )
         if self.decode and self.max_decode_len < 1:
             raise ValueError("decode=True needs max_decode_len >= 1")
         head = d_model // self.n_heads
@@ -151,7 +148,24 @@ class Attention(nn.Module):
                 )
             quant = self.cache_quant == "int8"
             b, t = x.shape[0], x.shape[1]
-            kv_shape = (b, self.max_decode_len, kv_local, head)
+            if self.seq_axis is not None:
+                # SEQUENCE-SHARDED cache (VERDICT r4 #5): each shard of
+                # the seq axis owns a contiguous L/n_sh slice of the cache
+                # slots; decode attention merges the shards' partial
+                # softmaxes split-K style (seq_decode_attention). Composes
+                # with TP (heads shard on model, slots on seq).
+                n_sh = lax.axis_size(self.seq_axis)
+                if self.max_decode_len % n_sh:
+                    raise ValueError(
+                        f"max_decode_len={self.max_decode_len} not "
+                        f"divisible by the {n_sh}-shard seq axis"
+                    )
+                l_local = self.max_decode_len // n_sh
+                k_off = lax.axis_index(self.seq_axis) * l_local
+            else:
+                l_local = self.max_decode_len
+                k_off = 0
+            kv_shape = (b, l_local, kv_local, head)
             cache_dt = jnp.int8 if quant else k.dtype
             ck = self.variable("cache", "cached_k", jnp.zeros, kv_shape, cache_dt)
             cv = self.variable("cache", "cached_v", jnp.zeros, kv_shape, cache_dt)
@@ -177,15 +191,32 @@ class Attention(nn.Module):
                 _DENSE_MAX_T,
                 local_attention,
                 quantized_cache_attention,
+                seq_decode_attention,
             )
 
             # append this chunk's K/V at the running index; slots past
             # offset + t hold zeros and are causally invisible (their
             # k_pos exceeds every live q_pos)
-            def write(cache, chunk):
-                cache.value = lax.dynamic_update_slice(
-                    cache.value, chunk, (0, offset) + (0,) * (chunk.ndim - 2)
-                )
+            if self.seq_axis is not None:
+                # scatter each token to the shard that owns its slot:
+                # indices outside this shard's [k_off, k_off + l_local)
+                # range are clamped to l_local and DROPPED by the scatter
+                pos = offset + jnp.arange(t) - k_off
+                idx = jnp.where((pos >= 0) & (pos < l_local), pos, l_local)
+
+                def write(cache, chunk):
+                    cache.value = cache.value.at[:, idx].set(
+                        chunk, mode="drop"
+                    )
+
+            else:
+
+                def write(cache, chunk):
+                    cache.value = lax.dynamic_update_slice(
+                        cache.value,
+                        chunk,
+                        (0, offset) + (0,) * (chunk.ndim - 2),
+                    )
 
             if quant:
                 def quantize(x_):
@@ -222,7 +253,16 @@ class Attention(nn.Module):
                 # cost is ~3x dequant_b in practice), so token-by-token
                 # decode must never take it even at extreme GQA ratios
                 # where the byte model above tips the other way
-                if (
+                if self.seq_axis is not None:
+                    # sharded cache: local partial over this shard's slots
+                    # (scales fold in, like quantized_cache_attention),
+                    # split-K merge over the seq axis
+                    out = seq_decode_attention(
+                        q, ck.value, cv.value, self.seq_axis,
+                        q_offset=offset, k_offset=k_off,
+                        k_scale=cks.value, v_scale=cvs.value,
+                    )
+                elif (
                     t == 1
                     or score_b <= dequant_b
                     or t * self.max_decode_len <= _DENSE_MAX_T * _DENSE_MAX_T
@@ -243,9 +283,15 @@ class Attention(nn.Module):
             else:
                 write(ck, k), write(cv, v)
                 ci.value = offset + t
-                out = local_attention(
-                    q, ck.value, cv.value, causal=True, q_offset=offset,
-                )
+                if self.seq_axis is not None:
+                    out = seq_decode_attention(
+                        q, ck.value, cv.value, self.seq_axis,
+                        q_offset=offset, k_offset=k_off,
+                    )
+                else:
+                    out = local_attention(
+                        q, ck.value, cv.value, causal=True, q_offset=offset,
+                    )
         elif self.seq_axis is None:
             # dense single-device form: dispatch to the best local core
             # (flash kernel on TPU, blockwise off-chip for long T)

@@ -291,8 +291,15 @@ class TestTensorParallelDecode:
         b = np.asarray(
             gtp.decode_logits(gtp.place_params(params), tokens, chunk=1)
         )
-        # int8 per-(token, head) row scales are shard-local and identical
-        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+        # int8 per-(token, head) row scales are shard-local and identical,
+        # but the next layer's cache round() amplifies reassociation dust
+        # to ~scale/127 steps — so sharded vs fused agree loosely while
+        # BOTH must sit in the same quantization band of the float oracle
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-3)
+        want = np.asarray(model.apply(params, tokens))
+        band_a = np.abs(a - want).max()
+        band_b = np.abs(b - want).max()
+        assert band_b < 1.5 * band_a + 1e-3, (band_a, band_b)
 
     def test_rejects_mesh_without_model_axis(self):
         model, _, _ = mk()
@@ -301,3 +308,120 @@ class TestTensorParallelDecode:
                 model, max_len=16,
                 mesh=jax.make_mesh((2,), ("data",), devices=jax.devices()[:2]),
             )
+
+
+class TestSeqShardedDecode:
+    """Sequence-sharded decode (VERDICT r4 #5): the KV cache's SLOT dim
+    shards over a ``seq`` mesh axis (caches larger than one device), each
+    shard scatter-writes the tokens it owns and computes a partial softmax
+    over its slice, and the shards merge split-K style (pmax + psums).
+    Oracle: logits equal the single-device decode."""
+
+    def _mesh(self, sp, tp=1):
+        if tp == 1:
+            return jax.make_mesh(
+                (sp,), ("seq",), devices=jax.devices()[:sp]
+            )
+        return jax.make_mesh(
+            (sp, tp), ("seq", "model"), devices=jax.devices()[: sp * tp]
+        )
+
+    @pytest.mark.parametrize("n_kv", [None, 2])
+    def test_logits_match_single_device(self, n_kv):
+        model, params, tokens = mk(n_kv)
+        g1 = LMGenerator(model, max_len=16)
+        gsp = LMGenerator(model, max_len=16, mesh=self._mesh(8))
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=1))
+        b = np.asarray(gsp.decode_logits(params, tokens, chunk=1))
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+    def test_prefill_chunk_spans_shards(self):
+        """A multi-token prefill chunk crosses shard boundaries (chunk=4
+        over 2-slot shards): the scatter must land every token on its
+        owning shard."""
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16)
+        gsp = LMGenerator(model, max_len=16, mesh=self._mesh(8))
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=4))
+        b = np.asarray(gsp.decode_logits(params, tokens, chunk=4))
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+    def test_cache_is_sharded_over_slots(self):
+        model, params, tokens = mk(2)
+        gsp = LMGenerator(model, max_len=16, mesh=self._mesh(8))
+        cache = gsp.init_cache(batch=2)
+        ck = cache["Block_0"]["Attention_0"]["cached_k"]
+        assert ck.shape == (2, 16, 2, 8)  # GLOBAL slot count
+        # each shard holds 16/8 = 2 cache slots (full heads)
+        assert ck.addressable_shards[0].data.shape == (2, 2, 2, 8)
+
+    def test_generate_matches_single_device(self):
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16)
+        gsp = LMGenerator(model, max_len=16, mesh=self._mesh(8))
+        a = np.asarray(g1.generate(params, tokens[:, :4], 8))
+        b = np.asarray(gsp.generate(params, tokens[:, :4], 8))
+        np.testing.assert_array_equal(a, b)
+
+    def test_int8_cache_seq_sharded(self):
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16, cache_quant="int8")
+        gsp = LMGenerator(
+            model, max_len=16, cache_quant="int8", mesh=self._mesh(8)
+        )
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=1))
+        b = np.asarray(gsp.decode_logits(params, tokens, chunk=1))
+        # sharded vs fused agree to ~reassociation dust AMPLIFIED by the
+        # next layer's cache round(): a 1e-7 activation difference can
+        # flip a round() to the neighboring int8 step (~scale/127), so
+        # the two int8 paths agree far looser than f32's 2e-5...
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-3)
+        # ...but BOTH must sit inside the same quantization band of the
+        # float forward — the oracle that actually certifies the math
+        want = np.asarray(model.apply(params, tokens))
+        band_a = np.abs(a - want).max()
+        band_b = np.abs(b - want).max()
+        assert band_b < 1.5 * band_a + 1e-3, (band_a, band_b)
+
+    def test_seq_x_tp_decode(self):
+        """The full composition: cache slots over seq x heads over model
+        (4 x 2 on the 8-device mesh), GQA cache, vs single-device."""
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16)
+        g = LMGenerator(model, max_len=16, mesh=self._mesh(4, 2))
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=1))
+        b = np.asarray(
+            g.decode_logits(g.place_params(params), tokens, chunk=1)
+        )
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+        ck = g.init_cache(batch=2)["Block_0"]["Attention_0"]["cached_k"]
+        # 16 slots / 4 seq shards, 2 kv heads / 2 model shards
+        assert ck.addressable_shards[0].data.shape == (2, 4, 1, 8)
+
+    def test_max_len_must_divide_seq_axis(self):
+        model, _, _ = mk()
+        with pytest.raises(ValueError, match="max_len"):
+            LMGenerator(model, max_len=15, mesh=self._mesh(8))
+
+    @pytest.mark.parametrize("quant", [None, "int8"])
+    def test_blockwise_prefill_partials(self, monkeypatch, quant):
+        """Large prefill chunks must NOT materialize (B, H, Tq, L_local)
+        dense scores: shrink the dense gate so the chunked prefill takes
+        the blockwise-olm local path, and the logits must still match the
+        single-device oracle computed with the normal gate."""
+        import importlib
+
+        # the ops package re-exports functions over submodule names, so a
+        # plain attribute import would resolve to the FUNCTION
+        la = importlib.import_module("akka_allreduce_tpu.ops.local_attention")
+
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16, cache_quant=quant)
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=4))
+        monkeypatch.setattr(la, "_DENSE_MAX_T", 1)
+        gsp = LMGenerator(
+            model, max_len=16, cache_quant=quant, mesh=self._mesh(8)
+        )
+        b = np.asarray(gsp.decode_logits(params, tokens, chunk=4))
+        tol = 2e-5 if quant is None else 1e-4
+        np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
