@@ -1185,12 +1185,30 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
         "redistribute, the same logical layers re-chunk, sequences "
         "re-split)",
     )
+    p.add_argument(
+        "--compile-cache",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="DIR",
+        help="enable JAX's persistent compilation cache (optional DIR; "
+        "default a shared temp dir): re-meshes back to a previously-seen "
+        "mesh size load their executables from disk instead of "
+        "recompiling — the dominant term of transformer-family re-mesh "
+        "latency",
+    )
     args = p.parse_args(argv)
 
     import jax
     import numpy as np
 
     from akka_allreduce_tpu.models import MLP, data
+
+    if args.compile_cache is not None:
+        from akka_allreduce_tpu.utils import enable_persistent_compile_cache
+
+        d = enable_persistent_compile_cache(args.compile_cache or None)
+        print(f"persistent compile cache: {d}")
     from akka_allreduce_tpu.train import (
         ElasticDPTrainer,
         ElasticLongContextTrainer,

@@ -256,11 +256,16 @@ class Attention(nn.Module):
                 if self.seq_axis is not None:
                     # sharded cache: local partial over this shard's slots
                     # (scales fold in, like quantized_cache_attention),
-                    # split-K merge over the seq axis
+                    # split-K merge over the seq axis; under TP the inputs
+                    # are ALSO model-varying — the blockwise carry must
+                    # carry that typing
                     out = seq_decode_attention(
                         q, ck.value, cv.value, self.seq_axis,
                         q_offset=offset, k_offset=k_off,
                         k_scale=cks.value, v_scale=cvs.value,
+                        extra_vary_axes=(
+                            (self.model_axis,) if self.model_axis else ()
+                        ),
                     )
                 elif (
                     t == 1
@@ -287,6 +292,9 @@ class Attention(nn.Module):
                     out = seq_decode_attention(
                         q, ck.value, cv.value, self.seq_axis,
                         q_offset=offset, k_offset=k_off,
+                        extra_vary_axes=(
+                            (self.model_axis,) if self.model_axis else ()
+                        ),
                     )
                 else:
                     out = local_attention(

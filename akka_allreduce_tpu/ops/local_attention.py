@@ -283,8 +283,13 @@ def seq_decode_attention(
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
     sm_scale: float | None = None,
+    extra_vary_axes: tuple = (),
 ) -> jax.Array:
     """Decode attention over a SEQUENCE-SHARDED KV cache (VERDICT r4 #5).
+
+    ``extra_vary_axes``: further mesh axes the inputs are device-varying
+    over (the ``model`` axis under TP-composed decode) — the blockwise
+    branch's scan carry must be typed varying over every such axis.
 
     Each shard holds its (B, L_local, H_kv, D) slice of the cache;
     ``q`` (B, Tq, H, D) is replicated over ``axis_name``. The shard
@@ -326,7 +331,7 @@ def seq_decode_attention(
             q, k, v, causal=True, scale=scale,
             q_offset=q_offset, k_offset=k_offset, block_k=512,
             k_scale=k_scale, v_scale=v_scale,
-            vary_axes=(axis_name,),
+            vary_axes=(axis_name,) + tuple(extra_vary_axes),
         )
         m_g = lax.pmax(m, axis_name)
         corr = jnp.exp(m - m_g)

@@ -340,6 +340,7 @@ class FSDPLMTrainer:
         seq_axis = self.seq_axis
         vary_axes = tuple(a for a in axes if a != data_axis)
         g_axes = self.gather_axes
+        param_specs = self._param_specs
         # the in-scan ungather targets THIS model shard's local layer
         # shapes (the TP dim shrinks by tp on Megatron-sharded leaves)
         trunk_shapes = self._trunk_local_shapes
@@ -547,7 +548,24 @@ class FSDPLMTrainer:
                 )
                 return ce.sum() * v / denom
 
-            loss, grads = jax.value_and_grad(masked_loss)(params)
+            # EXPLICIT psums for the replicated (embed/head) leaves:
+            # localize_tree makes them device-varying so their grads stay
+            # LOCAL, then grouped_tree_psum reduces them over the mesh —
+            # shard_map's automatic transpose-psum for replicated params
+            # DOES NOT RUN under check_vma=False (the int8/flash-relax
+            # configs silently trained on per-device local embed/head
+            # grads until the runtime replica assert caught it —
+            # tests/test_vma_replication.py, VERDICT r4 #6). Trunk leaves
+            # shard over every axis: localize and the grouped psum are
+            # no-ops for them (their reduction IS the gather transpose).
+            from akka_allreduce_tpu.comm.allreduce import (
+                grouped_tree_psum,
+                localize_tree,
+            )
+
+            params_in = localize_tree(params, param_specs, axes)
+            loss, grads = jax.value_and_grad(masked_loss)(params_in)
+            grads = grouped_tree_psum(grads, param_specs, axes)
             loss_avg = lax.psum(loss, axes)  # masked, already /denom
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)

@@ -240,7 +240,20 @@ class LongContextTrainer:
                     wire_dtype=compress,
                 )
             else:
-                lval, gavg = jax.value_and_grad(masked_loss_sum)(params)
+                # EXPLICIT grouped psums even uncompressed: shard_map's
+                # automatic transpose-psum for replicated params DOES NOT
+                # RUN under check_vma=False (the flash-relax configs), so
+                # relying on it would silently leave every device with its
+                # LOCAL gradient — found by the runtime replica assert
+                # (tests/test_vma_replication.py), VERDICT r4 #6
+                from akka_allreduce_tpu.comm.allreduce import (
+                    compressed_value_and_grad,
+                )
+
+                lval, gavg = compressed_value_and_grad(
+                    masked_loss_sum, params, param_specs, axis_names,
+                    wire_dtype=None,
+                )
             loss_avg = lax.psum(lval, axis_names)  # masked, already /denom
             contributors = lax.psum(v0, data_axis)
             updates, new_opt = tx.update(gavg, opt_state, params)

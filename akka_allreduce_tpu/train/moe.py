@@ -258,9 +258,19 @@ class MoETrainer:
                     wire_dtype=compress,
                 )
             else:
-                (_, (ce, aux, dropped)), gavg = jax.value_and_grad(
-                    masked_loss, has_aux=True
-                )(params)
+                # explicit grouped psums even uncompressed: the automatic
+                # transpose-psum for replicated params does not run under
+                # check_vma=False (flash-relax configs) — see
+                # long_context.py / tests/test_vma_replication.py
+                from akka_allreduce_tpu.comm.allreduce import (
+                    compressed_value_and_grad,
+                )
+
+                (_, (ce, aux, dropped)), gavg = compressed_value_and_grad(
+                    masked_loss, params, param_specs, axis_names,
+                    has_aux=True,
+                    wire_dtype=None,
+                )
             loss_avg = lax.psum(ce * v / denom, axis_names)
             aux_avg = lax.psum(aux * tokens_local * v / denom, axis_names)
             dropped_avg = lax.psum(

@@ -431,9 +431,19 @@ class PipelineLMTrainer:
                     wire_dtype=compress,
                 )
             else:
-                (_, ce_total), gavg = jax.value_and_grad(
-                    masked_loss, has_aux=True
-                )(params)
+                # explicit grouped psums even uncompressed: the automatic
+                # transpose-psum for replicated params does not run under
+                # check_vma=False (flash-relax configs) — see
+                # long_context.py / tests/test_vma_replication.py
+                from akka_allreduce_tpu.comm.allreduce import (
+                    compressed_value_and_grad,
+                )
+
+                (_, ce_total), gavg = compressed_value_and_grad(
+                    masked_loss, params, param_specs, axis_names,
+                    has_aux=True,
+                    wire_dtype=None,
+                )
             loss_avg = lax.psum(ce_total * v * is_last / denom, axis_names)
             contributors = lax.psum(v0, data_axis)
             new_params, new_opt = apply_update(params, opt_state, gavg)

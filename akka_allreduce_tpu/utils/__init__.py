@@ -4,3 +4,10 @@ from akka_allreduce_tpu.utils.metrics import (  # noqa: F401
     MetricsLogger,
     RoundMetrics,
 )
+from akka_allreduce_tpu.utils.compile_cache import (  # noqa: F401
+    enable_persistent_compile_cache,
+)
+from akka_allreduce_tpu.utils.verify import (  # noqa: F401
+    assert_replica_consistent,
+    assert_trainer_replicas,
+)

@@ -1,0 +1,78 @@
+"""Runtime replication verification (VERDICT r4 #6).
+
+Several hot paths run with ``shard_map(check_vma=False)`` because their
+collectives produce results the static varying-axes checker cannot prove
+replicated — the ring schedules' ``ppermute`` loops, ZeRO-1's tiled
+``all_gather``, the overlap ``custom_vjp``s, and the Pallas flash kernel
+all erase vma typing, and ``lax.pcast`` has no "to=invariant" to
+reinstate it. The compensation is this module: a RUNTIME assert that the
+data actually IS consistent wherever the sharding claims replicas, plus a
+trainer-level sweep used by tests/test_vma_replication.py to cover every
+relaxed configuration with real steps.
+
+A latent replication bug (devices silently diverging inside an unchecked
+region) shows up here as a bitwise mismatch between two shards that claim
+the same global slice — precisely the failure class ``check_vma`` would
+have caught statically.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def assert_replica_consistent(tree, *, name: str = "tree") -> int:
+    """Bitwise-verify every leaf of ``tree``: all addressable shards that
+    hold the SAME global slice (replica groups under the leaf's sharding)
+    must carry identical bytes. Works for fully-replicated leaves (every
+    shard is one group) and partially-sharded leaves (one group per
+    distinct index). Returns the number of shard-pairs compared; raises
+    ``AssertionError`` naming the leaf on the first mismatch.
+    """
+    import jax.tree_util as jtu
+
+    compared = 0
+    for path, leaf in jtu.tree_leaves_with_path(tree):
+        if not isinstance(leaf, jax.Array) or not leaf.is_fully_addressable:
+            continue
+        groups: dict = {}
+        for shard in leaf.addressable_shards:
+            key = tuple(
+                (s.start, s.stop, s.step) for s in shard.index
+            )
+            groups.setdefault(key, []).append(shard)
+        for key, shards in groups.items():
+            ref = np.asarray(shards[0].data)
+            for other in shards[1:]:
+                got = np.asarray(other.data)
+                if not np.array_equal(ref, got, equal_nan=True):
+                    diff = np.abs(
+                        ref.astype(np.float64) - got.astype(np.float64)
+                    ).max()
+                    raise AssertionError(
+                        f"replica divergence in {name}{jtu.keystr(path)} "
+                        f"slice {key}: device {shards[0].device} vs "
+                        f"{other.device}, max |diff| = {diff}"
+                    )
+                compared += 1
+    return compared
+
+
+def assert_trainer_replicas(trainer) -> int:
+    """Replica-consistency sweep over a trainer's live training state —
+    params, optimizer state, and (when present) the error-feedback
+    residual. The EF residual is data-SHARDED (one residual per device),
+    so its groups are singletons and it contributes no comparisons; it is
+    included so a future re-layout that aliases slices is still checked.
+    Returns total shard-pairs compared (must be > 0 for a multi-device
+    replicated-state trainer — callers should assert that too, or the
+    check can silently become vacuous)."""
+    state = {"params": getattr(trainer, "params", None)}
+    if getattr(trainer, "opt_state", None) is not None:
+        state["opt_state"] = trainer.opt_state
+    if getattr(trainer, "flat_params", None) is not None:
+        state["flat_params"] = trainer.flat_params
+    if getattr(trainer, "_ef", None) is not None:
+        state["ef"] = trainer._ef
+    return assert_replica_consistent(state, name="trainer")

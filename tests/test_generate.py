@@ -403,6 +403,24 @@ class TestSeqShardedDecode:
         with pytest.raises(ValueError, match="max_len"):
             LMGenerator(model, max_len=15, mesh=self._mesh(8))
 
+    def test_blockwise_prefill_seq_x_tp(self, monkeypatch):
+        """The blockwise prefill path under the seq x model composition:
+        the scan carry must be typed varying over BOTH axes (a
+        seq-only pcast fails shard_map's vma typecheck at trace time)."""
+        import importlib
+
+        la = importlib.import_module("akka_allreduce_tpu.ops.local_attention")
+
+        model, params, tokens = mk(2)
+        g1 = LMGenerator(model, max_len=16)
+        a = np.asarray(g1.decode_logits(params, tokens, chunk=4))
+        monkeypatch.setattr(la, "_DENSE_MAX_T", 1)
+        g = LMGenerator(model, max_len=16, mesh=self._mesh(4, 2))
+        b = np.asarray(
+            g.decode_logits(g.place_params(params), tokens, chunk=4)
+        )
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
     @pytest.mark.parametrize("quant", [None, "int8"])
     def test_blockwise_prefill_partials(self, monkeypatch, quant):
         """Large prefill chunks must NOT materialize (B, H, Tq, L_local)

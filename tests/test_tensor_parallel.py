@@ -113,8 +113,10 @@ class TestCombinations:
             m1 = t_r.train_step(x, y)
             m2 = t_p.train_step(x, y)
             assert abs(m1.loss - m2.loss) < 1e-5
+        # atol covers remat's recompute reassociation against the grouped
+        # (concatenated) explicit psum — float dust, not a semantic gap
         np.testing.assert_allclose(
-            t_r.get_flat_params(), t_p.get_flat_params(), rtol=1e-4, atol=1e-6
+            t_r.get_flat_params(), t_p.get_flat_params(), rtol=1e-4, atol=5e-6
         )
 
     def test_tp_checkpointable_roundtrip_after_remat_step(self, tmp_path, batches):

@@ -576,3 +576,66 @@ class TestInt8GradSync:
         # contributors carry only first-hop int8 crumbs (coarser than
         # bf16's, hence the looser ratio)
         assert masked_norm > 10 * other, (masked_norm, other)
+
+
+class TestFileDataset:
+    """The file-backed loader seam (VERDICT r4 #8): real data drops into
+    the same batches/device_sampler API the synthetic stand-ins expose."""
+
+    def _write_shards(self, tmp_path, n_shards=2, rows=24):
+        rng = np.random.default_rng(0)
+        for i in range(n_shards):
+            x = rng.standard_normal((rows, 28, 28, 1)).astype(np.float32)
+            y = rng.integers(0, 10, size=rows).astype(np.int32)
+            # np.savez appends .npz to bare paths — write via handle
+            with open(tmp_path / f"shard_{i}.npz", "wb") as f:
+                np.savez(f, x=x, y=y)
+        return n_shards * rows
+
+    def test_batches_cycle_and_cover(self, tmp_path):
+        from akka_allreduce_tpu.models.data import FileDataset
+
+        total = self._write_shards(tmp_path)
+        ds = FileDataset(tmp_path)
+        assert ds.n == total
+        seen = []
+        got = list(ds.batches(16, 5))
+        assert len(got) == 5
+        for x, y in got:
+            assert x.shape == (16, 28, 28, 1) and y.shape == (16,)
+            assert y.dtype == np.int32
+            seen.append(x)
+        # deterministic: same seed_offset -> identical stream
+        again = list(ds.batches(16, 5))
+        for (x1, _), (x2, _) in zip(got, again):
+            np.testing.assert_array_equal(x1, x2)
+
+    def test_trains_a_dp_model(self, tmp_path, line8):
+        import optax
+
+        from akka_allreduce_tpu.models import MLP
+        from akka_allreduce_tpu.models.data import FileDataset
+        from akka_allreduce_tpu.train import DPTrainer
+
+        self._write_shards(tmp_path)
+        ds = FileDataset(tmp_path)
+        t = DPTrainer(
+            MLP(hidden=(16,), classes=10), line8,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.adam(1e-2),
+        )
+        h = t.train(ds.batches(16, 4))
+        assert np.isfinite([m.loss for m in h]).all()
+        # and the on-device sampler feeds the jitted chain
+        h2 = t.train_chain(ds.device_sampler(), 3, 2)
+        assert len(h2) == 3 and np.isfinite(h2[-1].loss)
+
+    def test_missing_keys_and_empty_dir_fail_loudly(self, tmp_path):
+        from akka_allreduce_tpu.models.data import FileDataset
+
+        with pytest.raises(FileNotFoundError):
+            FileDataset(tmp_path / "nothing_here")
+        with open(tmp_path / "bad.npz", "wb") as f:
+            np.savez(f, a=np.zeros(3))
+        with pytest.raises(KeyError, match="lacks"):
+            FileDataset(tmp_path / "bad.npz")

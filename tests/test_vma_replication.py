@@ -1,0 +1,208 @@
+"""Runtime replication asserts for every check_vma=False configuration
+(VERDICT r4 #6).
+
+The static varying-axes checker is off exactly where users run at scale:
+the int8 ring paths, the overlap custom_vjps, ZeRO-1's tiled all_gather,
+and the flash-kernel dispatch. ``lax.pcast`` cannot reinstate the typing
+(no "to=invariant"), so the compensation is a RUNTIME check: after real
+training steps, every pair of addressable shards that the sharding says
+hold the same data must be bitwise identical
+(``utils.verify.assert_replica_consistent``). A replication bug inside an
+unchecked region — two devices silently computing different "replicated"
+params — fails here by name and slice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+import pytest
+
+from akka_allreduce_tpu.models import MLP, data
+from akka_allreduce_tpu.parallel import line_mesh
+from akka_allreduce_tpu.utils import (
+    assert_replica_consistent,
+    assert_trainer_replicas,
+)
+
+
+@pytest.fixture(scope="module")
+def line8():
+    return line_mesh(8)
+
+
+def _mlp(mesh, **kw):
+    from akka_allreduce_tpu.train import DPTrainer
+
+    return DPTrainer(
+        MLP(hidden=(16,), classes=10), mesh,
+        example_input=np.zeros((1, 28, 28, 1), np.float32),
+        optimizer=optax.sgd(0.1), seed=0, **kw,
+    )
+
+
+def _steps(trainer, n=2, with_mask=True):
+    ds = data.mnist_like()
+    valid = np.ones(8, np.float32)
+    valid[2] = 0.0
+    for i, (x, y) in enumerate(ds.batches(32, n)):
+        trainer.train_step(x, y, valid if (with_mask and i == 1) else None)
+
+
+class TestDPRelaxedConfigs:
+    """Every DPTrainer configuration that disables check_vma."""
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(compress="int8"),
+            dict(compress="int8", error_feedback=True),
+            dict(overlap=True),
+            dict(overlap=True, compress="bf16"),
+            dict(overlap=True, compress="bf16", error_feedback=True),
+            dict(overlap=True, compress="int8"),
+            dict(overlap=True, compress="int8", error_feedback=True),
+        ],
+        ids=lambda kw: "-".join(f"{k}={v}" for k, v in kw.items()),
+    )
+    def test_params_stay_replicated(self, line8, kw):
+        t = _mlp(line8, **kw)
+        _steps(t)
+        pairs = assert_trainer_replicas(t)
+        assert pairs > 0  # the check must not be vacuous
+
+    def test_int8_chain_replicas(self, line8):
+        t = _mlp(line8, compress="int8", error_feedback=True)
+        t.train_chain(data.mnist_like().device_sampler(), 3, 4)
+        assert assert_trainer_replicas(t) > 0
+
+    def test_divergence_is_actually_caught(self, line8):
+        """The assert must FAIL on a planted divergence — otherwise every
+        green run above is meaningless."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        t = _mlp(line8)
+        leaf = jax.tree.leaves(t.params)[0]
+        host = np.asarray(leaf)
+        perturbed = [host.copy() for _ in range(8)]
+        perturbed[3] = perturbed[3] + 1.0  # device 3 diverges
+        devs = line8.devices.flat
+        bad = jax.make_array_from_single_device_arrays(
+            host.shape,
+            NamedSharding(line8, P()),
+            [jax.device_put(p, d) for p, d in zip(perturbed, devs)],
+        )
+        with pytest.raises(AssertionError, match="replica divergence"):
+            assert_replica_consistent({"w": bad})
+
+
+class TestZero1Replicas:
+    """ZeRO-1's shard_map is unconditionally unchecked (the tiled
+    all_gather's replicated result is unprovable statically)."""
+
+    @pytest.mark.parametrize(
+        "kw",
+        [dict(), dict(compress="bf16", error_feedback=True)],
+        ids=["plain", "bf16-ef"],
+    )
+    def test_flat_params_stay_replicated(self, line8, kw):
+        from akka_allreduce_tpu.train import Zero1DPTrainer
+
+        t = Zero1DPTrainer(
+            MLP(hidden=(16,), classes=10), line8,
+            example_input=np.zeros((1, 28, 28, 1), np.float32),
+            optimizer=optax.adam(1e-3), seed=0, **kw,
+        )
+        _steps(t)
+        assert assert_trainer_replicas(t) > 0
+
+
+class TestShardedTrainerRelaxedConfigs:
+    """The sharded-param families' int8 configurations (grouped ring per
+    sharding class): replicated leaves must stay consistent; sharded
+    leaves' replica groups are checked per distinct slice."""
+
+    def test_long_context_int8(self):
+        from akka_allreduce_tpu.parallel import data_seq_mesh
+        from akka_allreduce_tpu.train import LongContextTrainer
+
+        t = LongContextTrainer(
+            data_seq_mesh(2, 4), vocab=16, d_model=32, n_heads=4,
+            n_layers=1, seq_len=32, learning_rate=1e-2, compress="int8",
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        for x, y in ds.batches(4, 2):
+            t.train_step(x, y)
+        assert assert_trainer_replicas(t) > 0
+
+    def test_pipeline_int8(self):
+        import jax
+
+        from akka_allreduce_tpu.train import PipelineLMTrainer
+
+        t = PipelineLMTrainer(
+            jax.make_mesh((2, 4), ("data", "pipe")), layers_per_stage=1,
+            vocab=16, d_model=32, n_heads=4, microbatches=2, seq_len=32,
+            learning_rate=1e-2, compress="int8",
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        for x, y in ds.batches(4, 2):
+            t.train_step(x, y)
+        assert assert_trainer_replicas(t) > 0
+
+    def test_moe_int8(self):
+        import jax
+
+        from akka_allreduce_tpu.train import MoETrainer
+
+        t = MoETrainer(
+            jax.make_mesh((2, 4), ("data", "expert")), vocab=16,
+            d_model=32, n_heads=4, n_layers=1, n_experts=4, seq_len=32,
+            optimizer=optax.sgd(1e-2), compress="int8",
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        for x, y in ds.batches(8, 2):
+            t.train_step(x, y)
+        assert assert_trainer_replicas(t) > 0
+
+    def test_fsdp_int8(self):
+        from akka_allreduce_tpu.train import FSDPLMTrainer
+
+        t = FSDPLMTrainer(
+            line_mesh(8), vocab=16, d_model=32, n_heads=4, n_layers=2,
+            seq_len=32, optimizer=optax.sgd(1e-2), compress="int8",
+        )
+        ds = data.lm_copy_task(32, vocab=16)
+        for x, y in ds.batches(8, 2):
+            t.train_step(x, y)
+        assert assert_trainer_replicas(t) > 0
+
+
+class TestCollectiveResultReplication:
+    """threshold_allreduce's ring schedules return results the checker
+    cannot type; the AllreduceResult must still be replicated."""
+
+    @pytest.mark.parametrize("compress", [None, "bf16", "int8"])
+    def test_ring_result_replicated(self, line8, compress):
+        from akka_allreduce_tpu.comm.allreduce import threshold_allreduce
+
+        xs = np.random.default_rng(0).standard_normal((8, 300)).astype(
+            np.float32
+        )
+        res = threshold_allreduce(
+            line8, xs, schedule="ring", compress=compress
+        )
+        assert assert_replica_consistent(
+            {"sum": res.sum, "count": res.count}
+        ) > 0
+
+    # NOTE: the pallas_ring schedule is NOT exercised here: at some sizes
+    # the Pallas TPU interpreter deadlocks on this box (all device threads
+    # blocked in _allocate_buffer io_callbacks — the callback pool on a
+    # 1-core host is smaller than the 8 interpret devices that must
+    # rendezvous). Its replication is covered equivalently by
+    # tests/test_pallas_ring.py, which asserts EVERY device's output
+    # equals the numpy oracle (out[d] == sum for all d).
