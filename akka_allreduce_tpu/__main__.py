@@ -1919,6 +1919,78 @@ def _cmd_bench_checkpoint(argv: list[str]) -> int:
     return 0
 
 
+def _cmd_soak(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        "soak",
+        description="the everything-on endurance run (VERDICT r4 #3): "
+        "flagship FSDP LM + elastic membership churn + async "
+        "checkpointing + a mid-run restore, unattended; prints per-event "
+        "lines and one summary JSON",
+    )
+    p.add_argument("--steps", type=int, default=1000)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--heads", type=int, default=None, help="default d/128")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--batch-per-replica", type=int, default=2)
+    p.add_argument("--f32", action="store_true", help="disable bf16 compute")
+    p.add_argument(
+        "--remat", choices=("full", "params", "none"), default="params"
+    )
+    p.add_argument("--no-prefetch", action="store_true")
+    p.add_argument(
+        "--compress", choices=("bf16", "int8", "none"), default="int8"
+    )
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--drop-at", type=int, default=None)
+    p.add_argument("--rejoin-at", type=int, default=None)
+    p.add_argument("--restore-at", type=int, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument(
+        "--delta-checkpoint", action="store_true",
+        help="async delta store instead of async Orbax",
+    )
+    p.add_argument("--metrics-out", default=None)
+    args = p.parse_args(argv)
+    if args.remat == "full" and not args.no_prefetch:
+        p.error(
+            "--remat full excludes prefetch (the prefetched layer rides "
+            "the scan carry remat exists to drop): add --no-prefetch"
+        )
+
+    import json
+
+    from akka_allreduce_tpu.soak import run_soak
+
+    report = run_soak(
+        steps=args.steps,
+        nodes=args.nodes,
+        vocab=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.heads,
+        n_layers=args.layers,
+        seq_len=args.seq_len,
+        batch_per_replica=args.batch_per_replica,
+        bf16=not args.f32,
+        remat=False if args.remat == "none" else args.remat,
+        prefetch=not args.no_prefetch,
+        compress=None if args.compress == "none" else args.compress,
+        learning_rate=args.lr,
+        drop_at=args.drop_at,
+        rejoin_at=args.rejoin_at,
+        restore_at=args.restore_at,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        delta=args.delta_checkpoint,
+        metrics_out=args.metrics_out,
+    )
+    print(json.dumps(report.as_dict()))
+    return 0
+
+
 COMMANDS = {
     "local-demo": _cmd_local_demo,
     "cluster-master": _cmd_cluster_master,
@@ -1929,6 +2001,7 @@ COMMANDS = {
     "bench-suite": _cmd_bench_suite,
     "bench-mfu": _cmd_bench_mfu,
     "bench-checkpoint": _cmd_bench_checkpoint,
+    "soak": _cmd_soak,
     "train-mlp": _cmd_train_mlp,
     "train-resnet": _cmd_train_resnet,
     "train-zero1": _cmd_train_zero1,

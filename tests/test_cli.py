@@ -74,13 +74,10 @@ class TestCLI:
         capsys.readouterr()
         assert main(args) == 0  # second run resumes from the delta store
         assert "resumed from step 2" in capsys.readouterr().out
-        import pytest
-
-        # argparse mutually-exclusive group rejects the pair at parse time
-        with pytest.raises(SystemExit) as e:
-            main(args + ["--async-checkpoint"])
-        assert e.value.code == 2
-        assert "not allowed with" in capsys.readouterr().err
+        # round 5: async composes with delta (AsyncDeltaCheckpointer) —
+        # the combined flags train, save off-thread, and resume
+        assert main(args + ["--async-checkpoint"]) == 0
+        assert "resumed from step 4" in capsys.readouterr().out
 
     def test_train_pp_rejects_bad_virtual_schedule(self, capsys):
         import pytest

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import asyncio
 
+import numpy as np
+
 from tests.test_remote import _Harness, _config
 
 CYCLES = 5
@@ -108,3 +110,49 @@ def test_repeated_crash_rejoin_cycles():
             await h.stop()
 
     asyncio.run(run())
+
+
+def test_composed_trainer_soak(tmp_path):
+    """The everything-on XLA soak (VERDICT r4 #3) at CPU-mesh scale:
+    FSDP LM (remat+prefetch+int8) + elastic drop/rejoin + async
+    checkpointing + a mid-run restore, one unattended loop. The report
+    must show both re-meshes, a restore that actually rewound to a saved
+    step, non-stalling saves, and a finite dropping loss."""
+    from akka_allreduce_tpu.soak import run_soak
+
+    report = run_soak(
+        steps=36,
+        nodes=4,
+        vocab=16,
+        d_model=32,
+        n_heads=4,
+        n_layers=2,
+        seq_len=32,
+        batch_per_replica=2,
+        bf16=False,
+        remat="params",
+        prefetch=True,
+        compress="int8",
+        learning_rate=1e-2,
+        drop_at=10,
+        rejoin_at=20,
+        restore_at=30,
+        checkpoint_every=8,
+        checkpoint_dir=str(tmp_path / "soak_ckpt"),
+        metrics_out=str(tmp_path / "soak.jsonl"),
+        log=lambda *_: None,
+    )
+    kinds = [e["kind"] for e in report.remesh_events]
+    assert kinds == ["drop", "rejoin"], report.remesh_events
+    assert report.generation == 2
+    assert report.restore is not None
+    assert report.restore["restored_step"] <= 30
+    assert report.checkpoint_saves >= 2
+    assert np.isfinite(report.final_loss)
+    assert report.final_loss < report.first_loss
+    # the metrics JSONL carries one line per step plus the summary
+    import json
+
+    lines = (tmp_path / "soak.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 36 + 1
+    assert "summary" in json.loads(lines[-1])
