@@ -648,6 +648,72 @@ class TestAsyncDeltaCheckpointer:
         with pytest.raises(RuntimeError, match="disk full"):
             store.wait_until_finished()
 
+    def test_crash_mid_save_preserves_old_delta(self, tmp_path):
+        """SIGKILL a writer mid-delta-save: the previous manifest must stay
+        the latest durable step and restore cleanly (manifests publish via
+        atomic rename; a crash leaves orphan blobs/.tmp files the next
+        save's prune sweeps, never a torn manifest)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+        import time as _time
+
+        d = tmp_path / "dcrash"
+        script = textwrap.dedent(f"""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np, optax, jax
+            jax.config.update("jax_platforms", "cpu")
+            from akka_allreduce_tpu.models import MLP, data
+            from akka_allreduce_tpu.parallel import line_mesh
+            from akka_allreduce_tpu.train import (
+                AsyncDeltaCheckpointer, DPTrainer,
+            )
+            t = DPTrainer(
+                MLP(hidden=(256, 256), classes=10), line_mesh(1),
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.adam(1e-3), seed=0,
+            )
+            ds = data.mnist_like()
+            t.train(ds.batches(8, 1))
+            store = AsyncDeltaCheckpointer({str(d)!r})
+            store.save(t, block=True)   # step 1: durable baseline
+            t.train(ds.batches(8, 1, seed_offset=1))
+            store.save(t)               # step 2: async, about to be killed
+            print("SAVING", flush=True)
+            import time; time.sleep(30)
+        """)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        try:
+            line = proc.stdout.readline().decode()
+            assert "SAVING" in line, line
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        _time.sleep(0.2)
+        from akka_allreduce_tpu.train import DeltaCheckpointer
+
+        store = DeltaCheckpointer(d)
+        latest = store.latest_step()
+        assert latest is not None, "baseline delta checkpoint lost"
+        fresh = DPTrainer_for_crash_test()
+        step = store.restore(fresh, latest)
+        assert step == latest >= 1
+        assert np.isfinite(fresh.get_flat_params()).all()
+        # a fresh save sweeps any crash orphans (.tmp blobs/manifests)
+        fresh.step_num += 1
+        store.save(fresh)
+        assert not list(store.blobs.glob("*.tmp"))
+        assert not list(store.directory.glob(".manifest_*.tmp"))
+
 
 class TestDeltaCheckpointer:
     """Per-leaf content-addressed delta saves: unchanged leaves cost zero
