@@ -280,6 +280,44 @@ def elastic_cycle():
     assert m.contributors == 16.0 and np.isfinite(m.loss)
 
 
+def soak16():
+    """The composed soak loop (FSDP + elastic churn + async checkpoints +
+    mid-run restore) at 16 devices / 8 nodes — the composition the suite
+    proves at n=8, exercised beyond it."""
+    import tempfile
+
+    from akka_allreduce_tpu.soak import run_soak
+
+    report = run_soak(
+        steps=24,
+        nodes=8,
+        vocab=16,
+        d_model=32,
+        n_heads=4,
+        n_layers=2,
+        seq_len=32,
+        batch_per_replica=2,
+        bf16=False,
+        remat="params",
+        prefetch=True,
+        compress="int8",
+        learning_rate=1e-2,
+        drop_at=6,
+        rejoin_at=12,
+        restore_at=18,
+        checkpoint_every=5,
+        checkpoint_dir=tempfile.mkdtemp(prefix="soak16_"),
+        log=lambda *_: None,
+    )
+    kinds = [e["kind"] for e in report.remesh_events]
+    assert kinds == ["drop", "rejoin"], report.remesh_events
+    assert report.generation == 2
+    assert report.restore is not None
+    import numpy as _np
+
+    assert _np.isfinite(report.final_loss)
+
+
 def dryrun():
     """The driver's own multi-chip gate at N devices (it runs 8; the
     sharding math must not be 8-specific)."""
@@ -302,6 +340,7 @@ TABLE = {
     "fsdp_3axis": fsdp_3axis,
     "moe_ep8": moe_ep8,
     "elastic_cycle": elastic_cycle,
+    "soak16": soak16,
     "dryrun": dryrun,
 }
 

@@ -58,6 +58,9 @@ class TestScale16:
     def test_dryrun_multichip_16(self):
         run_worker(16, "dryrun")
 
+    def test_composed_soak_16(self):
+        run_worker(16, "soak16")
+
 
 @pytest.mark.slow
 class TestScale32:
