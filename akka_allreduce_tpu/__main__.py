@@ -125,7 +125,30 @@ def _basic_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--batch", type=int, default=64, help="global batch size")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
+    _compile_cache_flag(p)
     _checkpoint_flags(p)
+
+
+def _compile_cache_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--compile-cache",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="DIR",
+        help="enable JAX's persistent compilation cache (optional DIR; "
+        "default a shared temp dir) — recurring program shapes load from "
+        "disk instead of recompiling across runs and re-meshes",
+    )
+
+
+def _maybe_enable_compile_cache(args) -> None:
+    """Honor a --compile-cache flag if the CLI carries one."""
+    if getattr(args, "compile_cache", None) is not None:
+        from akka_allreduce_tpu.utils import enable_persistent_compile_cache
+
+        d = enable_persistent_compile_cache(args.compile_cache or None)
+        print(f"persistent compile cache: {d}")
 
 
 def _checkpoint_flags(p: argparse.ArgumentParser) -> None:
@@ -458,6 +481,7 @@ def _cmd_train_zero1(argv: list[str]) -> int:
         "(requires --compress bf16; costs no extra collective here)",
     )
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import numpy as np
     import optax
@@ -564,6 +588,7 @@ def _cmd_train_fsdp(argv: list[str]) -> int:
         "(no host I/O per step)",
     )
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import jax
 
@@ -636,6 +661,7 @@ def _cmd_train_mlp(argv: list[str]) -> int:
     _train_flags(p)
     p.add_argument("--hidden", type=int, nargs="+", default=[128])
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import jax.numpy as jnp
     import numpy as np
@@ -673,6 +699,7 @@ def _cmd_train_resnet(argv: list[str]) -> int:
     p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--classes", type=int, default=10)
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import jax.numpy as jnp
     import numpy as np
@@ -768,7 +795,9 @@ def _cmd_train_lm(argv: list[str]) -> int:
     )
     _checkpoint_flags(p)
     _add_sharded_compress_flag(p)
+    _compile_cache_flag(p)
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import jax.numpy as jnp
 
@@ -1185,30 +1214,14 @@ def _cmd_elastic_demo(argv: list[str]) -> int:
         "redistribute, the same logical layers re-chunk, sequences "
         "re-split)",
     )
-    p.add_argument(
-        "--compile-cache",
-        nargs="?",
-        const="",
-        default=None,
-        metavar="DIR",
-        help="enable JAX's persistent compilation cache (optional DIR; "
-        "default a shared temp dir): re-meshes back to a previously-seen "
-        "mesh size load their executables from disk instead of "
-        "recompiling — the dominant term of transformer-family re-mesh "
-        "latency",
-    )
+    _compile_cache_flag(p)
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import jax
     import numpy as np
 
     from akka_allreduce_tpu.models import MLP, data
-
-    if args.compile_cache is not None:
-        from akka_allreduce_tpu.utils import enable_persistent_compile_cache
-
-        d = enable_persistent_compile_cache(args.compile_cache or None)
-        print(f"persistent compile cache: {d}")
     from akka_allreduce_tpu.train import (
         ElasticDPTrainer,
         ElasticLongContextTrainer,
@@ -1345,7 +1358,9 @@ def _cmd_train_moe(argv: list[str]) -> int:
         "I/O per step)",
     )
     _add_sharded_compress_flag(p)
+    _compile_cache_flag(p)
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
 
     import jax
     import jax.numpy as jnp
@@ -1492,7 +1507,9 @@ def _cmd_train_pp(argv: list[str]) -> int:
         "(layers-per-stage must divide by it)",
     )
     _add_sharded_compress_flag(p)
+    _compile_cache_flag(p)
     args = p.parse_args(argv)
+    _maybe_enable_compile_cache(args)
     import jax
 
     from akka_allreduce_tpu.models import data

@@ -288,27 +288,28 @@ def soak16():
 
     from akka_allreduce_tpu.soak import run_soak
 
-    report = run_soak(
-        steps=24,
-        nodes=8,
-        vocab=16,
-        d_model=32,
-        n_heads=4,
-        n_layers=2,
-        seq_len=32,
-        batch_per_replica=2,
-        bf16=False,
-        remat="params",
-        prefetch=True,
-        compress="int8",
-        learning_rate=1e-2,
-        drop_at=6,
-        rejoin_at=12,
-        restore_at=18,
-        checkpoint_every=5,
-        checkpoint_dir=tempfile.mkdtemp(prefix="soak16_"),
-        log=lambda *_: None,
-    )
+    with tempfile.TemporaryDirectory(prefix="soak16_") as ckpt_dir:
+        report = run_soak(
+            steps=24,
+            nodes=8,
+            vocab=16,
+            d_model=32,
+            n_heads=4,
+            n_layers=2,
+            seq_len=32,
+            batch_per_replica=2,
+            bf16=False,
+            remat="params",
+            prefetch=True,
+            compress="int8",
+            learning_rate=1e-2,
+            drop_at=6,
+            rejoin_at=12,
+            restore_at=18,
+            checkpoint_every=5,
+            checkpoint_dir=ckpt_dir,
+            log=lambda *_: None,
+        )
     kinds = [e["kind"] for e in report.remesh_events]
     assert kinds == ["drop", "rejoin"], report.remesh_events
     assert report.generation == 2
