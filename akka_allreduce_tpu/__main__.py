@@ -2033,13 +2033,11 @@ COMMANDS = {
 
 def main(argv: list[str] | None = None) -> int:
     # the axon TPU plugin overrides JAX_PLATFORMS at import time; re-assert
-    # the user's explicit platform choice (same dance as tests/conftest.py)
-    import os
+    # the user's explicit platform choice (ONE copy of the dance:
+    # utils/platform.py)
+    from akka_allreduce_tpu.utils import respect_env_platform
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    respect_env_platform()
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)

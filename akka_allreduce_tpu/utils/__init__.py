@@ -7,6 +7,9 @@ from akka_allreduce_tpu.utils.metrics import (  # noqa: F401
 from akka_allreduce_tpu.utils.compile_cache import (  # noqa: F401
     enable_persistent_compile_cache,
 )
+from akka_allreduce_tpu.utils.platform import (  # noqa: F401
+    respect_env_platform,
+)
 from akka_allreduce_tpu.utils.verify import (  # noqa: F401
     assert_replica_consistent,
     assert_trainer_replicas,

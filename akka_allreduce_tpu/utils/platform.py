@@ -1,0 +1,26 @@
+"""Platform-selection workaround for the axon TPU plugin.
+
+The plugin overrides ``jax_platforms`` at import time (its sitecustomize
+registers "axon,cpu" via ``jax.config``, ignoring a user's
+``JAX_PLATFORMS`` environment variable). Every entrypoint that honors an
+explicit platform request therefore re-asserts the env var through
+``jax.config`` — this helper is the ONE copy of that dance (the CLI
+dispatcher, bench.py, and the test conftest's pre-import variant all
+route the same intent).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_env_platform() -> str | None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment into
+    ``jax.config`` (a no-op when unset). Returns the platform string in
+    effect, or None when the plugin's default stands."""
+    env = os.environ.get("JAX_PLATFORMS")
+    if env:
+        import jax
+
+        jax.config.update("jax_platforms", env)
+    return env or None

@@ -86,6 +86,42 @@ def main() -> None:
     signal.signal(signal.SIGALRM, on_timeout)
     signal.alarm(watchdog_s)
 
+    # Initialize the backend in a DAEMON THREAD with a bounded join: a
+    # downed tunnel can hang jax.devices() inside a C-level wait where
+    # the SIGALRM handler never gets to run (Python signal delivery needs
+    # the main thread back in the interpreter). The thread shares the
+    # process, so a successful init is reused — no second init cost —
+    # and a hung or failed one leaves the main thread free to emit an
+    # honest JSON line and exit.
+    import threading
+
+    probe_s = int(os.environ.get("BENCH_INIT_PROBE_TIMEOUT", 120))
+    init: dict = {}
+
+    def _init_backend():
+        try:
+            from akka_allreduce_tpu.utils import respect_env_platform
+
+            import jax
+
+            # the axon plugin overrides JAX_PLATFORMS; jax.config wins
+            respect_env_platform()
+            init["devices"] = jax.devices()
+        except Exception as e:  # surfaced in the JSON record
+            init["error"] = repr(e)
+
+    _t = threading.Thread(target=_init_backend, daemon=True)
+    _t.start()
+    _t.join(probe_s)
+    if _t.is_alive() or "error" in init:
+        err = init.get("error", f"backend init exceeded {probe_s}s")
+        print(f"backend init failed: {err}", file=sys.stderr)
+        _emit(
+            f"allreduce_bench_BACKEND_UNAVAILABLE_{mfloat}Mfloat", 0.0,
+            error=err[:200],
+        )
+        os._exit(2)
+
     import jax
     import jax.numpy as jnp
     from jax import lax
