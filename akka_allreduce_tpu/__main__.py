@@ -147,8 +147,10 @@ def _maybe_enable_compile_cache(args) -> None:
     if getattr(args, "compile_cache", None) is not None:
         from akka_allreduce_tpu.utils import enable_persistent_compile_cache
 
+        # CLI processes keep the cache for their whole lifetime — the
+        # restore handle matters for scoped users (bench-suite config 5)
         d = enable_persistent_compile_cache(args.compile_cache or None)
-        print(f"persistent compile cache: {d}")
+        print(f"persistent compile cache: {d.directory}")
 
 
 def _checkpoint_flags(p: argparse.ArgumentParser) -> None:
@@ -1014,6 +1016,14 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         stage_note = ", ".join(
             f"{k}={v:.3f}s" for k, v in stages.items()
         )
+        # provenance for the recorded number: which wire codec ran (the C++
+        # hot loop vs the struct/numpy fallback) — same flag the engine
+        # kernels use, so one bool covers both hot paths. loaded(), not
+        # available(): the latter may block on a compile and then describe
+        # a library the finished run never used
+        from akka_allreduce_tpu import native as _native
+
+        wire_path = "native" if _native.loaded() else "python"
         print(
             f"node {nid} shut down ({reason}): {state['flushes']} rounds, "
             f"{mbs:.1f} MB/s reduced",
@@ -1030,7 +1040,7 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             f"node {nid} stage times over {dt:.2f}s wall: {stage_note} "
             f"(wall spans, {accounted:.2f}s total; partition: own cpu "
             f"{cpu:.2f}s, off-cpu {max(dt - cpu, 0.0):.2f}s = "
-            "peer/master scheduled or socket idle)",
+            f"peer/master scheduled or socket idle; wire={wire_path})",
             flush=True,
         )
         if args.metrics_out:
@@ -1041,6 +1051,7 @@ def _cmd_cluster_node(argv: list[str]) -> int:
                 kind="node_stage_times", node=nid, wall_s=round(dt, 3),
                 cpu_s=round(cpu, 3),
                 rounds=state["flushes"], mb_per_s=round(mbs, 1),
+                wire=wire_path,
                 **{k: round(v, 4) for k, v in stages.items()},
             )
             m.close()

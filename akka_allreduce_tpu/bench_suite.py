@@ -74,6 +74,15 @@ def config1_local_engine(size: int = 1_000_000, rounds: int = 30) -> dict:
         [sink_for(i) for i in range(n)],
         cfg,
     )
+    from akka_allreduce_tpu import native
+
+    # which hot-loop implementation this run will use (the C++ engine+wire
+    # library vs the numpy/struct fallback) — throughput records without
+    # provenance are not comparable across machines. Snapshot the LOADED
+    # state before the measured window: available() may block minutes
+    # compiling and then describe a library the run never used.
+    native.available()  # settle the lazy build before timing starts
+    native_engine = native.loaded()
     t0 = time.perf_counter()
     system.start()
     system.run_until_quiescent()
@@ -87,6 +96,7 @@ def config1_local_engine(size: int = 1_000_000, rounds: int = 30) -> dict:
         rounds=completed,
         seconds=round(dt, 4),
         throughput_mbs=round(completed * size * 4 / dt / 1e6, 1),
+        native_engine=native_engine,
         path="host_engine",
     )
 
@@ -438,179 +448,186 @@ def config5_dropout_recovery(size: int = 200_000) -> dict:
     # a FRESH per-run dir: the cold drop numbers must really be cold — a
     # shared cache dir would make any rerun's "cold" latencies silently
     # warm with the previous run's executables
-    compile_cache_dir = enable_persistent_compile_cache(
+    cache = enable_persistent_compile_cache(
         tempfile.mkdtemp(prefix="remesh_xla_cache_")
     )
+    compile_cache_dir = cache.directory
+    try:
 
-    def remesh_cycle(elastic, batch_for=None):
-        """Drop + late-joiner + WARM second-drop cycle on ``elastic``;
-        returns the measured (drop, rejoin, warm_drop) re-mesh+first-step
-        latencies and the step metrics. ``batch_for(trainer, seed_offset)``
-        supplies the per-phase batch (default: the MNIST loader sized
-        8 rows/device)."""
-        if batch_for is None:
-            batch_for = lambda t, s: next(  # noqa: E731
-                iter(ds.batches(8 * t.n_devices, 1, seed_offset=s))
-            )
-        x, y = batch_for(elastic.trainer, 0)
-        elastic.train_step(x, y)  # compile generation 0
+        def remesh_cycle(elastic, batch_for=None):
+            """Drop + late-joiner + WARM second-drop cycle on ``elastic``;
+            returns the measured (drop, rejoin, warm_drop) re-mesh+first-step
+            latencies and the step metrics. ``batch_for(trainer, seed_offset)``
+            supplies the per-phase batch (default: the MNIST loader sized
+            8 rows/device)."""
+            if batch_for is None:
+                batch_for = lambda t, s: next(  # noqa: E731
+                    iter(ds.batches(8 * t.n_devices, 1, seed_offset=s))
+                )
+            x, y = batch_for(elastic.trainer, 0)
+            elastic.train_step(x, y)  # compile generation 0
 
-        def drop_lost():
-            # dropout: the lost node goes silent long enough for phi to
-            # accrue while the survivors keep heartbeating across the gap
-            for k in survivors:
-                elastic.heartbeat(k)
-            now["t"] += 60.0
-            for k in survivors:
-                elastic.heartbeat(k)
-            t0 = time.perf_counter()
-            dropped = elastic.poll()
-            x, y = batch_for(elastic.trainer, 2)
-            m = elastic.train_step(x, y)  # includes new-mesh compile
-            return dropped, m, time.perf_counter() - t0
+            def drop_lost():
+                # dropout: the lost node goes silent long enough for phi to
+                # accrue while the survivors keep heartbeating across the gap
+                for k in survivors:
+                    elastic.heartbeat(k)
+                now["t"] += 60.0
+                for k in survivors:
+                    elastic.heartbeat(k)
+                t0 = time.perf_counter()
+                dropped = elastic.poll()
+                x, y = batch_for(elastic.trainer, 2)
+                m = elastic.train_step(x, y)  # includes new-mesh compile
+                return dropped, m, time.perf_counter() - t0
 
-        def rejoin_lost():
-            now["t"] += 1.0
-            elastic.heartbeat(lost)
-            t0 = time.perf_counter()
-            rejoined = elastic.poll()
-            x, y = batch_for(elastic.trainer, 3)
-            m = elastic.train_step(x, y)
-            return rejoined, m, time.perf_counter() - t0
+            def rejoin_lost():
+                now["t"] += 1.0
+                elastic.heartbeat(lost)
+                t0 = time.perf_counter()
+                rejoined = elastic.poll()
+                x, y = batch_for(elastic.trainer, 3)
+                m = elastic.train_step(x, y)
+                return rejoined, m, time.perf_counter() - t0
 
-        dropped, m_drop, drop_s = drop_lost()
-        rejoined, m_join, rejoin_s = rejoin_lost()
-        # warm second drop: the same membership change as the first, so
-        # the rebuilt trainer's programs hash to cache entries the first
-        # drop wrote — re-mesh latency minus the XLA compile
-        _, _, warm_drop_s = drop_lost()
-        rejoin_lost()  # restore full membership for any caller after us
-        return dropped, rejoined, drop_s, rejoin_s, warm_drop_s, m_drop, m_join
+            dropped, m_drop, drop_s = drop_lost()
+            rejoined, m_join, rejoin_s = rejoin_lost()
+            # warm second drop: the same membership change as the first, so
+            # the rebuilt trainer's programs hash to cache entries the first
+            # drop wrote — re-mesh latency minus the XLA compile
+            _, _, warm_drop_s = drop_lost()
+            rejoin_lost()  # restore full membership for any caller after us
+            return dropped, rejoined, drop_s, rejoin_s, warm_drop_s, m_drop, m_join
 
-    trainer = ElasticDPTrainer(
-        MLP(hidden=(16,), classes=10),
-        assignment,
-        example_input=np.zeros((1, 28, 28, 1), np.float32),
-        clock=lambda: now["t"],
-    )
-    (
-        dropped_remesh, rejoin_remesh, drop_remesh_s, rejoin_remesh_s,
-        warm_drop_remesh_s, m_drop, m_join,
-    ) = remesh_cycle(trainer)
-
-    # sharded-state variant (VERDICT r3 #3): ZeRO-1's 1/n optimizer shards
-    # survive the SAME cycle through the mesh-size-independent snapshot
-    # (Snapshot -> checkpoint_state -> reshard onto the new mesh)
-    import optax
-
-    from akka_allreduce_tpu.train import ElasticTrainer, Zero1DPTrainer
-
-    def z1_factory(mesh):
-        return Zero1DPTrainer(
+        trainer = ElasticDPTrainer(
             MLP(hidden=(16,), classes=10),
-            mesh,
+            assignment,
             example_input=np.zeros((1, 28, 28, 1), np.float32),
-            optimizer=optax.sgd(0.1),
-            seed=0,
+            clock=lambda: now["t"],
+        )
+        (
+            dropped_remesh, rejoin_remesh, drop_remesh_s, rejoin_remesh_s,
+            warm_drop_remesh_s, m_drop, m_join,
+        ) = remesh_cycle(trainer)
+
+        # sharded-state variant (VERDICT r3 #3): ZeRO-1's 1/n optimizer shards
+        # survive the SAME cycle through the mesh-size-independent snapshot
+        # (Snapshot -> checkpoint_state -> reshard onto the new mesh)
+        import optax
+
+        from akka_allreduce_tpu.train import ElasticTrainer, Zero1DPTrainer
+
+        def z1_factory(mesh):
+            return Zero1DPTrainer(
+                MLP(hidden=(16,), classes=10),
+                mesh,
+                example_input=np.zeros((1, 28, 28, 1), np.float32),
+                optimizer=optax.sgd(0.1),
+                seed=0,
+            )
+
+        z1 = ElasticTrainer(z1_factory, assignment, clock=lambda: now["t"])
+        (
+            z1_dropped, z1_rejoined, z1_drop_s, z1_rejoin_s, z1_warm_drop_s,
+            _, z1_join,
+        ) = remesh_cycle(z1)
+
+        # parallelism-family variants (VERDICT r3 next-round #1): MoE, Pipeline
+        # and LongContext run the SAME drop + late-joiner cycle — their meshes
+        # re-SHAPE with membership (expert/pipe/seq axes adapt), with logical
+        # state crossing through the snapshot protocols. On one real chip the
+        # structure axes stay 1 (zero-device control node drops), but the full
+        # snapshot -> rebuild -> recompile -> restore -> first-step path is
+        # measured; the CPU-mesh suite exercises the axis re-shaping
+        # (tests/test_elastic.py).
+        from akka_allreduce_tpu.models import data as _lmdata
+        from akka_allreduce_tpu.train import (
+            ElasticLongContextTrainer,
+            ElasticMoETrainer,
+            ElasticPipelineTrainer,
         )
 
-    z1 = ElasticTrainer(z1_factory, assignment, clock=lambda: now["t"])
-    (
-        z1_dropped, z1_rejoined, z1_drop_s, z1_rejoin_s, z1_warm_drop_s,
-        _, z1_join,
-    ) = remesh_cycle(z1)
+        lm_ds = _lmdata.lm_copy_task(32, vocab=16)
 
-    # parallelism-family variants (VERDICT r3 next-round #1): MoE, Pipeline
-    # and LongContext run the SAME drop + late-joiner cycle — their meshes
-    # re-SHAPE with membership (expert/pipe/seq axes adapt), with logical
-    # state crossing through the snapshot protocols. On one real chip the
-    # structure axes stay 1 (zero-device control node drops), but the full
-    # snapshot -> rebuild -> recompile -> restore -> first-step path is
-    # measured; the CPU-mesh suite exercises the axis re-shaping
-    # (tests/test_elastic.py).
-    from akka_allreduce_tpu.models import data as _lmdata
-    from akka_allreduce_tpu.train import (
-        ElasticLongContextTrainer,
-        ElasticMoETrainer,
-        ElasticPipelineTrainer,
-    )
+        def family_cycle(e, rows_of):
+            """remesh_cycle fed LM token batches sized to the CURRENT mesh."""
+            dropped, rejoined, drop_s, rejoin_s, warm_s, _, m = remesh_cycle(
+                e,
+                lambda t, s: next(lm_ds.batches(rows_of(t), 1, seed_offset=s)),
+            )
+            return bool(dropped) and bool(rejoined), drop_s, rejoin_s, warm_s, m
 
-    lm_ds = _lmdata.lm_copy_task(32, vocab=16)
-
-    def family_cycle(e, rows_of):
-        """remesh_cycle fed LM token batches sized to the CURRENT mesh."""
-        dropped, rejoined, drop_s, rejoin_s, warm_s, _, m = remesh_cycle(
-            e,
-            lambda t, s: next(lm_ds.batches(rows_of(t), 1, seed_offset=s)),
+        fam_kw = dict(
+            vocab=16, d_model=32, n_heads=2, learning_rate=1e-2, seed=0,
+            clock=lambda: now["t"],
         )
-        return bool(dropped) and bool(rejoined), drop_s, rejoin_s, warm_s, m
+        moe_ok, moe_drop_s, moe_rejoin_s, moe_warm_s, moe_m = family_cycle(
+            ElasticMoETrainer(
+                assignment, n_experts=4, n_layers=1, seq_len=32,
+                capacity_factor=4.0, **fam_kw,
+            ),
+            lambda t: t.dp * t.ep,
+        )
+        pp_ok, pp_drop_s, pp_rejoin_s, pp_warm_s, pp_m = family_cycle(
+            ElasticPipelineTrainer(
+                assignment, n_layers=2, microbatches=2, seq_len=32, **fam_kw,
+            ),
+            lambda t: t.dp * t.microbatches,
+        )
+        lc_ok, lc_drop_s, lc_rejoin_s, lc_warm_s, lc_m = family_cycle(
+            ElasticLongContextTrainer(
+                assignment, seq_len=32, max_sp=4, n_layers=1, **fam_kw,
+            ),
+            lambda t: t.dp,
+        )
 
-    fam_kw = dict(
-        vocab=16, d_model=32, n_heads=2, learning_rate=1e-2, seed=0,
-        clock=lambda: now["t"],
-    )
-    moe_ok, moe_drop_s, moe_rejoin_s, moe_warm_s, moe_m = family_cycle(
-        ElasticMoETrainer(
-            assignment, n_experts=4, n_layers=1, seq_len=32,
-            capacity_factor=4.0, **fam_kw,
-        ),
-        lambda t: t.dp * t.ep,
-    )
-    pp_ok, pp_drop_s, pp_rejoin_s, pp_warm_s, pp_m = family_cycle(
-        ElasticPipelineTrainer(
-            assignment, n_layers=2, microbatches=2, seq_len=32, **fam_kw,
-        ),
-        lambda t: t.dp * t.microbatches,
-    )
-    lc_ok, lc_drop_s, lc_rejoin_s, lc_warm_s, lc_m = family_cycle(
-        ElasticLongContextTrainer(
-            assignment, seq_len=32, max_sp=4, n_layers=1, **fam_kw,
-        ),
-        lambda t: t.dp,
-    )
-
-    return _record(
-        5,
-        "threshold_dropout_recovery",
-        workers=n,
-        threshold=0.75,
-        rounds_completed=completed,
-        seconds=round(dt, 4),
-        mean_contributors=round(mean_count, 2),
-        dropped_remeshed=bool(dropped_remesh),
-        rejoin_remeshed=bool(rejoin_remesh),
-        remeshed=bool(dropped_remesh) and bool(rejoin_remesh),
-        remesh_nodes=trainer.n_nodes,
-        device_platform=devices[0].platform,
-        zero_device_control_node=zero_device_node,
-        drop_remesh_and_first_step_s=round(drop_remesh_s, 3),
-        rejoin_remesh_and_first_step_s=round(rejoin_remesh_s, 3),
-        warm_drop_remesh_and_first_step_s=round(warm_drop_remesh_s, 3),
-        compile_cache=compile_cache_dir,
-        post_remesh_loss=round(m_drop.loss, 4),
-        post_rejoin_loss=round(m_join.loss, 4),
-        zero1_remeshed=bool(z1_dropped) and bool(z1_rejoined),
-        zero1_drop_remesh_and_first_step_s=round(z1_drop_s, 3),
-        zero1_rejoin_remesh_and_first_step_s=round(z1_rejoin_s, 3),
-        zero1_warm_drop_remesh_and_first_step_s=round(z1_warm_drop_s, 3),
-        zero1_post_rejoin_loss=round(z1_join.loss, 4),
-        moe_remeshed=moe_ok,
-        moe_drop_remesh_and_first_step_s=round(moe_drop_s, 3),
-        moe_rejoin_remesh_and_first_step_s=round(moe_rejoin_s, 3),
-        moe_warm_drop_remesh_and_first_step_s=round(moe_warm_s, 3),
-        moe_post_rejoin_loss=round(moe_m.loss, 4),
-        pipeline_remeshed=pp_ok,
-        pipeline_drop_remesh_and_first_step_s=round(pp_drop_s, 3),
-        pipeline_rejoin_remesh_and_first_step_s=round(pp_rejoin_s, 3),
-        pipeline_warm_drop_remesh_and_first_step_s=round(pp_warm_s, 3),
-        pipeline_post_rejoin_loss=round(pp_m.loss, 4),
-        long_context_remeshed=lc_ok,
-        long_context_drop_remesh_and_first_step_s=round(lc_drop_s, 3),
-        long_context_rejoin_remesh_and_first_step_s=round(lc_rejoin_s, 3),
-        long_context_warm_drop_remesh_and_first_step_s=round(lc_warm_s, 3),
-        long_context_post_rejoin_loss=round(lc_m.loss, 4),
-        path="host_engine + xla_elastic",
-    )
+        return _record(
+            5,
+            "threshold_dropout_recovery",
+            workers=n,
+            threshold=0.75,
+            rounds_completed=completed,
+            seconds=round(dt, 4),
+            mean_contributors=round(mean_count, 2),
+            dropped_remeshed=bool(dropped_remesh),
+            rejoin_remeshed=bool(rejoin_remesh),
+            remeshed=bool(dropped_remesh) and bool(rejoin_remesh),
+            remesh_nodes=trainer.n_nodes,
+            device_platform=devices[0].platform,
+            zero_device_control_node=zero_device_node,
+            drop_remesh_and_first_step_s=round(drop_remesh_s, 3),
+            rejoin_remesh_and_first_step_s=round(rejoin_remesh_s, 3),
+            warm_drop_remesh_and_first_step_s=round(warm_drop_remesh_s, 3),
+            compile_cache=compile_cache_dir,
+            post_remesh_loss=round(m_drop.loss, 4),
+            post_rejoin_loss=round(m_join.loss, 4),
+            zero1_remeshed=bool(z1_dropped) and bool(z1_rejoined),
+            zero1_drop_remesh_and_first_step_s=round(z1_drop_s, 3),
+            zero1_rejoin_remesh_and_first_step_s=round(z1_rejoin_s, 3),
+            zero1_warm_drop_remesh_and_first_step_s=round(z1_warm_drop_s, 3),
+            zero1_post_rejoin_loss=round(z1_join.loss, 4),
+            moe_remeshed=moe_ok,
+            moe_drop_remesh_and_first_step_s=round(moe_drop_s, 3),
+            moe_rejoin_remesh_and_first_step_s=round(moe_rejoin_s, 3),
+            moe_warm_drop_remesh_and_first_step_s=round(moe_warm_s, 3),
+            moe_post_rejoin_loss=round(moe_m.loss, 4),
+            pipeline_remeshed=pp_ok,
+            pipeline_drop_remesh_and_first_step_s=round(pp_drop_s, 3),
+            pipeline_rejoin_remesh_and_first_step_s=round(pp_rejoin_s, 3),
+            pipeline_warm_drop_remesh_and_first_step_s=round(pp_warm_s, 3),
+            pipeline_post_rejoin_loss=round(pp_m.loss, 4),
+            long_context_remeshed=lc_ok,
+            long_context_drop_remesh_and_first_step_s=round(lc_drop_s, 3),
+            long_context_rejoin_remesh_and_first_step_s=round(lc_rejoin_s, 3),
+            long_context_warm_drop_remesh_and_first_step_s=round(lc_warm_s, 3),
+            long_context_post_rejoin_loss=round(lc_m.loss, 4),
+            path="host_engine + xla_elastic",
+        )
+    finally:
+        # the enable mutates global jax.config (cache dir + cache-everything
+        # thresholds); leaking it poisons everything that compiles later in
+        # this process (the round-5 two-test crash pair) — always restore
+        cache.restore()
 
 
 # -- suite driver --------------------------------------------------------------

@@ -32,6 +32,21 @@ class RoundOutOfWindowError(Exception):
     either already flushed (stale duplicate) or too far in the future."""
 
 
+def _as_f32(value) -> np.ndarray:
+    """View ``value`` as float32 without a copy whenever possible.
+
+    Decoded wire payloads arrive as ``np.frombuffer`` views into the
+    transport's receive buffer (control/remote.py) — viewing them again here
+    must not materialize a defensive copy; the stores below copy exactly
+    once, into their own accumulation/assembly storage. Raw buffers
+    (memoryview/bytes) are accepted too, viewed in place."""
+    if isinstance(value, np.ndarray):
+        return value if value.dtype == np.float32 else value.astype(np.float32)
+    if isinstance(value, (memoryview, bytes, bytearray)):
+        return np.frombuffer(value, dtype=np.float32)
+    return np.asarray(value, dtype=np.float32)
+
+
 class ScatteredDataBuffer:
     """Accumulates scatter contributions for one worker's block in one round.
 
@@ -95,7 +110,7 @@ class ScatteredDataBuffer:
             raise IndexError(f"src_id {src_id} out of [0, {self.peer_size})")
         if self._contributed[chunk_id, src_id]:
             return False  # duplicate delivery — at-least-once transports are fine
-        value = np.asarray(value, dtype=np.float32)
+        value = _as_f32(value)
         if value.shape != (stop - start,):
             raise ValueError(
                 f"chunk {chunk_id} expects shape ({stop - start},), got {value.shape}"
@@ -211,7 +226,7 @@ class ReducedDataBuffer:
         start, stop = self._bounds(src_id, chunk_id)  # validates ids first
         if self._filled[src_id, chunk_id]:
             return  # duplicate delivery
-        value = np.asarray(value, dtype=np.float32)
+        value = _as_f32(value)
         if value.shape != (stop - start,):
             raise ValueError(
                 f"block {src_id} chunk {chunk_id} expects shape ({stop - start},),"

@@ -199,10 +199,17 @@ def _main() -> None:
     # flushed, not per-node flush events
     completed = min(len(f) for f in flushes)
     total_bytes = args.size * 4 * completed
+    # provenance next to the number (same flag the TCP cluster prints):
+    # throughput without the engine path recorded is not comparable.
+    # loaded() (non-blocking, no build) — available() could compile for
+    # minutes and then describe a library the finished run never used
+    from akka_allreduce_tpu import native
+
     print(
         f"nodes={args.nodes} size={args.size} rounds_completed={completed} "
         f"(per-node flushes: {[len(f) for f in flushes]}) "
         f"elapsed={dt:.3f}s allreduce_throughput={total_bytes / dt / 1e6:.1f} MB/s "
+        f"engine={'native' if native.loaded() else 'numpy'} "
         f"(host engine; the TPU data plane runs this as one XLA collective)"
     )
 

@@ -13,6 +13,23 @@ addresses to endpoints: exact routes ("master" -> seed) and prefix resolvers
 is at-most-once: a dead or unknown destination drops the message — exactly the
 reference's remoting semantics, and what the threshold design expects
 (SURVEY.md §4.2: rounds complete at threshold, never wait for lost messages).
+
+Data plane (zero-copy, both directions):
+
+- **send**: frames are scatter-gather segment lists from
+  ``wire.encode_frame_parts`` — the float payload segment is a ``memoryview``
+  of the engine's array — handed to ``socket.sendmsg`` (writev), so the
+  kernel gathers header + payload with NO user-space concatenation copy.
+  Small control frames coalesce into a per-connection buffer flushed on the
+  next event-loop pass (or as the prefix of the next big send), so a burst
+  of heartbeats/acks costs one syscall, not one each.
+- **receive**: a ``BufferedProtocol`` reads each frame body straight into a
+  pooled preallocated buffer via the event loop's ``recv_into`` (no
+  per-frame ``bytes`` allocation, no readexactly join copy), and the decoded
+  float payloads are ``np.frombuffer`` views INTO that buffer. A buffer
+  returns to the pool only after the handler has run and no decoded view
+  still aliases it (checked via the bytearray export count), so zero-copy
+  can never turn into use-after-recycle.
 """
 
 from __future__ import annotations
@@ -21,6 +38,7 @@ import asyncio
 import logging
 import socket
 import time
+from collections import deque
 from typing import Any, Awaitable, Callable
 
 from akka_allreduce_tpu.control import wire
@@ -32,6 +50,231 @@ log = logging.getLogger(__name__)
 Handler = Callable[[Any], list[Envelope]]
 PrefixHandler = Callable[[int, Any], list[Envelope]]
 _U32 = wire._U32
+
+# Frames at or below this many bytes coalesce into the sender queue's tail
+# entry (one small memcpy) instead of costing an iovec slot and a frame entry
+# each; payload frames are far above it and always go vectored.
+_COALESCE_MAX = 1024
+
+# Size bound of one coalesce entry — a burst larger than this just starts a
+# new entry (still one sendmsg, one extra iovec slot).
+_COALESCE_ENTRY_MAX = 64 << 10
+
+# Kernel socket buffer request for both directions: payload frames are
+# MB-scale, and the kernel buffer is the send pipeline now that frames go
+# straight from engine memory to the socket (no user-space staging copy) —
+# the default ~208 KB would cost several writability round-trips per frame.
+_SOCK_BUF_BYTES = 4 << 20
+
+
+def _byte_views(parts) -> list[memoryview]:
+    return [
+        p if isinstance(p, memoryview) else memoryview(p) for p in parts
+    ]
+
+
+class _Frame:
+    """One queued outbound frame: segments + the envelope(s) it carries."""
+
+    __slots__ = ("parts", "envs", "nbytes", "coalesced", "inflight")
+
+    def __init__(self, parts: list, envs: list, nbytes: int, coalesced: bool) -> None:
+        self.parts = parts
+        self.envs = envs
+        self.nbytes = nbytes
+        self.coalesced = coalesced
+        # set once the writer exports this frame's buffers into a sendmsg
+        # batch: no further merging (a resize with live exports raises
+        # BufferError) and no backpressure drop (stream would desync)
+        self.inflight = False
+
+
+class _Sender:
+    """Per-endpoint outbound state: a frame queue drained by ONE writer task.
+
+    ``send`` enqueues frame segments (zero-copy views of engine memory) and
+    returns; the writer connects lazily and drains the queue with
+    multi-frame vectored ``sendmsg`` calls — the queue is the pipeline, so
+    the pump keeps decoding/handling while the kernel drains bytes. Small
+    control frames coalesce into the queue's tail entry (one tiny memcpy)
+    instead of costing an iovec slot and a wakeup each.
+    """
+
+    __slots__ = (
+        "queue", "queued_bytes", "sock", "writer_task", "retry_ok",
+        "waiters", "closed",
+    )
+
+    def __init__(self) -> None:
+        self.queue: "deque[_Frame]" = deque()
+        self.queued_bytes = 0
+        self.sock: socket.socket | None = None
+        self.writer_task: asyncio.Task | None = None
+        # one reconnect-and-retry is allowed after a period of success: a
+        # cached connection whose peer restarted fails on the first write
+        # after the restart — that staleness is this transport's problem. A
+        # failure on a FRESH connection means the peer is genuinely gone.
+        self.retry_ok = False
+        self.waiters: list[asyncio.Future] = []
+        self.closed = False
+
+    def close_sock(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:  # pragma: no cover - close is best effort
+                pass
+            self.sock = None
+        self.retry_ok = False
+
+    def close(self) -> None:
+        self.closed = True
+        task = self.writer_task
+        if (
+            task is not None
+            and not task.done()
+            and task is not asyncio.current_task()
+        ):
+            task.cancel()
+        self.close_sock()
+        self.queue.clear()
+        self.queued_bytes = 0
+        self.wake_waiters()
+
+    def wake_waiters(self) -> None:
+        for fut in self.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self.waiters.clear()
+
+
+class _FrameReceiver(asyncio.BufferedProtocol):
+    """Inbound framing over a preallocated receive ring.
+
+    The event loop ``recv_into``s a fixed ring buffer (no per-frame
+    ``bytes``), and every COMPLETE frame in it is parsed per recv — a
+    coalesced burst of control frames costs one syscall, not one each.
+    Small bodies decode via a tiny copy out of the ring (control messages
+    and sub-16KB payloads — the ring is reused, so views must not alias
+    it); payload-scale bodies switch the protocol to direct mode, where
+    the remainder of the body is received straight into a pooled
+    frame-sized buffer and decode hands the engine zero-copy views INTO
+    that buffer (recycled only once no view aliases it)."""
+
+    _RING_BYTES = 64 << 10
+    # bodies at/below this are served out of the ring (one small memcpy);
+    # anything larger gets a dedicated pooled buffer and zero-copy decode
+    _SMALL_BODY_MAX = 16 << 10
+
+    def __init__(self, owner: "RemoteTransport") -> None:
+        self._owner = owner
+        self._ring = bytearray(self._RING_BYTES)
+        self._rlen = 0  # valid bytes at the ring's start
+        self._body: bytearray | None = None  # direct-mode target buffer
+        self._need = 0
+        self._got = 0
+        self._transport: asyncio.Transport | None = None
+
+    def connection_made(self, transport) -> None:
+        self._transport = transport
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            try:  # payload frames are MB-scale: a roomy kernel buffer keeps
+                # the sender streaming instead of bouncing on EAGAIN
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_RCVBUF, _SOCK_BUF_BYTES
+                )
+            except OSError:  # pragma: no cover - kernel may clamp/refuse
+                pass
+        self._owner._server_conns.add(transport)
+
+    def connection_lost(self, exc) -> None:
+        self._owner._server_conns.discard(self._transport)
+
+    def eof_received(self) -> bool:
+        return False  # close the transport; at-most-once, nothing to recover
+
+    def get_buffer(self, sizehint: int) -> memoryview:
+        if self._body is not None:
+            return memoryview(self._body)[self._got : self._need]
+        return memoryview(self._ring)[self._rlen :]
+
+    def buffer_updated(self, nbytes: int) -> None:
+        owner = self._owner
+        if self._body is not None:  # direct mode: body lands in its buffer
+            self._got += nbytes
+            if self._got < self._need:
+                return
+            body, need = self._body, self._need
+            self._body = None
+            self._deliver(body, need, pooled=body)
+            return
+        self._rlen += nbytes
+        ring = self._ring
+        pos = 0
+        while True:
+            avail = self._rlen - pos
+            if avail < 4:
+                break
+            (length,) = _U32.unpack_from(ring, pos)
+            if length > owner.max_frame_bytes:
+                # a corrupt/hostile length prefix must not make us buffer
+                # gigabytes; drop the connection (the peer's framing is
+                # gone — nothing after this parses)
+                log.warning(
+                    "frame length %d exceeds limit %d; closing connection",
+                    length,
+                    owner.max_frame_bytes,
+                )
+                owner.dropped += 1
+                assert self._transport is not None
+                self._transport.close()
+                return
+            if length == 0:
+                owner.dropped += 1  # vacuous frame: nothing to decode
+                pos += 4
+                continue
+            if length > self._SMALL_BODY_MAX:
+                body = owner._acquire_recv_buf(length)
+                got = min(avail - 4, length)
+                body[:got] = memoryview(ring)[pos + 4 : pos + 4 + got]
+                pos += 4 + got
+                if got == length:  # whole body was already buffered
+                    self._deliver(body, length, pooled=body)
+                    continue
+                # switch to direct mode: the rest of the body is received
+                # straight into the frame buffer — by construction nothing
+                # can follow an incomplete body in the ring
+                self._body, self._need, self._got = body, length, got
+                break
+            if avail - 4 < length:
+                break  # incomplete small body: wait for more bytes
+            # small frame fully buffered: decode via a tiny copy so its
+            # decoded views can never alias the (reused) ring
+            frame = bytes(memoryview(ring)[pos + 4 : pos + 4 + length])
+            pos += 4 + length
+            self._deliver(frame, length, pooled=None)
+        if pos:  # compact the unconsumed tail to the ring's start
+            rest = self._rlen - pos
+            if rest:
+                ring[:rest] = ring[pos : self._rlen]
+            self._rlen = rest
+
+    def _deliver(self, buf, need: int, *, pooled: bytearray | None) -> None:
+        owner = self._owner
+        try:
+            t0 = time.perf_counter()
+            dest, msg = wire.decode_frame_body(memoryview(buf)[:need])
+            owner.stage_seconds["decode"] += time.perf_counter() - t0
+        except Exception as exc:  # malformed body: drop THIS frame
+            # framing is length-prefixed, so the stream stays in sync —
+            # one bad message must not kill the connection
+            log.warning("undecodable frame (%s); dropping", exc)
+            owner.dropped += 1
+            if pooled is not None:
+                owner._release_recv_buf(pooled)
+            return
+        owner._inbox.put_nowait((dest, msg, pooled))
 
 
 class RemoteTransport:
@@ -52,11 +295,13 @@ class RemoteTransport:
         self._prefix_handlers: dict[str, PrefixHandler] = {}
         self._routes: dict[str, Endpoint] = {}
         self._prefix_routes: dict[str, Callable[[int], Endpoint | None]] = {}
-        self._conns: dict[Endpoint, asyncio.StreamWriter] = {}
-        self._conn_locks: dict[Endpoint, asyncio.Lock] = {}
-        self._inbox: asyncio.Queue[tuple[str, Any]] = asyncio.Queue()
+        self._senders: dict[Endpoint, _Sender] = {}
+        self._server_conns: set = set()
+        self._inbox: asyncio.Queue[
+            tuple[str, Any, bytearray | None]
+        ] = asyncio.Queue()
         self._pump: asyncio.Task | None = None
-        self._reader_tasks: set[asyncio.Task] = set()
+        self._recv_pool: list[bytearray] = []
         self.delivered = 0
         self.dropped = 0
         self.on_send_error: Callable[[Endpoint, Envelope], None] | None = None
@@ -76,17 +321,18 @@ class RemoteTransport:
         # perf_counter calls per message per stage on >=KB-scale frames;
         # noise next to the work being measured.
         self.stage_seconds: dict[str, float] = {
-            "encode": 0.0,  # wire.encode_frame (single-copy frame build)
-            "socket_write": 0.0,  # connect + write + bounded drain
-            "decode": 0.0,  # wire.decode_frame_body (zero-copy payloads)
+            "encode": 0.0,  # wire.encode_frame_parts (+ checksum pass)
+            "socket_write": 0.0,  # connect + vectored sendmsg + coalesce flush
+            "decode": 0.0,  # wire.decode_frame_body (views into recv buffer)
             "handler": 0.0,  # engine: buffer store/reduce + replies built
         }
 
     # -- lifecycle -------------------------------------------------------------
 
     async def start(self) -> Endpoint:
-        self._server = await asyncio.start_server(
-            self._serve_connection, self._host, self._port
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(
+            lambda: _FrameReceiver(self), self._host, self._port
         )
         self._port = self._server.sockets[0].getsockname()[1]
         self._pump = asyncio.create_task(self._pump_inbox())
@@ -99,26 +345,75 @@ class RemoteTransport:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-        # cancel connection handlers BEFORE wait_closed: on Python >= 3.12 it
-        # waits for them, and they loop on readexactly until cancelled
-        for task in list(self._reader_tasks):
-            task.cancel()
-        if self._reader_tasks:
-            await asyncio.gather(*self._reader_tasks, return_exceptions=True)
+        # close accepted connections BEFORE wait_closed: on Python >= 3.12
+        # wait_closed waits for them
+        for transport in list(self._server_conns):
+            transport.close()
+        self._server_conns.clear()
         if self._server is not None:
             await self._server.wait_closed()
             self._server = None
         if self._pump is not None:
-            self._pump.cancel()
-            try:
-                await self._pump
-            except asyncio.CancelledError:
-                pass
+            # re-cancel until the task actually ends: a wait_for inside the
+            # pump's write path (connect) can eat one cancellation on
+            # Python < 3.12 when its future completes in the same tick
+            while not self._pump.done():
+                self._pump.cancel()
+                await asyncio.wait([self._pump], timeout=1.0)
             self._pump = None
-        for w in self._conns.values():
-            w.close()
-        self._conns.clear()
-        self._conn_locks.clear()
+        writers = [
+            s.writer_task
+            for s in self._senders.values()
+            if s.writer_task is not None and not s.writer_task.done()
+        ]
+        if writers:
+            # bounded courtesy drain BEFORE teardown: send() returns at
+            # enqueue time, so a goodbye frame (LeaveCluster) may still sit
+            # in a sender queue — give the writers one timeout window to
+            # flush it; a stalled peer is already bounded by their own waits
+            await asyncio.wait(writers, timeout=self.connect_timeout_s)
+        for sender in self._senders.values():
+            sender.close()
+        if writers:
+            await asyncio.gather(*writers, return_exceptions=True)
+        self._senders.clear()
+        self._recv_pool.clear()
+
+    # -- receive-buffer pool ----------------------------------------------------
+
+    # Bound on pooled buffers (count and per-buffer bytes): payload frames at
+    # the benchmark scale are ~1-4 MB, so a handful of retained buffers serve
+    # a steady stream without per-frame allocation; anything larger is given
+    # back to the allocator.
+    _recv_pool_max = 8
+    _recv_buf_max = 16 << 20
+
+    def _acquire_recv_buf(self, length: int) -> bytearray:
+        pool = self._recv_pool
+        best = -1
+        for i, b in enumerate(pool):
+            if len(b) >= length and (best < 0 or len(b) < len(pool[best])):
+                best = i
+        if best >= 0:
+            return pool.pop(best)
+        return bytearray(length)
+
+    def _release_recv_buf(self, buf: bytearray) -> None:
+        if (
+            len(self._recv_pool) >= self._recv_pool_max
+            or len(buf) > self._recv_buf_max
+        ):
+            return
+        try:
+            # a bytearray with live buffer exports refuses to resize — the
+            # exact guard we need: if any decoded view still aliases this
+            # buffer (a handler kept the payload), recycling would corrupt
+            # it, so the buffer is simply dropped instead of pooled
+            last = buf.pop()
+        except (BufferError, IndexError):
+            return
+        buf.append(last)
+        self._recv_pool.append(buf)
 
     # -- registration / routing -------------------------------------------------
 
@@ -165,7 +460,7 @@ class RemoteTransport:
         if env.via is None:
             handler = self._local_handler(env.dest)
             if handler is not None:  # local delivery: no wire, same FIFO inbox
-                await self._inbox.put((env.dest, env.msg))
+                await self._inbox.put((env.dest, env.msg, None))
                 return
         ep = env.via if env.via is not None else self._resolve(env.dest)
         if ep is None:
@@ -173,32 +468,69 @@ class RemoteTransport:
             self.dropped += 1
             return
         t0 = time.perf_counter()
-        frame = wire.encode_frame(env.dest, env.msg, f16=self.wire_f16)
+        parts = wire.encode_frame_parts(env.dest, env.msg, f16=self.wire_f16)
         self.stage_seconds["encode"] += time.perf_counter() - t0
-        # One reconnect-and-retry: a cached connection whose peer restarted
-        # fails on the first write after the restart — that staleness is this
-        # transport's problem, not the control plane's. A failure on a FRESH
-        # connection means the peer is genuinely gone: drop (at-most-once).
-        for attempt in (0, 1):
+        sender = self._senders.get(ep)
+        if sender is None or sender.closed:
+            sender = self._senders[ep] = _Sender()
+        nbytes = sum(len(p) for p in parts)
+        tail = sender.queue[-1] if sender.queue else None
+        if (
+            nbytes <= _COALESCE_MAX
+            and tail is not None
+            and tail.coalesced
+            and not tail.inflight
+            and tail.nbytes + nbytes <= _COALESCE_ENTRY_MAX
+        ):
+            # small control frame: merge into the queue's coalesce tail — a
+            # burst of heartbeats/acks becomes one segment of one sendmsg
+            tail.parts[0] += b"".join(parts)
+            tail.envs.append(env)
+            tail.nbytes += nbytes
+            frame = tail
+        elif nbytes <= _COALESCE_MAX:
+            frame = _Frame([bytearray(b"".join(parts))], [env], nbytes, True)
+            sender.queue.append(frame)
+        else:
+            # payload frame: the segments (header bytes + payload view of
+            # the engine's memory) go on the queue as-is — the vectored
+            # write is the first and only place the payload bytes move
+            frame = _Frame(parts, [env], nbytes, False)
+            sender.queue.append(frame)
+        sender.queued_bytes += nbytes
+        loop = asyncio.get_running_loop()
+        if sender.writer_task is None or sender.writer_task.done():
+            sender.writer_task = loop.create_task(
+                self._drain_sender(ep, sender)
+            )
+        if sender.queued_bytes > self.write_buffer_high_water:
+            # Bounded user-space buffering, with a DEADLINE: a dead peer
+            # empties the queue via the writer's own bounded waits, but a
+            # trickling peer (accepts a few bytes per writability window)
+            # could otherwise park the pump here indefinitely — the stalled
+            # peer must become dropped messages, never a stalled control
+            # plane. On timeout this send's frame is withdrawn (at-most-
+            # once) unless the writer already has its buffers on the wire.
+            fut = loop.create_future()
+            sender.waiters.append(fut)
+            timer = loop.call_later(
+                self.connect_timeout_s,
+                lambda: None if fut.done() else fut.set_result("timeout"),
+            )
             try:
-                await self._write(ep, frame)
-                if self.on_send_ok is not None:
-                    self.on_send_ok(ep, env)
-                return
-            except (OSError, asyncio.TimeoutError) as exc:
-                had_conn = ep in self._conns
-                writer = self._conns.pop(ep, None)
-                if writer is not None:
-                    writer.close()
-                if attempt == 1 or not had_conn:
+                timed_out = (await fut) == "timeout"
+            finally:
+                timer.cancel()
+            if timed_out and not frame.inflight:
+                try:
+                    sender.queue.remove(frame)
+                except ValueError:
+                    return  # completed/dropped while we timed out
+                sender.queued_bytes -= frame.nbytes
+                for e in frame.envs:
                     self.dropped += 1
-                    log.warning(
-                        "send to %s (%s) failed: %s", env.dest, ep, exc
-                    )
-                    self._conn_locks.pop(ep, None)
                     if self.on_send_error is not None:
-                        self.on_send_error(ep, env)
-                    return
+                        self.on_send_error(ep, e)
 
     async def send_all(self, envelopes: list[Envelope]) -> None:
         for env in envelopes:
@@ -209,103 +541,152 @@ class RemoteTransport:
     # (dominated by max_chunk_size floats; 256 MB = a 64M-float chunk).
     max_frame_bytes = 256 << 20
 
-    # Back-pressure point: drain (bounded) only once this much is buffered.
-    # Draining every frame costs a timer + task round-trip through the event
-    # loop per message; letting the OS buffer absorb bursts nearly doubles
-    # small-chunk message rate while still bounding memory at an
-    # unresponsive peer (the drain timeout turns a stalled peer into
-    # dropped messages, not a stalled control plane).
+    # Back-pressure point: a send whose endpoint has more than this many
+    # bytes queued-but-unsent waits for the writer to drain below it, so a
+    # slow peer bounds memory instead of growing the queue forever.
     write_buffer_high_water = 1 << 20
 
-    async def _write(self, ep: Endpoint, frame: bytes) -> None:
-        # Bounded connect/drain: sends run inline in the pump consumer, so an
-        # unresponsive peer (SYN blackhole) must not stall the whole control
-        # plane for the kernel's TCP timeout — it becomes a dropped message.
-        lock = self._conn_locks.setdefault(ep, asyncio.Lock())
-        async with lock:  # serialize connect + write per peer
-            # stage timing starts INSIDE the lock (a sender parked on the
-            # lock must not double-count its peer's interval) and accrues
-            # through try/finally so failed connects/drains — the stalls
-            # this accounting exists to expose — are attributed here, not
-            # to "event-loop wait"
-            t0 = time.perf_counter()
+    # Cap on frames/bytes folded into one sendmsg batch: bounds both the
+    # iovec count and how much a single syscall can monopolize the writer.
+    _batch_max_frames = 16
+    _batch_max_bytes = 8 << 20
+
+    async def _connect_sender(self, ep: Endpoint, sender: _Sender) -> None:
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            await asyncio.wait_for(
+                loop.sock_connect(sock, (ep.host, ep.port)),
+                self.connect_timeout_s,
+            )
+        except BaseException:
+            sock.close()
+            raise
+        # control frames: latency-sensitive (vectored writes already emit
+        # whole frames, so Nagle only adds latency here)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF_BYTES
+            )
+        except OSError:  # pragma: no cover - kernel may clamp/refuse
+            pass
+        sender.sock = sock
+
+    async def _sendmsg(self, sock: socket.socket, views: list[memoryview]) -> None:
+        """Vectored write of ``views``, bounded: a peer that stops reading
+        turns into dropped messages (TimeoutError via the writability
+        wait), never a stalled control plane."""
+        loop = asyncio.get_running_loop()
+        while views:
             try:
-                writer = self._conns.get(ep)
-                if writer is None or writer.is_closing():
-                    _, writer = await asyncio.wait_for(
-                        asyncio.open_connection(ep.host, ep.port),
-                        self.connect_timeout_s,
+                n = sock.sendmsg(views)
+            except (BlockingIOError, InterruptedError):
+                n = 0
+            if n:
+                while n:
+                    head = views[0]
+                    if n >= len(head):
+                        n -= len(head)
+                        views.pop(0)
+                    else:
+                        views[0] = head[n:]
+                        n = 0
+                if not views:
+                    return
+            await _wait_writable(loop, sock, self.connect_timeout_s)
+
+    def _fail_sender(self, ep: Endpoint, sender: _Sender, exc: BaseException) -> None:
+        """At-most-once: everything queued for the dead endpoint drops, with
+        the error callback fired per envelope (consecutive-failure counting
+        at the control plane relies on per-send callbacks).
+
+        This fires only after the writer's full escalation — a bounded send
+        on the existing connection, then a reconnect AND a bounded resend —
+        has failed, so a burst of callbacks here means the peer was
+        unresponsive across two connection lifetimes (>= 2x
+        connect_timeout_s), not one transient stall; a briefly-slow peer is
+        absorbed by the retry and the kernel buffer."""
+        log.warning("send to %s failed: %s", ep, exc)
+        frames = list(sender.queue)
+        sender.queue.clear()
+        sender.queued_bytes = 0
+        sender.close_sock()
+        sender.wake_waiters()
+        for frame in frames:
+            for env in frame.envs:
+                self.dropped += 1
+                if self.on_send_error is not None:
+                    self.on_send_error(ep, env)
+
+    async def _drain_sender(self, ep: Endpoint, sender: _Sender) -> None:
+        """The endpoint's single writer: drains whole frames, in order, in
+        multi-frame vectored batches; reconnects once per failure burst."""
+        try:
+            while sender.queue and not sender.closed:
+                t0 = time.perf_counter()
+                try:
+                    if sender.sock is None:
+                        try:
+                            await self._connect_sender(ep, sender)
+                        except (OSError, asyncio.TimeoutError) as exc:
+                            self._fail_sender(ep, sender, exc)
+                            return
+                    batch: list[_Frame] = []
+                    views: list[memoryview] = []
+                    batch_bytes = 0
+                    for frame in sender.queue:
+                        frame.inflight = True
+                        batch.append(frame)
+                        views.extend(_byte_views(frame.parts))
+                        batch_bytes += frame.nbytes
+                        if (
+                            len(batch) >= self._batch_max_frames
+                            or batch_bytes >= self._batch_max_bytes
+                        ):
+                            break
+                    try:
+                        await self._sendmsg(sender.sock, views)
+                    except (OSError, asyncio.TimeoutError) as exc:
+                        # frames stay queued: a retry resends them whole on a
+                        # fresh connection (the peer discards the partial
+                        # frame with the broken stream). Read the retry
+                        # permission BEFORE close_sock resets it.
+                        can_retry = sender.retry_ok
+                        sender.close_sock()
+                        if can_retry:
+                            continue  # one reconnect-retry per burst
+                        self._fail_sender(ep, sender, exc)
+                        return
+                finally:
+                    self.stage_seconds["socket_write"] += (
+                        time.perf_counter() - t0
                     )
-                    sock = writer.get_extra_info("socket")
-                    if sock is not None:  # control frames: latency-sensitive
-                        sock.setsockopt(
-                            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
-                        )
-                    self._conns[ep] = writer
-                writer.write(frame)
-                if (
-                    writer.transport.get_write_buffer_size()
-                    > self.write_buffer_high_water
-                ):
-                    await asyncio.wait_for(
-                        writer.drain(), self.connect_timeout_s
-                    )
-            finally:
-                self.stage_seconds["socket_write"] += (
-                    time.perf_counter() - t0
-                )
+                sender.retry_ok = True
+                for frame in batch:
+                    sender.queue.popleft()
+                    sender.queued_bytes -= frame.nbytes
+                    if self.on_send_ok is not None:
+                        for env in frame.envs:
+                            self.on_send_ok(ep, env)
+                if sender.queued_bytes <= self.write_buffer_high_water:
+                    sender.wake_waiters()
+        finally:
+            sender.wake_waiters()
 
     # -- receiving ----------------------------------------------------------------
-
-    async def _serve_connection(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
-        task = asyncio.current_task()
-        assert task is not None
-        self._reader_tasks.add(task)
-        try:
-            while True:
-                header = await reader.readexactly(4)
-                (length,) = _U32.unpack(header)
-                if length > self.max_frame_bytes:
-                    # a corrupt/hostile length prefix must not make us
-                    # buffer gigabytes; drop the connection (the peer's
-                    # framing is gone — nothing after this parses)
-                    log.warning(
-                        "frame length %d exceeds limit %d; closing connection",
-                        length,
-                        self.max_frame_bytes,
-                    )
-                    self.dropped += 1
-                    break
-                body = await reader.readexactly(length)
-                try:
-                    t0 = time.perf_counter()
-                    dest, msg = wire.decode_frame_body(body)
-                    self.stage_seconds["decode"] += time.perf_counter() - t0
-                except Exception as exc:  # malformed body: drop THIS frame
-                    # framing is length-prefixed, so the stream stays in
-                    # sync — one bad message must not kill the connection
-                    log.warning("undecodable frame (%s); dropping", exc)
-                    self.dropped += 1
-                    continue
-                await self._inbox.put((dest, msg))
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass  # peer closed; at-most-once semantics, nothing to recover
-        except asyncio.CancelledError:
-            pass
-        finally:
-            self._reader_tasks.discard(task)
-            writer.close()
 
     async def _pump_inbox(self) -> None:
         """Single consumer: every handler runs one message at a time."""
         while True:
-            dest, msg = await self._inbox.get()
+            dest, msg, buf = await self._inbox.get()
             handler = self._local_handler(dest)
             if handler is None:
                 log.warning("no handler for %s; dropping", dest)
                 self.dropped += 1
+                if buf is not None:
+                    self._release_recv_buf(buf)
                 continue
             try:
                 t0 = time.perf_counter()
@@ -313,9 +694,18 @@ class RemoteTransport:
                 self.stage_seconds["handler"] += time.perf_counter() - t0
             except Exception:
                 log.exception("handler for %s failed on %s", dest, type(msg).__name__)
+                msg = None
+                if buf is not None:
+                    self._release_recv_buf(buf)
                 continue
             self.delivered += 1
+            # drop our reference to the decoded payload views BEFORE
+            # recycling; the export check in _release_recv_buf protects
+            # against anything the handler (or the replies) retained
+            msg = None
             await self.send_all(out)
+            if buf is not None:
+                self._release_recv_buf(buf)
 
     async def drain(self, timeout: float = 5.0) -> None:
         """Wait until the local inbox is empty (test convenience)."""
@@ -324,6 +714,37 @@ class RemoteTransport:
             if asyncio.get_event_loop().time() > deadline:
                 raise TimeoutError("transport did not drain")
             await asyncio.sleep(0.01)
+
+
+async def _wait_writable(
+    loop: asyncio.AbstractEventLoop, sock: socket.socket, timeout: float
+) -> None:
+    """Wait until ``sock`` accepts more bytes (writev drained), raising
+    ``asyncio.TimeoutError`` after ``timeout``.
+
+    Deliberately NOT ``asyncio.wait_for``: this wait sits under every frame
+    write, and on Python < 3.12 ``wait_for`` can swallow an external task
+    cancellation that races the future's completion — a cancelled pump that
+    keeps running turns ``transport.stop()`` into a deadlock. A plain
+    ``await fut`` with a manual timer propagates cancellation verbatim."""
+    fut = loop.create_future()
+    fd = sock.fileno()
+
+    def ready() -> None:
+        if not fut.done():
+            fut.set_result(None)
+
+    def timed_out() -> None:
+        if not fut.done():
+            fut.set_exception(asyncio.TimeoutError("socket write stalled"))
+
+    loop.add_writer(fd, ready)
+    timer = loop.call_later(timeout, timed_out)
+    try:
+        await fut
+    finally:
+        timer.cancel()
+        loop.remove_writer(fd)
 
 
 async def run_periodic(

@@ -3,10 +3,16 @@
 The reference configures Akka serializers for its ``Array[Float]``-carrying
 actor messages (SURVEY.md §2 L0 "serializer config for Array[Float] messages").
 This is the same layer, purpose-built: each message encodes to
-``[u8 tag][fixed struct fields][raw little-endian float32 payload]`` and a
-framed envelope is ``[u32 frame_len][u16 dest_len][dest utf8][encoded msg]``.
-No pickle — the format is versioned by tag, language-neutral, and float
-payloads are zero-copy views on decode (``np.frombuffer``).
+``[u8 tag][fixed struct fields][u32 count][u32 checksum][raw little-endian
+float payload]`` and a framed envelope is ``[u32 frame_len][u16 dest_len]
+[dest utf8][encoded msg]``. No pickle — the format is versioned by tag,
+language-neutral, and float payloads are zero-copy BOTH ways:
+``encode_frame_parts`` returns scatter-gather segments whose payload segment
+is a ``memoryview`` of the caller's array (the transport hands the segments
+to ``sendmsg`` — no concatenation copy ever happens), and decode yields
+``np.frombuffer`` views into the receive buffer. Payload frames carry an
+additive byte checksum, computed/verified in the native wire hot loop
+(``native/wire.cpp``) when built, with an exact struct/numpy fallback.
 """
 
 from __future__ import annotations
@@ -19,6 +25,7 @@ import numpy as np
 
 _log = logging.getLogger(__name__)
 
+from akka_allreduce_tpu import native
 from akka_allreduce_tpu.control import cluster as cl
 from akka_allreduce_tpu.protocol import (
     CompleteAllreduce,
@@ -95,10 +102,11 @@ def _note_clipped(n: int) -> None:
         )
 
 
-def _pack_floats(value: np.ndarray, f16: bool = False) -> tuple[bytes, memoryview]:
-    """(length prefix, payload view) — the view is copied exactly once, by the
-    final frame join, instead of once per concatenation level. ``f16`` casts
-    the payload to float16 for the wire, SATURATING at ±65504: a silent cast
+def _pack_floats(value: np.ndarray, f16: bool = False) -> tuple[memoryview, int]:
+    """(payload byte view, count word) — the view aliases the caller's array
+    (or the one f16 cast), so the send path never copies the payload; the
+    transport's vectored write is the only consumer. ``f16`` casts the
+    payload to float16 for the wire, SATURATING at ±65504: a silent cast
     would turn out-of-range elements into inf and poison every downstream
     f32 accumulation (unlike bf16, float16 trades range for mantissa).
     Saturation is counted and warned once (``f16_clip_count``)."""
@@ -108,21 +116,26 @@ def _pack_floats(value: np.ndarray, f16: bool = False) -> tuple[bytes, memoryvie
         if clipped:
             _note_clipped(clipped)
         arr = np.clip(arr32, -_F16_MAX, _F16_MAX).astype("<f2")
-        return _U32.pack(arr.size | _F16_FLAG), memoryview(arr).cast("B")
+        return memoryview(arr).cast("B"), arr.size | _F16_FLAG
     arr = np.ascontiguousarray(value, dtype="<f4")
-    return _U32.pack(arr.size), memoryview(arr).cast("B")
+    return memoryview(arr).cast("B"), arr.size
 
 
-def _unpack_floats(buf: memoryview, off: int) -> tuple[np.ndarray, int]:
-    (n,) = _U32.unpack_from(buf, off)
-    off += 4
-    if n & _F16_FLAG:
-        n &= ~_F16_FLAG
-        half = np.frombuffer(buf, dtype="<f2", count=n, offset=off)
-        # engine sees f32 only; the astype is the decompression copy
-        return half.astype(np.float32), off + 2 * n
-    arr = np.frombuffer(buf, dtype="<f4", count=n, offset=off)
-    return arr, off + 4 * n
+def _decode_block(buf: memoryview):
+    """Payload-frame body -> (value view, src, dest, chunk, round, count).
+
+    One native call parses the header AND verifies the payload checksum
+    (``native.unpack_block``); the returned array is a zero-copy
+    ``np.frombuffer`` view into ``buf`` (f16 payloads decompress — the
+    astype is the one necessary copy)."""
+    src, dest, chunk, rnd, count, n, is_f16, off = native.unpack_block(buf)
+    if is_f16:
+        value = np.frombuffer(buf, dtype="<f2", count=n, offset=off).astype(
+            np.float32
+        )
+    else:
+        value = np.frombuffer(buf, dtype="<f4", count=n, offset=off)
+    return value, src, dest, chunk, rnd, count
 
 
 def encode(msg: Any, *, f16: bool = False) -> bytes:
@@ -143,30 +156,19 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
     if tag == 1:
         return [head, struct.pack("<q", msg.round_num)]
     if tag == 2:
-        n, payload = _pack_floats(msg.value, f16)
-        return [
-            head,
-            struct.pack(
-                "<iiiq", msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num
-            ),
-            n,
-            payload,
-        ]
+        payload, count_word = _pack_floats(msg.value, f16)
+        head = native.pack_block_header(
+            2, msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num, 0,
+            payload, count_word,
+        )
+        return [head, payload]
     if tag == 3:
-        n, payload = _pack_floats(msg.value, f16)
-        return [
-            head,
-            struct.pack(
-                "<iiiqi",
-                msg.src_id,
-                msg.dest_id,
-                msg.chunk_id,
-                msg.round_num,
-                msg.count,
-            ),
-            n,
-            payload,
-        ]
+        payload, count_word = _pack_floats(msg.value, f16)
+        head = native.pack_block_header(
+            3, msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num,
+            msg.count, payload, count_word,
+        )
+        return [head, payload]
     if tag == 4:
         return [head, struct.pack("<iq", msg.src_id, msg.round_num)]
     if tag == 5:
@@ -222,12 +224,10 @@ def decode(data: bytes | memoryview) -> Any:
     if tag == 1:
         return StartAllreduce(*struct.unpack_from("<q", buf, off))
     if tag == 2:
-        src, dest, chunk, rnd = struct.unpack_from("<iiiq", buf, off)
-        value, _ = _unpack_floats(buf, off + 20)
+        value, src, dest, chunk, rnd, _ = _decode_block(buf)
         return ScatterBlock(value, src, dest, chunk, rnd)
     if tag == 3:
-        src, dest, chunk, rnd, count = struct.unpack_from("<iiiqi", buf, off)
-        value, _ = _unpack_floats(buf, off + 24)
+        value, src, dest, chunk, rnd, count = _decode_block(buf)
         return ReduceBlock(value, src, dest, chunk, rnd, count)
     if tag == 4:
         return CompleteAllreduce(*struct.unpack_from("<iq", buf, off))
@@ -274,17 +274,29 @@ def decode(data: bytes | memoryview) -> Any:
     raise ValueError(f"unknown wire tag {tag}")
 
 
-def encode_frame(dest: str, msg: Any, *, f16: bool = False) -> bytes:
-    """Framed envelope: ``[u32 len][u16 dest_len][dest][tag][body]``.
+def encode_frame_parts(
+    dest: str, msg: Any, *, f16: bool = False
+) -> list[bytes | memoryview]:
+    """Framed envelope as scatter-gather segments:
+    ``[u32 len][u16 dest_len][dest][tag][body...]``.
 
-    Built with a single ``join`` over header + payload segments — the float
-    payload is copied exactly once, here, on its way to the socket. ``f16``
-    sends float payloads at half width (decode side is automatic).
-    """
-    parts = [b"", _pack_str(dest), *_encode_parts(msg, f16)]
+    The float payload stays a ``memoryview`` of the caller's array — NO
+    payload-sized copy happens here or anywhere on the send path: the
+    transport passes the segments straight to ``socket.sendmsg`` (writev),
+    so the kernel gathers them. The payload memory must stay unmodified
+    until the send completes (the engine's frozen-after-reduce buffers and
+    snapshot-publishing sources guarantee this). ``f16`` sends float
+    payloads at half width (decode side is automatic)."""
+    parts: list[Any] = [b"", _pack_str(dest), *_encode_parts(msg, f16)]
     body_len = sum(len(p) for p in parts)
     parts[0] = _U32.pack(body_len)
-    return b"".join(parts)
+    return parts
+
+
+def encode_frame(dest: str, msg: Any, *, f16: bool = False) -> bytes:
+    """``encode_frame_parts`` joined to one buffer (compat / tests — the
+    transport itself sends the segments unjoined)."""
+    return b"".join(encode_frame_parts(dest, msg, f16=f16))
 
 
 def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:

@@ -181,10 +181,12 @@ class AllreduceWorker:
         # Partition my input into one block per peer, chunk each block; only
         # chunks running past data_size materialize a zero-padded tail (peers
         # trim the padding on flush). With ``zero_copy_scatter`` the chunks
-        # are views of the source's array (receivers only accumulate from
-        # scatter payloads, and frames are encoded from live memory later —
-        # sound only for snapshot-publishing sources, see WorkerConfig);
-        # otherwise each chunk is snapshotted here, synchronously.
+        # are views of the source's array all the way to the socket: the
+        # transport's vectored write (sendmsg of [header, payload view])
+        # reads the chunk's LIVE memory at write time, with no copy at any
+        # layer — sound only for snapshot-publishing sources, see
+        # WorkerConfig. Otherwise each chunk is snapshotted here,
+        # synchronously, and the snapshot is what the socket reads.
         data = np.ascontiguousarray(data, dtype=np.float32)
         zero_copy = self.config.zero_copy_scatter
         my_id = self.worker_id

@@ -186,21 +186,29 @@ class FileDataset:
 
     def batches(self, batch_size: int, steps: int, *, seed_offset: int = 1):
         """Yield ``steps`` minibatches, shuffling on every pass through
-        the data (sampling without replacement within a pass)."""
-        rng = np.random.default_rng(self._seed + seed_offset)
-        order = rng.permutation(self.n)
-        at = 0
-        for _ in range(steps):
-            if at + batch_size > self.n:
-                order = rng.permutation(self.n)
-                at = 0
-            if batch_size > self.n:
-                raise ValueError(
-                    f"batch {batch_size} exceeds dataset rows {self.n}"
-                )
-            idx = order[at : at + batch_size]
-            at += batch_size
-            yield self.x[idx], self.y[idx]
+        the data (sampling without replacement within a pass).
+
+        Misuse fails EAGERLY at call time — a plain generator would defer
+        the check to the first ``next()``, and a ``steps=0`` call would
+        never validate at all (ADVICE r5)."""
+        if batch_size > self.n:
+            raise ValueError(
+                f"batch {batch_size} exceeds dataset rows {self.n}"
+            )
+
+        def gen():
+            rng = np.random.default_rng(self._seed + seed_offset)
+            order = rng.permutation(self.n)
+            at = 0
+            for _ in range(steps):
+                if at + batch_size > self.n:
+                    order = rng.permutation(self.n)
+                    at = 0
+                idx = order[at : at + batch_size]
+                at += batch_size
+                yield self.x[idx], self.y[idx]
+
+        return gen()
 
     def device_sampler(self):
         """Traced ``(key, batch_size) -> (x, y)`` sampling rows (with

@@ -15,6 +15,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import struct
 import subprocess
 import threading
 
@@ -23,9 +24,15 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "_threshold_reduce.so")
-_SRC_PATH = os.path.join(os.path.dirname(__file__), "threshold_reduce.cpp")
+# threshold_reduce.cpp: reduction kernels; wire.cpp: payload-frame codec hot
+# loop (header pack/unpack + checksum) — one .so, one loader, one ABI.
+_SRC_PATHS = [
+    os.path.join(os.path.dirname(__file__), "threshold_reduce.cpp"),
+    os.path.join(os.path.dirname(__file__), "wire.cpp"),
+]
+_SRC_PATH = _SRC_PATHS[0]  # sentinel the build/test machinery stats
 
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lib = None
 _lock = threading.Lock()
@@ -35,6 +42,7 @@ _load_failed = False
 _f32p = ctypes.POINTER(ctypes.c_float)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64p = ctypes.POINTER(ctypes.c_int64)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
 # Canonical compile flags — native/Makefile shims to build() below, so this is
@@ -45,6 +53,10 @@ _CXXFLAGS = ["-O3", "-fPIC", "-shared", "-fopenmp", "-Wall", "-std=c++17"]
 # already optimal single-threaded; OpenMP only wins with work to spread).
 _ACCUM_NATIVE_MIN = 16384
 
+# wire codec routes payloads smaller than this (bytes) to struct/numpy — a
+# ctypes call costs ~1us of marshalling, so tiny frames are faster in Python.
+_WIRE_NATIVE_MIN = 16384
+
 
 def _try_build() -> bool:
     if not os.path.exists(_SRC_PATH):
@@ -54,7 +66,8 @@ def _try_build() -> bool:
     # may compile concurrently, and os.replace is atomic on POSIX — nobody
     # ever CDLLs a half-written file.
     tmp = f"{_SO_PATH}.tmp.{os.getpid()}.{threading.get_ident()}"
-    cmd = [os.environ.get("CXX", "g++"), *_CXXFLAGS, _SRC_PATH, "-o", tmp]
+    srcs = [p for p in _SRC_PATHS if os.path.exists(p)]
+    cmd = [os.environ.get("CXX", "g++"), *_CXXFLAGS, *srcs, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO_PATH)
@@ -97,6 +110,15 @@ def _bind(lib) -> None:
     lib.ar_expand_counts.argtypes = [
         _i32p, _i64p, ctypes.c_int64, _i32p, ctypes.c_int64,
     ]
+    lib.aw_checksum.argtypes = [_u8p, ctypes.c_int64]
+    lib.aw_checksum.restype = ctypes.c_uint32
+    lib.aw_pack_block.argtypes = [
+        _u8p, ctypes.c_int, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int32, _u8p, ctypes.c_int64, ctypes.c_uint32,
+    ]
+    lib.aw_pack_block.restype = ctypes.c_int
+    lib.aw_unpack_block.argtypes = [_u8p, ctypes.c_int64, _i64p]
+    lib.aw_unpack_block.restype = ctypes.c_int64
 
 
 def _load(*, build_wait: bool = False, _retried: bool = False):
@@ -175,6 +197,14 @@ def _load(*, build_wait: bool = False, _retried: bool = False):
 
 def available() -> bool:
     return _load(build_wait=True) is not None
+
+
+def loaded() -> bool:
+    """True iff the native library is loaded RIGHT NOW — never builds,
+    never blocks. This is the provenance query: ``available()`` may spend
+    ~2 min compiling and then truthfully answer "yes" about a library the
+    measurement it labels never used."""
+    return _lib is not None
 
 
 def build() -> bool:
@@ -286,3 +316,131 @@ def expand_counts(
         n_out,
     )
     return out
+
+
+# -- wire codec hot loop (control/wire.py payload frames) ----------------------
+#
+# Frame body layout (tag 2 = ScatterBlock <iiiq>, tag 3 = ReduceBlock <iiiqi>):
+#   [tag u8][fields][count_word u32][checksum u32][payload bytes]
+# The count word's top bit flags float16 payloads (wire._F16_FLAG); the
+# checksum is the additive sum of the payload's LE u32 words mod 2^32 (tail
+# zero-padded). These wrappers
+# collapse the per-frame work to ONE native call each way when the payload is
+# large enough to amortize the ctypes marshalling, with an exact struct/numpy
+# fallback otherwise — same bytes either path.
+
+_F16_FLAG = 0x8000_0000  # keep in sync with control/wire.py and wire.cpp
+_PACK_SCATTER = struct.Struct("<BiiiqII")
+_PACK_REDUCE = struct.Struct("<BiiiqiII")
+
+
+def _u8(mv: memoryview) -> np.ndarray:
+    return np.frombuffer(mv, dtype=np.uint8)
+
+
+def _byte_view(buf) -> memoryview:
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    return mv if mv.format == "B" and mv.contiguous else mv.cast("B")
+
+
+def wire_checksum(buf) -> int:
+    """Additive sum of little-endian u32 words mod 2^32 (tail zero-padded)
+    of ``buf`` — native when it pays off, numpy otherwise, same value."""
+    mv = _byte_view(buf)
+    n = mv.nbytes
+    if n == 0:
+        return 0
+    if n >= _WIRE_NATIVE_MIN and (lib := _load()) is not None:
+        return int(lib.aw_checksum(_u8(mv).ctypes.data_as(_u8p), n))
+    n4 = n & ~3
+    s = (
+        int(np.add.reduce(np.frombuffer(mv[:n4], "<u4"), dtype=np.uint32))
+        if n4
+        else 0
+    )
+    if n4 < n:
+        s = (s + int.from_bytes(bytes(mv[n4:n]), "little")) & 0xFFFF_FFFF
+    return s
+
+
+def pack_block_header(
+    tag: int,
+    src_id: int,
+    dest_id: int,
+    chunk_id: int,
+    round_num: int,
+    count: int,
+    payload,
+    count_word: int,
+) -> bytes:
+    """``[tag][fields][count_word][checksum]`` for a payload frame — the
+    checksum pass over ``payload`` and the header pack are one native call."""
+    mv = _byte_view(payload)
+    n = mv.nbytes
+    if n >= _WIRE_NATIVE_MIN and (lib := _load()) is not None:
+        out = (ctypes.c_uint8 * 40)()
+        ln = lib.aw_pack_block(
+            out, tag, src_id, dest_id, chunk_id, round_num, count,
+            _u8(mv).ctypes.data_as(_u8p), n, count_word,
+        )
+        if ln > 0:
+            return bytes(out[:ln])
+    ck = wire_checksum(mv)
+    if tag == 2:
+        return _PACK_SCATTER.pack(
+            2, src_id, dest_id, chunk_id, round_num, count_word, ck
+        )
+    if tag == 3:
+        return _PACK_REDUCE.pack(
+            3, src_id, dest_id, chunk_id, round_num, count, count_word, ck
+        )
+    raise ValueError(f"not a payload frame tag: {tag}")
+
+
+def unpack_block(body) -> tuple[int, int, int, int, int, int, bool, int]:
+    """Parse + checksum-verify a payload frame body (starting at the tag).
+
+    Returns ``(src_id, dest_id, chunk_id, round_num, count, n_elems, is_f16,
+    payload_offset)``; raises ``ValueError`` on truncation / checksum
+    mismatch / non-payload tag. The caller slices the payload out of ``body``
+    at the returned offset — no copy happens here.
+    """
+    mv = _byte_view(body)
+    n = mv.nbytes
+    if n >= _WIRE_NATIVE_MIN and (lib := _load()) is not None:
+        out = (ctypes.c_int64 * 7)()
+        off = int(
+            lib.aw_unpack_block(_u8(mv).ctypes.data_as(_u8p), n, out)
+        )
+        if off == -2:
+            raise ValueError("payload checksum mismatch")
+        if off < 0:
+            raise ValueError(f"malformed payload frame (code {off})")
+        return (
+            int(out[0]), int(out[1]), int(out[2]), int(out[3]), int(out[4]),
+            int(out[5]), bool(out[6]), off,
+        )
+    if n < 1:
+        raise ValueError("empty payload frame")
+    tag = mv[0]
+    try:
+        if tag == 2:
+            src, dest, chunk, rnd = struct.unpack_from("<iiiq", mv, 1)
+            count, off = 0, 21
+        elif tag == 3:
+            src, dest, chunk, rnd, count = struct.unpack_from("<iiiqi", mv, 1)
+            off = 25
+        else:
+            raise ValueError(f"not a payload frame tag: {tag}")
+        count_word, ck = struct.unpack_from("<II", mv, off)
+    except struct.error as exc:  # same contract as the native path: ValueError
+        raise ValueError(f"truncated payload frame header ({exc})") from exc
+    off += 8
+    n_elems = count_word & ~_F16_FLAG
+    is_f16 = bool(count_word & _F16_FLAG)
+    nbytes = n_elems * (2 if is_f16 else 4)
+    if off + nbytes > n:
+        raise ValueError("truncated payload")
+    if wire_checksum(mv[off : off + nbytes]) != ck:
+        raise ValueError("payload checksum mismatch")
+    return (src, dest, chunk, rnd, count, n_elems, is_f16, off)

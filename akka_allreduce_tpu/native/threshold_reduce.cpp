@@ -63,6 +63,7 @@ void ar_expand_counts(const int32_t* chunk_counts, const int64_t* lengths,
   }
 }
 
-int ar_abi_version() { return 2; }
+// v3: wire.cpp (payload-frame pack/unpack + checksum) joined the library.
+int ar_abi_version() { return 3; }
 
 }  // extern "C"

@@ -1,0 +1,129 @@
+// Host data-plane wire hot loop: payload-frame header pack/unpack + checksum.
+//
+// The Python codec (control/wire.py) frames float payloads as
+//   [tag u8][fields][count_word u32][checksum u32][payload bytes]
+// where tag 2 = ScatterBlock (fields <iiiq>), tag 3 = ReduceBlock (<iiiqi>),
+// the count word's top bit flags a float16 payload, and the checksum is the
+// additive sum of the payload's LE u32 words mod 2^32, tail zero-padded
+// (matches native.wire_checksum's numpy fallback exactly).
+//
+// These two entry points collapse the per-frame Python work — struct packs,
+// bounds checks, and the full-payload checksum pass — into ONE ctypes call
+// each way, so the per-byte cost of a payload frame is a single vectorized
+// read (the checksum) with no intermediate allocation. Byte order is written
+// explicitly little-endian so the wire format is host-independent.
+//
+// Compiled into the same .so as threshold_reduce.cpp (one loader, one ABI).
+
+#include <cstdint>
+
+namespace {
+
+inline void put_le32(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)(v);
+  p[1] = (uint8_t)(v >> 8);
+  p[2] = (uint8_t)(v >> 16);
+  p[3] = (uint8_t)(v >> 24);
+}
+
+inline void put_le64(uint8_t* p, uint64_t v) {
+  put_le32(p, (uint32_t)v);
+  put_le32(p + 4, (uint32_t)(v >> 32));
+}
+
+inline uint32_t get_le32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+inline uint64_t get_le64(const uint8_t* p) {
+  return (uint64_t)get_le32(p) | ((uint64_t)get_le32(p + 4) << 32);
+}
+
+constexpr uint32_t kF16Flag = 0x80000000u;  // wire.py _F16_FLAG
+
+}  // namespace
+
+extern "C" {
+
+// Additive payload checksum: sum of little-endian u32 words mod 2^32, the
+// tail (payloads are always a multiple of 2 bytes) zero-padded. A word sum
+// vectorizes to memory speed — one read pass, ~8x cheaper than the memcpy
+// it replaces on the old join/readexactly path — and catches the framing
+// corruptions the transport actually sees (truncation, garbage bodies).
+uint32_t aw_checksum(const uint8_t* data, int64_t n) {
+  int64_t n4 = n >> 2;
+  uint64_t s = 0;
+#pragma omp parallel for schedule(static) reduction(+ : s) if (n4 > 262144)
+  for (int64_t i = 0; i < n4; ++i) {
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+    // memcpy load: one unaligned mov per word — decode checksums run at
+    // payload offsets the dest-string length makes misaligned, where the
+    // byte-shift form costs ~5x
+    uint32_t w;
+    __builtin_memcpy(&w, data + 4 * i, 4);
+    s += w;
+#else
+    s += get_le32(data + 4 * i);
+#endif
+  }
+  uint32_t tail = 0;
+  for (int64_t i = n4 * 4, shift = 0; i < n; ++i, shift += 8)
+    tail |= (uint32_t)data[i] << shift;
+  return (uint32_t)(s + tail);
+}
+
+// Pack [tag][fields][count_word][checksum] for a payload frame and compute
+// the checksum of `payload` in the same call. Returns the header length
+// written into `out` (caller provides >= 34 bytes), or -1 on unknown tag.
+int aw_pack_block(uint8_t* out, int tag, int32_t src_id, int32_t dest_id,
+                  int32_t chunk_id, int64_t round_num, int32_t count,
+                  const uint8_t* payload, int64_t payload_bytes,
+                  uint32_t count_word) {
+  if (tag != 2 && tag != 3) return -1;
+  uint8_t* p = out;
+  *p++ = (uint8_t)tag;
+  put_le32(p, (uint32_t)src_id);
+  put_le32(p + 4, (uint32_t)dest_id);
+  put_le32(p + 8, (uint32_t)chunk_id);
+  put_le64(p + 12, (uint64_t)round_num);
+  p += 20;
+  if (tag == 3) {
+    put_le32(p, (uint32_t)count);
+    p += 4;
+  }
+  put_le32(p, count_word);
+  put_le32(p + 4, aw_checksum(payload, payload_bytes));
+  p += 8;
+  return (int)(p - out);
+}
+
+// Parse + verify a payload frame body starting at the tag byte. Fills
+// out[0..6] = {src_id, dest_id, chunk_id, round_num, count, n_elems, is_f16}
+// and returns the payload byte offset, or -1 (truncated) / -2 (checksum
+// mismatch) / -3 (not a payload tag).
+int64_t aw_unpack_block(const uint8_t* body, int64_t nbytes, int64_t* out) {
+  if (nbytes < 1) return -1;
+  int tag = body[0];
+  if (tag != 2 && tag != 3) return -3;
+  int64_t off = 1 + 20 + (tag == 3 ? 4 : 0) + 8;  // fields + count word + checksum
+  if (nbytes < off) return -1;
+  const uint8_t* p = body + 1;
+  out[0] = (int32_t)get_le32(p);
+  out[1] = (int32_t)get_le32(p + 4);
+  out[2] = (int32_t)get_le32(p + 8);
+  out[3] = (int64_t)get_le64(p + 12);
+  out[4] = tag == 3 ? (int32_t)get_le32(p + 20) : 0;
+  uint32_t count_word = get_le32(body + off - 8);
+  uint32_t checksum = get_le32(body + off - 4);
+  int is_f16 = (count_word & kF16Flag) != 0;
+  int64_t n_elems = (int64_t)(count_word & ~kF16Flag);
+  out[5] = n_elems;
+  out[6] = is_f16;
+  int64_t payload_bytes = n_elems * (is_f16 ? 2 : 4);
+  if (payload_bytes > nbytes - off) return -1;
+  if (aw_checksum(body + off, payload_bytes) != checksum) return -2;
+  return off;
+}
+
+}  // extern "C"

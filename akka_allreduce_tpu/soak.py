@@ -37,7 +37,12 @@ class SoakReport:
     remesh_events: list  # [{step, kind, seconds, n_devices}]
     restore: dict | None  # {at_step, restored_step, seconds}
     checkpoint_saves: int
+    # a skip because a background save is still in flight (real contention —
+    # the stall signal) vs a skip because the step is already durable (the
+    # post-restore rewind makes save() a dedup no-op; ADVICE r5 said the old
+    # single counter conflated the two and inflated the stall metric)
     checkpoint_skipped_busy: int
+    checkpoint_skipped_dedup: int
     max_capture_stall_s: float
     generation: int
 
@@ -146,7 +151,8 @@ def run_soak(
     remesh_events: list[dict] = []
     restore_rec: dict | None = None
     saves = 0
-    skipped = 0
+    skipped_busy = 0
+    skipped_dedup = 0
     max_capture = 0.0
     compile_steps: set[int] = {0}  # steps whose time includes an XLA compile
     t_start = time.perf_counter()
@@ -218,14 +224,21 @@ def run_soak(
             )
 
         if checkpoint_every and step and step % checkpoint_every == 0:
-            t0 = time.perf_counter()
-            launched = ckpt.save(elastic.trainer)
-            cap = time.perf_counter() - t0
-            if launched:
-                saves += 1
-                max_capture = max(max_capture, cap)
+            if ckpt.busy():
+                # a background save is still in flight: THIS is the
+                # contention the stall metric exists to count
+                skipped_busy += 1
             else:
-                skipped += 1
+                t0 = time.perf_counter()
+                launched = ckpt.save(elastic.trainer)
+                cap = time.perf_counter() - t0
+                if launched:
+                    saves += 1
+                    max_capture = max(max_capture, cap)
+                else:
+                    # not busy and not launched: the step is already durable
+                    # (e.g. the restore rewound step_num onto a saved step)
+                    skipped_dedup += 1
 
     ckpt.wait_until_finished()
     wall = time.perf_counter() - t_start
@@ -253,7 +266,8 @@ def run_soak(
         remesh_events=remesh_events,
         restore=restore_rec,
         checkpoint_saves=saves,
-        checkpoint_skipped_busy=skipped,
+        checkpoint_skipped_busy=skipped_busy,
+        checkpoint_skipped_dedup=skipped_dedup,
         max_capture_stall_s=round(max_capture, 3),
         generation=elastic.generation,
     )

@@ -774,4 +774,9 @@ class AsyncDeltaCheckpointer(_BackgroundWriter, DeltaCheckpointer):
         return super().restore(trainer, step)
 
     def close(self) -> None:
-        self._drain()
+        try:
+            self._drain()
+        finally:
+            # DeltaCheckpointer.close() is a no-op today, but a drain failure
+            # must never skip whatever cleanup it grows (ADVICE r5)
+            super().close()

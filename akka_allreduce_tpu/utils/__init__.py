@@ -5,6 +5,7 @@ from akka_allreduce_tpu.utils.metrics import (  # noqa: F401
     RoundMetrics,
 )
 from akka_allreduce_tpu.utils.compile_cache import (  # noqa: F401
+    CompileCacheHandle,
     enable_persistent_compile_cache,
 )
 from akka_allreduce_tpu.utils.platform import (  # noqa: F401

@@ -12,6 +12,13 @@ loads the executable from disk instead of recompiling.
 
 Opt-in via ``--compile-cache [DIR]`` on the training CLIs and measured
 by ``bench-suite``'s config-5 tier (cold vs warm cycle latencies).
+
+``enable_persistent_compile_cache`` mutates GLOBAL ``jax.config`` state;
+it returns a :class:`CompileCacheHandle` so scoped users (bench-suite
+config 5, tests) can put the three flags back in a ``finally`` — the
+round-5 regression was exactly this leak: the cache-everything
+thresholds left live crashed an unrelated elastic test later in the
+same pytest process.
 """
 
 from __future__ import annotations
@@ -20,20 +27,67 @@ import os
 import tempfile
 
 
-def enable_persistent_compile_cache(directory: str | None = None) -> str:
+class CompileCacheHandle:
+    """Restore handle for the jax.config flags the enable call replaced.
+
+    ``str(handle)`` / ``handle.directory`` is the cache directory in use
+    (process-lifetime callers just print it); ``restore()`` — idempotent,
+    also run by ``with``-block exit — puts ``jax_compilation_cache_dir``
+    and both persistent-cache thresholds back to their prior values.
+    """
+
+    def __init__(self, directory: str, previous: dict) -> None:
+        self.directory = directory
+        self._previous = previous
+        self._restored = False
+
+    def restore(self) -> None:
+        if self._restored:
+            return
+        self._restored = True
+        import jax
+
+        for name, value in self._previous.items():
+            jax.config.update(name, value)
+
+    def __enter__(self) -> "CompileCacheHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.restore()
+
+    def __str__(self) -> str:
+        return self.directory
+
+    def __fspath__(self) -> str:
+        return self.directory
+
+
+_FLAGS = (
+    "jax_compilation_cache_dir",
+    "jax_persistent_cache_min_entry_size_bytes",
+    "jax_persistent_cache_min_compile_time_secs",
+)
+
+
+def enable_persistent_compile_cache(
+    directory: str | None = None,
+) -> CompileCacheHandle:
     """Point JAX's persistent compilation cache at ``directory`` (created
     if missing; a shared temp-dir default otherwise) and drop the entry
     thresholds so even small re-mesh programs are cached. Safe to call
-    more than once; returns the directory in use."""
+    more than once; returns a :class:`CompileCacheHandle` whose
+    ``restore()`` undoes all three config updates."""
     import jax
 
     directory = directory or os.path.join(
         tempfile.gettempdir(), "akka_allreduce_tpu_xla_cache"
     )
     os.makedirs(directory, exist_ok=True)
+    previous = {name: getattr(jax.config, name) for name in _FLAGS}
     jax.config.update("jax_compilation_cache_dir", directory)
     # default thresholds skip sub-second / small programs — exactly the
     # size class the elastic demo's trainers compile to; cache everything
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    return directory
+    return CompileCacheHandle(directory, previous)

@@ -22,6 +22,22 @@ def make_scattered(data_size=64, chunk=16, peers=4, th_reduce=1.0):
 
 
 class TestScatteredDataBuffer:
+    def test_store_accepts_wire_views_without_copy(self):
+        """Payloads arrive from the transport as np.frombuffer views into
+        the receive buffer (or as raw memoryviews); the stores view them in
+        place — the only copy is into the buffer's own storage."""
+        buf = make_scattered()
+        backing = bytearray(np.arange(16, dtype=np.float32).tobytes())
+        view = np.frombuffer(memoryview(backing), dtype=np.float32)
+        assert not view.flags.owndata
+        buf.store(view, src_id=0, chunk_id=0)
+        buf.store(memoryview(backing), src_id=1, chunk_id=0)  # raw buffer
+        out, count = buf.reduce(0)
+        np.testing.assert_allclose(out, 2 * np.arange(16, dtype=np.float32))
+        assert count == 2
+        # the reduce output is the buffer's OWN storage, not the wire view
+        assert not np.shares_memory(out, view)
+
     def test_accumulates_sum_and_count(self):
         buf = make_scattered()  # block=16, 1 chunk of 16
         a = np.arange(16, dtype=np.float32)

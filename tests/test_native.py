@@ -72,6 +72,83 @@ class TestKernels:
             )
 
 
+@pytest.fixture(params=["native", "fallback"])
+def wire_engine(request, monkeypatch):
+    """Like ``engine`` but forces the WIRE codec's native/fallback gate."""
+    if request.param == "native":
+        if not native.available():
+            pytest.skip("native library not built and no toolchain")
+        monkeypatch.setattr(native, "_WIRE_NATIVE_MIN", 0)
+    else:
+        monkeypatch.setattr(native, "_WIRE_NATIVE_MIN", 1 << 62)
+    return request.param
+
+
+class TestWireKernels:
+    """native/wire.cpp vs the struct/numpy fallback: byte-identical headers,
+    identical checksums, identical parses — the wire format cannot depend on
+    which path happens to be live."""
+
+    def test_checksum_matches_fallback_on_all_tail_lengths(self, wire_engine):
+        rng = np.random.default_rng(3)
+        for n in (0, 1, 2, 3, 4, 5, 31, 4096, 100_001):
+            data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            n4 = n & ~3
+            expect = (
+                int(
+                    np.add.reduce(
+                        np.frombuffer(data[:n4], "<u4"), dtype=np.uint32
+                    )
+                )
+                if n4
+                else 0
+            )
+            if n4 < n:
+                expect = (
+                    expect + int.from_bytes(data[n4:], "little")
+                ) & 0xFFFF_FFFF
+            assert native.wire_checksum(data) == expect, n
+
+    def test_pack_unpack_roundtrip(self, wire_engine):
+        payload = np.arange(777, dtype=np.float32)
+        mv = memoryview(payload).cast("B")
+        for tag, count in ((2, 0), (3, 9)):
+            head = native.pack_block_header(
+                tag, 1, 2, 3, 1234567890123, count, mv, payload.size
+            )
+            out = native.unpack_block(bytes(head) + mv.tobytes())
+            assert out == (1, 2, 3, 1234567890123, count, 777, False, len(head))
+
+    def test_pack_headers_byte_identical_across_paths(self):
+        if not native.available():
+            pytest.skip("native library not built and no toolchain")
+        import struct
+
+        payload = np.arange(50_000, dtype=np.float32)
+        mv = memoryview(payload).cast("B")
+        ck = native.wire_checksum(mv)
+        native_head = native.pack_block_header(
+            3, -1, 7, 5, -42, 11, mv, payload.size
+        )
+        py_head = struct.pack(
+            "<BiiiqiII", 3, -1, 7, 5, -42, 11, payload.size, ck
+        )
+        assert native_head == py_head
+
+    def test_unpack_rejects_malformed(self, wire_engine):
+        payload = np.arange(64, dtype=np.float32)
+        mv = memoryview(payload).cast("B")
+        head = native.pack_block_header(2, 0, 1, 2, 3, 0, mv, payload.size)
+        body = bytearray(bytes(head) + mv.tobytes())
+        with pytest.raises(ValueError):  # truncated payload
+            native.unpack_block(bytes(body[:-4]))
+        with pytest.raises(ValueError):  # not a payload tag
+            native.unpack_block(b"\x09" + bytes(body[1:]))
+        body[40] ^= 0xFF
+        with pytest.raises(ValueError):  # checksum mismatch
+            native.unpack_block(bytes(body))
+
+
 class TestBuildMachinery:
     def test_available_reports_consistently(self):
         # whichever state we're in, repeated calls agree and don't rebuild

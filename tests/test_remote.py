@@ -120,6 +120,59 @@ def test_wire_rejects_unknown():
         wire.decode(b"\xff")
 
 
+def test_encode_frame_parts_zero_copy_1m_floats():
+    """The acceptance pin for the send path: encoding a 1M-float payload
+    performs NO payload-sized copy — the payload segment is a memoryview of
+    the caller's array (buffer identity), and tracemalloc bounds the whole
+    encode's allocations to header scale."""
+    import tracemalloc
+
+    value = np.arange(1_000_000, dtype=np.float32)
+    msg = ScatterBlock(value, 0, 1, 0, 7)
+    wire.encode_frame_parts("worker:1", msg)  # warm lazy imports/caches
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    parts = wire.encode_frame_parts("worker:1", msg)
+    allocated = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+    payload = parts[-1]
+    assert isinstance(payload, memoryview)
+    assert payload.nbytes == value.nbytes
+    # buffer identity: the segment aliases the caller's array
+    assert np.shares_memory(np.frombuffer(payload, np.float32), value)
+    # headers + checksum bookkeeping only — orders of magnitude below the
+    # 4 MB payload (the old join path allocated >= payload size here)
+    assert allocated < value.nbytes // 10, allocated
+    # the joined compat form is byte-identical to the segments
+    assert b"".join(parts) == wire.encode_frame("worker:1", msg)
+
+
+def test_decode_payload_views_alias_wire_buffer():
+    """Decode's float payloads are views INTO the frame buffer (no copy):
+    mutating the buffer is visible through the decoded array."""
+    value = np.arange(4096, dtype=np.float32)
+    buf = bytearray(wire.encode(ScatterBlock(value, 1, 2, 3, 4)))
+    msg = wire.decode(memoryview(buf))
+    assert not msg.value.flags.owndata
+    assert np.shares_memory(
+        msg.value, np.frombuffer(memoryview(buf), np.uint8)
+    )
+    # corrupting one payload byte after decode shows through the view
+    np.testing.assert_array_equal(msg.value, value)
+    buf[-1] ^= 0xFF
+    assert msg.value[-1] != value[-1]
+
+
+def test_wire_payload_checksum_rejects_corruption():
+    """Payload frames carry an additive checksum (native/wire.cpp or the
+    numpy fallback): a flipped payload byte fails decode cleanly."""
+    value = np.arange(1000, dtype=np.float32)
+    buf = bytearray(wire.encode(ScatterBlock(value, 1, 2, 3, 4)))
+    buf[60] ^= 0x10  # inside the payload
+    with pytest.raises(ValueError):
+        wire.decode(memoryview(buf))
+
+
 def test_endpoint_parse():
     assert cl.Endpoint.parse("1.2.3.4:99") == cl.Endpoint("1.2.3.4", 99)
     with pytest.raises(ValueError):
@@ -626,6 +679,104 @@ def test_rejoin_after_heartbeat_resume():
             await h.wait_for(lambda: h.flushes(1) > f1, timeout=15.0)
         finally:
             await h.stop()
+
+    asyncio.run(run())
+
+
+def test_transport_recv_buffer_aliasing_and_safe_pool_reuse():
+    """The receive path's zero-copy contract end to end: a delivered
+    payload is a view into the transport's pooled receive buffer (recv_into,
+    no per-frame bytes), buffers recycle across frames once released, and a
+    handler that RETAINS a view keeps its buffer out of the pool — reuse can
+    never corrupt a live view."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+
+    async def run():
+        rx, tx = RemoteTransport(), RemoteTransport()
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            v1 = np.arange(65536, dtype=np.float32)
+            v2 = v1 * 2.0
+            await tx.send(Envelope("sink", ScatterBlock(v1, 0, 1, 0, 1)))
+            await wait_until(lambda: len(got) == 1)
+            view = got[0].value
+            # the payload aliases the receive buffer, not a private copy
+            assert not view.flags.owndata
+            base = view.base
+            while getattr(base, "base", None) is not None:
+                base = base.base
+            assert isinstance(base, memoryview)
+            assert isinstance(base.obj, bytearray)
+            # while we hold the view, its buffer must stay out of the pool:
+            # a second frame cannot recycle it underneath us
+            await tx.send(Envelope("sink", ScatterBlock(v2, 0, 1, 0, 2)))
+            await wait_until(lambda: len(got) == 2)
+            np.testing.assert_array_equal(got[0].value, v1)
+            np.testing.assert_array_equal(got[1].value, v2)
+            assert not any(
+                b is base.obj for b in rx._recv_pool
+            ), "buffer with a live view was pooled"
+            # a NON-retaining handler releases its buffer after each
+            # message: those buffers return to the pool for reuse
+            rounds: list[int] = []
+            rx.register(
+                "counter", lambda m: rounds.append(m.round_num) or []
+            )
+            tx.set_route("counter", ep)
+            for r in range(3, 6):
+                await tx.send(Envelope("counter", ScatterBlock(v1, 0, 1, 0, r)))
+            await wait_until(lambda: rounds == [3, 4, 5])
+            assert rx._recv_pool, "released buffers should return to the pool"
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_stalled_peer_never_parks_the_sender():
+    """A peer that accepts the connection but never reads must not park the
+    sender: the writer's bounded waits and the bounded high-water
+    backpressure deadline turn the stall into dropped messages within a few
+    connect_timeout_s — never an indefinitely blocked send()."""
+    import socket as socketmod
+    import time as timemod
+
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+
+    async def run():
+        srv = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)  # accepts; NOBODY ever reads
+        tx = RemoteTransport(connect_timeout_s=0.4)
+        await tx.start()
+        tx.set_route("sink", cl.Endpoint("127.0.0.1", srv.getsockname()[1]))
+        rx = RemoteTransport()
+        got: list[int] = []
+        rx.register("healthy", lambda m: got.append(m.round_num) or [])
+        rx_ep = await rx.start()
+        tx.set_route("healthy", rx_ep)
+        try:
+            payload = np.zeros(262_144, dtype=np.float32)  # 1 MB frames
+            t0 = timemod.monotonic()
+            for r in range(12):  # 12 MB >> high water + kernel buffers
+                await tx.send(Envelope("sink", ScatterBlock(payload, 0, 1, 0, r)))
+            elapsed = timemod.monotonic() - t0
+            # every send returned in bounded time (the kernel may have
+            # absorbed some frames into zombie connections — at-most-once
+            # allows that; what it must NOT do is park the sender)
+            assert elapsed < 8.0, elapsed
+            # and the transport is still fully alive for healthy peers
+            await tx.send(Envelope("healthy", ScatterBlock(payload, 0, 1, 0, 99)))
+            await wait_until(lambda: got == [99], 5.0)
+        finally:
+            await tx.stop()
+            await rx.stop()
+            srv.close()
 
     asyncio.run(run())
 
