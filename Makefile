@@ -1,0 +1,28 @@
+# Top-level developer targets. The native build's canonical recipe lives in
+# akka_allreduce_tpu/native/__init__.py (see native/Makefile, a thin shim).
+
+PYTHON ?= python3
+
+.PHONY: lint lint-json baseline native test tier1
+
+# arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
+# (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
+# tests/test_arlint.py, so CI and a local `make lint` always agree.
+lint:
+	$(PYTHON) -m akka_allreduce_tpu.analysis akka_allreduce_tpu/
+
+lint-json:
+	$(PYTHON) -m akka_allreduce_tpu.analysis akka_allreduce_tpu/ --json
+
+# refresh arlint_baseline.json from the current tree — use ONLY for findings
+# that are deliberate and justified; prefer fixing, then inline suppression
+baseline:
+	$(PYTHON) -m akka_allreduce_tpu.analysis akka_allreduce_tpu/ --write-baseline
+
+native:
+	$(MAKE) -C native
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+tier1: lint test

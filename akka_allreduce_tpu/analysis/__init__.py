@@ -1,0 +1,59 @@
+"""arlint — the repo's own async-safety / buffer-aliasing / wire-exhaustiveness
+static analyzer (``python -m akka_allreduce_tpu.analysis``).
+
+Every rule targets a defect class this codebase has already paid for by hand
+(ANALYSIS.md tells each story):
+
+- **ASYNC001** — blocking call (``time.sleep``, ``subprocess.run``, sync
+  socket/file IO) inside ``async def``: stalls the event loop that carries
+  heartbeats and round traffic.
+- **ASYNC002** — coroutine called but never awaited: the body silently never
+  runs.
+- **ASYNC003** — ``asyncio.create_task``/``ensure_future`` result dropped:
+  the task can be garbage-collected mid-flight and its exception is lost.
+- **ASYNC004** — ``except Exception:`` / bare ``except`` inside a coroutine
+  without an ``asyncio.CancelledError`` escape: can swallow task cancellation
+  (the PR-2 ``transport.stop()`` deadlock class).
+- **BUF001** — ``np.frombuffer``/``memoryview`` view of a pooled/recycled
+  buffer escaping its recycle scope (returned or stored on ``self``): the
+  recv-ring aliasing class.
+- **WIRE001** — wire-tag exhaustiveness: every tag in ``control/wire._TAGS``
+  must have an encode arm, a decode arm, and an ``isinstance`` dispatch arm
+  somewhere in the analyzed tree — and no arm may exist for an unknown tag.
+
+No third-party dependencies: stdlib ``ast`` only, so it runs anywhere the
+package imports. Suppress a finding inline with ``# arlint: disable=RULE``
+(same line) or ``# arlint: disable-next=RULE`` (line above), or via the
+checked-in baseline file (``[tool.arlint]`` in pyproject.toml).
+"""
+
+from __future__ import annotations
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig, load_config
+from akka_allreduce_tpu.analysis.core import (
+    Finding,
+    analyze_paths,
+    analyze_source,
+)
+from akka_allreduce_tpu.analysis.rules import FILE_RULES
+from akka_allreduce_tpu.analysis.wire_rule import check_wire_exhaustiveness
+
+ALL_RULES = (
+    "ASYNC001",
+    "ASYNC002",
+    "ASYNC003",
+    "ASYNC004",
+    "BUF001",
+    "WIRE001",
+)
+
+__all__ = [
+    "ALL_RULES",
+    "ArlintConfig",
+    "FILE_RULES",
+    "Finding",
+    "analyze_paths",
+    "analyze_source",
+    "check_wire_exhaustiveness",
+    "load_config",
+]

@@ -1,0 +1,146 @@
+"""CLI: ``python -m akka_allreduce_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage or
+configuration error. Default output is ``file:line: RULE message`` per
+finding; ``--json`` emits a machine-readable report instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from akka_allreduce_tpu.analysis.config import (
+    ArlintConfig,
+    ConfigError,
+    load_config,
+)
+from akka_allreduce_tpu.analysis.core import (
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m akka_allreduce_tpu.analysis",
+        description="arlint: async-safety / buffer-aliasing / "
+        "wire-exhaustiveness static analyzer (ANALYSIS.md documents the "
+        "rules and the bugs that motivated them)",
+    )
+    p.add_argument(
+        "paths", nargs="+", type=Path, help="files or directories to analyze"
+    )
+    p.add_argument("--json", action="store_true", help="JSON report on stdout")
+    p.add_argument(
+        "--rules",
+        help="comma-separated rule subset (default: all, or [tool.arlint] "
+        "rules)",
+    )
+    p.add_argument(
+        "--config",
+        type=Path,
+        default=None,
+        help="pyproject.toml carrying [tool.arlint] (default: nearest one "
+        "above the first path)",
+    )
+    p.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="baseline JSON overriding the configured one",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline: report everything",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline file from the current findings and exit 0",
+    )
+    args = p.parse_args(argv)
+
+    for path in args.paths:
+        if not path.exists():
+            print(f"arlint: no such path: {path}", file=sys.stderr)
+            return 2
+    try:
+        config = load_config(args.paths, pyproject=args.config)
+    except ConfigError as exc:
+        print(f"arlint: {exc}", file=sys.stderr)
+        return 2
+    if args.rules:
+        config.rules = tuple(
+            r.strip() for r in args.rules.split(",") if r.strip()
+        )
+    if config.rules is not None:
+        # an unvalidated typo ('ASYNC01') would silently select NOTHING and
+        # turn the whole gate green — unknown rule ids are a usage error
+        from akka_allreduce_tpu.analysis import ALL_RULES
+
+        unknown = sorted(set(config.rules) - set(ALL_RULES))
+        if unknown:
+            print(
+                f"arlint: unknown rule(s) {', '.join(unknown)}; known: "
+                f"{', '.join(ALL_RULES)}",
+                file=sys.stderr,
+            )
+            return 2
+
+    findings = analyze_paths(args.paths, config)
+
+    baseline_path = (
+        args.baseline if args.baseline is not None else config.baseline_path()
+    )
+    if args.no_baseline:
+        baseline_path = None
+    if args.write_baseline:
+        if baseline_path is None:
+            print(
+                "arlint: --write-baseline needs --baseline or a "
+                "[tool.arlint] baseline entry",
+                file=sys.stderr,
+            )
+            return 2
+        write_baseline(baseline_path, findings)
+        print(
+            f"arlint: wrote {len(findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    baselined: list = []
+    if baseline_path is not None:
+        findings, baselined = apply_baseline(
+            findings, load_baseline(baseline_path)
+        )
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in findings],
+                    "baselined": [f.as_dict() for f in baselined],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        note = f", {len(baselined)} baselined" if baselined else ""
+        print(
+            f"arlint: {len(findings)} unsuppressed finding(s){note}",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

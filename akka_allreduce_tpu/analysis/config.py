@@ -1,0 +1,215 @@
+"""``[tool.arlint]`` configuration.
+
+The container targets Python 3.10 (no ``tomllib``) and the analyzer must not
+grow third-party deps, so this module reads the ONE table it needs with a
+deliberately small parser: ``[tool.arlint]`` holding scalar strings, booleans,
+integers, and flat string lists. That subset is the documented contract
+(ANALYSIS.md); anything fancier in the block is a config error, not a silent
+skip. On 3.11+ the real ``tomllib`` is used instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+try:  # Python >= 3.11
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - exercised on 3.10 only
+    tomllib = None
+
+
+@dataclasses.dataclass
+class ArlintConfig:
+    """Resolved analyzer configuration (defaults = no pyproject needed)."""
+
+    #: rules to run (None = all registered rules)
+    rules: tuple[str, ...] | None = None
+    #: baseline file path, relative to the pyproject that named it
+    baseline: str | None = None
+    #: path substrings excluded from analysis (fixtures, generated code)
+    exclude: tuple[str, ...] = ()
+    #: extra dotted callables ASYNC001 treats as blocking
+    async001_blocking: tuple[str, ...] = ()
+    #: markers BUF001 treats as recycled-buffer sources, matched against
+    #: whole underscore-separated segments of the name ("ring" hits
+    #: ``_ring``/``ring_buf`` but never ``_instring``)
+    buf001_markers: tuple[str, ...] = ("ring", "pool", "recycled")
+    #: where the config came from (for error messages / baseline resolution)
+    source: Path | None = None
+
+    def baseline_path(self) -> Path | None:
+        if self.baseline is None:
+            return None
+        p = Path(self.baseline)
+        if not p.is_absolute() and self.source is not None:
+            p = self.source.parent / p
+        return p
+
+
+class ConfigError(ValueError):
+    """Malformed ``[tool.arlint]`` block."""
+
+
+_KV = re.compile(r"^\s*([A-Za-z0-9_-]+)\s*=\s*(.+?)\s*$")
+
+
+def _parse_value(raw: str, *, key: str):
+    raw = raw.strip()
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        items = []
+        for part in _split_list(inner):
+            items.append(_parse_value(part, key=key))
+        return items
+    if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "\"'":
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    if re.fullmatch(r"-?\d+", raw):
+        return int(raw)
+    raise ConfigError(f"[tool.arlint] {key}: unsupported TOML value {raw!r}")
+
+
+def _split_list(inner: str) -> list[str]:
+    """Split a flat TOML list body on commas outside quotes."""
+    parts: list[str] = []
+    buf = ""
+    quote: str | None = None
+    for ch in inner:
+        if quote is not None:
+            buf += ch
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            buf += ch
+        elif ch == ",":
+            if buf.strip():
+                parts.append(buf.strip())
+            buf = ""
+        else:
+            buf += ch
+    if buf.strip():
+        parts.append(buf.strip())
+    return parts
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment that sits outside any quoted string —
+    tomllib accepts them everywhere, so the 3.10 fallback must too."""
+    quote: str | None = None
+    for i, ch in enumerate(line):
+        if quote is not None:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return line[:i].rstrip()
+    return line
+
+
+def _read_arlint_table_minitoml(text: str) -> dict:
+    """Extract ``[tool.arlint]`` key/values from raw TOML text (3.10 path)."""
+    table: dict = {}
+    in_table = False
+    pending = ""  # accumulates a multi-line list value
+    for line in text.splitlines():
+        stripped = _strip_comment(line.strip()).strip()
+        if pending:
+            if not stripped:
+                continue
+            pending += " " + stripped
+            if stripped.endswith("]"):
+                m = _KV.match(pending)
+                assert m is not None
+                table[m.group(1)] = _parse_value(m.group(2), key=m.group(1))
+                pending = ""
+            continue
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped.startswith("["):
+            # a table header may carry a trailing comment; strip it before
+            # matching so `[tool.arlint]  # config` is still recognized
+            header = stripped.split("#", 1)[0].strip()
+            in_table = header == "[tool.arlint]"
+            continue
+        if not in_table:
+            continue
+        m = _KV.match(stripped)
+        if m is None:
+            raise ConfigError(f"[tool.arlint]: cannot parse line {stripped!r}")
+        if m.group(2).startswith("[") and not m.group(2).endswith("]"):
+            pending = stripped
+            continue
+        table[m.group(1)] = _parse_value(m.group(2), key=m.group(1))
+    if pending:
+        # an unterminated multi-line list must be a loud error, never a
+        # silently dropped key
+        raise ConfigError(
+            f"[tool.arlint]: unterminated list starting at {pending!r}"
+        )
+    return table
+
+
+def _read_arlint_table(path: Path) -> dict:
+    text = path.read_text(encoding="utf-8")
+    if tomllib is not None:
+        data = tomllib.loads(text)
+        return data.get("tool", {}).get("arlint", {})
+    return _read_arlint_table_minitoml(text)
+
+
+def _str_tuple(value, *, key: str) -> tuple[str, ...]:
+    if not isinstance(value, list) or not all(
+        isinstance(v, str) for v in value
+    ):
+        raise ConfigError(f"[tool.arlint] {key}: expected a list of strings")
+    return tuple(value)
+
+
+def config_from_table(table: dict, *, source: Path | None = None) -> ArlintConfig:
+    cfg = ArlintConfig(source=source)
+    for key, value in table.items():
+        norm = key.replace("-", "_")
+        if norm == "rules":
+            cfg.rules = _str_tuple(value, key=key)
+        elif norm == "baseline":
+            if not isinstance(value, str):
+                raise ConfigError("[tool.arlint] baseline: expected a string")
+            cfg.baseline = value
+        elif norm == "exclude":
+            cfg.exclude = _str_tuple(value, key=key)
+        elif norm == "async001_blocking":
+            cfg.async001_blocking = _str_tuple(value, key=key)
+        elif norm == "buf001_markers":
+            cfg.buf001_markers = _str_tuple(value, key=key)
+        else:
+            raise ConfigError(f"[tool.arlint]: unknown key {key!r}")
+    return cfg
+
+
+def find_pyproject(start: Path) -> Path | None:
+    """Nearest pyproject.toml at or above ``start``."""
+    cur = start if start.is_dir() else start.parent
+    for candidate in (cur, *cur.parents):
+        p = candidate / "pyproject.toml"
+        if p.is_file():
+            return p
+    return None
+
+
+def load_config(
+    paths: list[Path] | None = None, *, pyproject: Path | None = None
+) -> ArlintConfig:
+    """Resolve config: explicit ``pyproject`` wins, else the nearest
+    pyproject.toml above the first analyzed path; no file -> defaults."""
+    if pyproject is None and paths:
+        pyproject = find_pyproject(paths[0].resolve())
+    if pyproject is None:
+        return ArlintConfig()
+    return config_from_table(_read_arlint_table(pyproject), source=pyproject)

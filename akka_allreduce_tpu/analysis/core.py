@@ -1,0 +1,302 @@
+"""Analyzer core: findings, suppression comments, baseline, and the runner.
+
+Enforcement contract (tests/test_arlint.py, ``make lint``): a finding is
+*unsuppressed* unless an inline ``# arlint: disable=RULE`` comment covers its
+line or the baseline file carries its fingerprint. Fingerprints are
+``(relative path, rule, stripped source line)`` — content-addressed, so a
+baseline survives unrelated edits shifting line numbers, but any change to
+the offending line itself resurfaces the finding for a fresh look.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from collections import Counter
+from pathlib import Path
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    #: stripped source text of ``line`` (fingerprint component); filled by
+    #: the runner, empty for findings built directly in unit fixtures
+    line_content: str = ""
+    #: last line of the offending statement (0 = same as ``line``): a
+    #: trailing suppression comment on a black-wrapped multi-line call sits
+    #: on the CLOSING line, so suppression matching covers the whole span
+    end_line: int = 0
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        # the message participates so that two DIFFERENT findings anchored to
+        # the same line (WIRE001 reports everything at the _TAGS literal)
+        # never collapse into one baseline entry
+        return (self.path, self.rule, self.line_content, self.message)
+
+
+# -- inline suppressions ------------------------------------------------------
+
+# the rules group accepts lowercase too: `disable=buf001` must parse as a
+# NAMED suppression (normalized to uppercase below), never degrade to a
+# blanket disable because the group failed to match
+_SUPPRESS = re.compile(
+    r"#\s*arlint:\s*disable(?P<next>-next)?"
+    r"(?P<eq>\s*=\s*(?P<rules>[A-Za-z0-9_, ]*))?"
+)
+
+
+def _comment_tokens(source: str) -> list[tuple[int, str]]:
+    """(line, text) of every real COMMENT token — tokenizing (rather than
+    regex-scanning raw lines) keeps a directive spelled inside a string
+    literal or docstring from registering a phantom suppression."""
+    try:
+        return [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # untokenizable source never got past ast.parse either; nothing to
+        # suppress on a file that only carries a PARSE finding
+        return []
+
+
+def suppressed_lines(source: str) -> dict[int, frozenset[str] | None]:
+    """``{line_number: rules}`` where rules is None for a blanket disable.
+
+    ``# arlint: disable=RULE`` suppresses its own (1-based) line;
+    ``# arlint: disable-next=RULE`` suppresses the following line.
+    """
+    out: dict[int, frozenset[str] | None] = {}
+    for i, text in _comment_tokens(source):
+        m = _SUPPRESS.search(text)
+        if m is None:
+            continue
+        target = i + 1 if m.group("next") else i
+        if m.group("eq") is None:
+            ruleset = None  # no '=': a deliberate blanket disable
+        else:
+            # '=' present: ONLY the named rules are suppressed (uppercased —
+            # `disable=buf001` means BUF001); an empty/garbled list
+            # suppresses nothing rather than everything
+            ruleset = frozenset(
+                r.strip().upper()
+                for r in (m.group("rules") or "").split(",")
+                if r.strip()
+            )
+        if target in out:
+            prev = out[target]
+            out[target] = (
+                None if prev is None or ruleset is None else prev | ruleset
+            )
+        else:
+            out[target] = ruleset
+    return out
+
+
+def is_suppressed(
+    finding: Finding, suppressions: dict[int, frozenset[str] | None]
+) -> bool:
+    last = max(finding.line, finding.end_line)
+    for line in range(finding.line, last + 1):
+        rules = suppressions.get(line, ...)
+        if rules is ...:
+            continue
+        if rules is None or finding.rule in rules:
+            return True
+    return False
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> Counter:
+    """Fingerprint multiset from a baseline JSON file (missing file = empty:
+    a fresh checkout with no baseline simply enforces everything)."""
+    if not path.is_file():
+        return Counter()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return Counter(
+        (e["path"], e["rule"], e["line_content"], e.get("message", ""))
+        for e in data.get("findings", [])
+    )
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    entries = [
+        {
+            "path": f.path,
+            "rule": f.rule,
+            "line_content": f.line_content,
+            "message": f.message,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    path.write_text(
+        json.dumps({"version": 1, "findings": entries}, indent=2) + "\n",
+        encoding="utf-8",
+    )
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: Counter
+) -> tuple[list[Finding], list[Finding]]:
+    """Split into (unsuppressed, baselined); each baseline entry absorbs at
+    most its multiplicity, so a SECOND identical violation still fails."""
+    remaining = Counter(baseline)
+    fresh: list[Finding] = []
+    known: list[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining[fp] > 0:
+            remaining[fp] -= 1
+            known.append(f)
+        else:
+            fresh.append(f)
+    return fresh, known
+
+
+# -- runner -------------------------------------------------------------------
+
+
+def _attach_line_content(findings: list[Finding], source: str) -> list[Finding]:
+    lines = source.splitlines()
+    out = []
+    for f in findings:
+        content = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        out.append(dataclasses.replace(f, line_content=content))
+    return out
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    config: ArlintConfig | None = None,
+    *,
+    apply_suppressions: bool = True,
+    tree: ast.AST | None = None,
+) -> list[Finding]:
+    """Run the per-file rules over one source string (the fixture/test API).
+
+    Returns findings sorted by line; syntax errors surface as a synthetic
+    ``PARSE`` finding rather than an exception, so one broken file cannot
+    take the whole lint run down silently. ``tree`` lets a caller that
+    already parsed the source (analyze_paths) skip the second parse.
+    """
+    from akka_allreduce_tpu.analysis.rules import FILE_RULES
+
+    config = config or ArlintConfig()
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            return [
+                Finding(
+                    path, exc.lineno or 1, "PARSE", f"syntax error: {exc.msg}"
+                )
+            ]
+    findings: list[Finding] = []
+    for rule_id, rule in FILE_RULES.items():
+        if config.rules is not None and rule_id not in config.rules:
+            continue
+        findings.extend(rule(tree, path, config))
+    findings = _attach_line_content(findings, source)
+    if apply_suppressions:
+        sup = suppressed_lines(source)
+        findings = [f for f in findings if not is_suppressed(f, sup)]
+    return sorted(findings, key=lambda f: (f.line, f.rule))
+
+
+def iter_python_files(paths: list[Path], config: ArlintConfig) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    out = []
+    for f in files:
+        posix = f.as_posix()
+        if any(pat in posix for pat in config.exclude):
+            continue
+        out.append(f)
+    # overlapping inputs (`arlint pkg/ pkg/mod.py`) must not analyze a file
+    # twice — duplicate findings would defeat baseline multiplicity
+    return list(dict.fromkeys(out))
+
+
+def analyze_paths(
+    paths: list[Path],
+    config: ArlintConfig | None = None,
+    *,
+    root: Path | None = None,
+) -> list[Finding]:
+    """Analyze files/trees: per-file rules + project-wide WIRE001.
+
+    ``root`` anchors the relative paths used in output and baseline
+    fingerprints (default: the config's pyproject directory, else cwd).
+    Inline suppressions are already applied; baseline filtering is the
+    caller's second step (the CLI and the enforcement test both do it).
+    """
+    from akka_allreduce_tpu.analysis.wire_rule import check_wire_exhaustiveness
+
+    config = config or ArlintConfig()
+    if root is None:
+        root = (
+            config.source.parent if config.source is not None else Path.cwd()
+        )
+    files = iter_python_files([p.resolve() for p in paths], config)
+    findings: list[Finding] = []
+    parsed: dict[str, tuple[ast.AST, str]] = {}
+    suppressions: dict[str, dict] = {}
+    for f in files:
+        try:
+            rel = f.relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        source = f.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            findings.extend(analyze_source(source, rel, config))  # -> PARSE
+            continue
+        findings.extend(analyze_source(source, rel, config, tree=tree))
+        parsed[rel] = (tree, source)
+        suppressions[rel] = suppressed_lines(source)
+    if config.rules is None or "WIRE001" in config.rules:
+        wire_findings = check_wire_exhaustiveness(
+            {rel: tree for rel, (tree, _) in parsed.items()}, config
+        )
+        wire_findings = [
+            dataclasses.replace(
+                f,
+                line_content=(
+                    parsed[f.path][1].splitlines()[f.line - 1].strip()
+                    if f.path in parsed
+                    and 0 < f.line <= len(parsed[f.path][1].splitlines())
+                    else ""
+                ),
+            )
+            for f in wire_findings
+        ]
+        findings.extend(
+            f
+            for f in wire_findings
+            if not is_suppressed(f, suppressions.get(f.path, {}))
+        )
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,441 @@
+"""Per-file AST rules: ASYNC001-ASYNC004 and BUF001.
+
+Each rule is a function ``(tree, path, config) -> list[Finding]`` registered
+in ``FILE_RULES``. The rules are deliberately shallow — no cross-function
+dataflow — because every one of them targets a *syntactically local* defect
+shape this codebase has actually shipped (see ANALYSIS.md). Shallow means
+predictable: a finding always points at one line a human can judge in
+isolation, and a suppression comment on that line is the whole escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+from akka_allreduce_tpu.analysis.core import Finding
+
+# -- shared helpers -----------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> str | None:
+    """The last identifier of a Name/Attribute/Subscript chain:
+    ``self._recv_pool[i]`` -> ``_recv_pool``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _functions(tree: ast.AST) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _direct_body_walk(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``func``'s body WITHOUT descending into nested function
+    definitions (code in a nested def does not run in this frame — an
+    ``except`` or blocking call there belongs to the nested function's own
+    execution context, which the rules visit separately)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- ASYNC001: blocking call inside a coroutine -------------------------------
+
+# Callables that block the calling thread. The event loop thread carries
+# heartbeats, failure detection, and every in-flight round: one of these in a
+# coroutine stalls ALL of them for its full duration.
+_BLOCKING = {
+    "time.sleep": "asyncio.sleep",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "subprocess.Popen": "asyncio.create_subprocess_exec",
+    "os.system": "asyncio.create_subprocess_shell",
+    "os.waitpid": "asyncio.create_subprocess_exec",
+    "select.select": "loop.add_reader/add_writer",
+    "socket.create_connection": "loop.sock_connect on a non-blocking socket",
+    "urllib.request.urlopen": "a thread via asyncio.to_thread",
+}
+
+
+def rule_async001(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    blocking = dict(_BLOCKING)
+    for extra in config.async001_blocking:
+        blocking.setdefault(extra, "an async equivalent or asyncio.to_thread")
+    findings = []
+    for func in _functions(tree):
+        if not isinstance(func, ast.AsyncFunctionDef):
+            continue
+        for node in _direct_body_walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in blocking:
+                findings.append(
+                    Finding(
+                        path,
+                        node.lineno,
+                        "ASYNC001",
+                        f"blocking call {name}() inside 'async def "
+                        f"{func.name}' stalls the event loop (and every "
+                        f"heartbeat/round it carries); use "
+                        f"{blocking[name]} or asyncio.to_thread",
+                        end_line=node.end_lineno or node.lineno,
+                    )
+                )
+    return findings
+
+
+# -- ASYNC002: coroutine called but never awaited -----------------------------
+
+# asyncio module-level coroutine functions whose bare call is always a bug
+_ASYNCIO_COROS = {
+    "asyncio.sleep",
+    "asyncio.wait",
+    "asyncio.wait_for",
+    "asyncio.gather",
+    "asyncio.to_thread",
+    "asyncio.open_connection",
+    "asyncio.start_server",
+}
+
+
+def _async_contexts(
+    tree: ast.AST,
+) -> list[tuple[ast.AsyncFunctionDef, frozenset[str]]]:
+    """Every ``async def`` paired with the async-method names of its
+    enclosing class (empty for module-level/nested functions): ``self.X``
+    must resolve against the SAME class, or a sync ``B.ping`` would be
+    flagged because an unrelated ``A.ping`` is async."""
+    out: list[tuple[ast.AsyncFunctionDef, frozenset[str]]] = []
+    class_methods: dict[ast.AST, frozenset[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            class_methods[node] = frozenset(
+                f.name
+                for f in node.body
+                if isinstance(f, ast.AsyncFunctionDef)
+            )
+            for f in node.body:
+                if isinstance(f, ast.AsyncFunctionDef):
+                    out.append((f, class_methods[node]))
+    in_class = {id(f) for f, _ in out}
+    for f in _functions(tree):
+        if isinstance(f, ast.AsyncFunctionDef) and id(f) not in in_class:
+            out.append((f, frozenset()))
+    return out
+
+
+def rule_async002(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    # bare-Name calls resolve against module-level async defs only
+    top_coros = {
+        f.name
+        for f in getattr(tree, "body", [])
+        if isinstance(f, ast.AsyncFunctionDef)
+    }
+    findings = []
+    # only coroutine bodies are scanned (like ASYNC001/ASYNC004): a sync
+    # function calling a coroutine may be handing it to a scheduler —
+    # inside an async def a bare coroutine-call statement is a lost body
+    for func, self_coros in _async_contexts(tree):
+        for node in _direct_body_walk(func):
+            if not (
+                isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+            ):
+                continue
+            call = node.value
+            name = dotted_name(call.func)
+            hit: str | None = None
+            if name in _ASYNCIO_COROS:
+                hit = name
+            elif isinstance(call.func, ast.Name) and call.func.id in top_coros:
+                hit = call.func.id
+            elif (
+                isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id == "self"
+                and call.func.attr in self_coros
+            ):
+                hit = f"self.{call.func.attr}"
+            if hit is not None:
+                findings.append(
+                    Finding(
+                        path,
+                        node.lineno,
+                        "ASYNC002",
+                        f"coroutine {hit}() is called but never awaited — "
+                        f"the body never runs; await it or wrap it in a "
+                        f"retained task",
+                        end_line=node.end_lineno or node.lineno,
+                    )
+                )
+    return findings
+
+
+# -- ASYNC003: dropped task handle --------------------------------------------
+
+
+def _is_task_spawn(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    # observed_task included: it keeps the task alive and logs crashes, but a
+    # dropped handle still loses the caller's ability to cancel/await it
+    if tail in ("create_task", "ensure_future", "observed_task"):
+        return name
+    return None
+
+
+def rule_async003(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+            continue
+        name = _is_task_spawn(node.value)
+        if name is not None:
+            findings.append(
+                Finding(
+                    path,
+                    node.lineno,
+                    "ASYNC003",
+                    f"{name}() handle dropped: the event loop keeps only a "
+                    f"weak reference, so the task can be garbage-collected "
+                    f"mid-flight and its exception is silently lost — retain "
+                    f"the handle (task set / attribute) or add a "
+                    f"done-callback that logs failures",
+                    end_line=node.end_lineno or node.lineno,
+                )
+            )
+    return findings
+
+
+# -- ASYNC004: cancellation-swallowing except inside a coroutine --------------
+
+_SWALLOWING = ("Exception", "BaseException", "CancelledError")
+
+
+def _handler_catches(handler: ast.ExceptHandler, names: tuple[str, ...]) -> str | None:
+    """Which of ``names`` this handler's type expression covers (bare
+    ``except`` counts as BaseException)."""
+    if handler.type is None:
+        return "bare except"
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        tname = terminal_name(t)
+        if tname in names:
+            return tname
+    return None
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """Handler body re-raises the active exception at any depth outside
+    nested defs: bare ``raise``, or ``raise e`` of the bound name
+    (``except ... as e``)."""
+    for node in _direct_body_walk(handler):
+        if not isinstance(node, ast.Raise):
+            continue
+        if node.exc is None:
+            return True
+        if (
+            handler.name is not None
+            and isinstance(node.exc, ast.Name)
+            and node.exc.id == handler.name
+        ):
+            return True
+    return False
+
+
+def rule_async004(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    findings = []
+    for func in _functions(tree):
+        if not isinstance(func, ast.AsyncFunctionDef):
+            continue
+        for node in _direct_body_walk(func):
+            if not isinstance(node, ast.Try):
+                continue
+            # A dedicated CancelledError arm (no broad type alongside it) is
+            # a deliberate decision about cancellation — the idiomatic
+            # `task.cancel(); await task; except CancelledError: pass`
+            # included. It protects an `except Exception` arm ANYWHERE in
+            # the same try (Exception cannot catch CancelledError on
+            # py3.8+, so arm order is irrelevant), but protects bare
+            # `except`/`except BaseException` only when it comes FIRST —
+            # those catch CancelledError themselves, making a later
+            # dedicated arm dead code.
+            dedicated = [
+                bool(
+                    _handler_catches(h, ("CancelledError",))
+                    and _handler_catches(h, ("Exception", "BaseException"))
+                    is None
+                )
+                for h in node.handlers
+            ]
+            for i, handler in enumerate(node.handlers):
+                if dedicated[i]:
+                    continue
+                caught = _handler_catches(handler, _SWALLOWING)
+                if caught is None or _reraises(handler):
+                    continue
+                protected = (
+                    any(dedicated)
+                    if caught == "Exception"
+                    else any(dedicated[:i])
+                )
+                if protected:
+                    continue
+                findings.append(
+                    Finding(
+                        path,
+                        handler.lineno,
+                        "ASYNC004",
+                        # span stays on the `except` line only: the handler
+                        # BODY must not become a suppression surface
+                        f"'{caught}' handler inside 'async def {func.name}' "
+                        f"can swallow asyncio.CancelledError (wait_for "
+                        f"timeouts/teardown deadlock class, Python < 3.12 "
+                        f"especially) — add an 'except "
+                        f"asyncio.CancelledError: raise' arm before it or "
+                        f"re-raise inside",
+                    )
+                )
+    return findings
+
+
+# -- BUF001: escaping view of a recycled buffer -------------------------------
+
+_VIEW_CALLS = ("np.frombuffer", "numpy.frombuffer", "memoryview")
+
+# a view escaping THROUGH one of these owns its memory: methods called on the
+# view, and constructors/functions the view is passed into
+_COPYING_METHODS = ("copy", "tobytes", "astype")
+_COPYING_CALLS = ("bytes", "bytearray", "list", "tuple", "np.array", "numpy.array")
+
+
+def _recycled_view_call(
+    node: ast.AST, markers: tuple[str, ...]
+) -> tuple[ast.Call, str] | None:
+    """A ``np.frombuffer``/``memoryview`` Call over a source whose terminal
+    name matches a recycled-buffer marker, found anywhere inside ``node`` —
+    except under a copying wrapper (``view.copy()``, ``bytes(view)``, …),
+    whose result owns its memory: 'copy before the escape' must silence the
+    rule even when done in the same expression."""
+    if isinstance(node, ast.Call):
+        func_name = dotted_name(node.func)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _COPYING_METHODS
+        ):
+            return None  # <view expr>.copy() — nothing below escapes
+        if func_name in _COPYING_CALLS:
+            return None  # bytes(<view expr>) — ditto
+        if func_name in _VIEW_CALLS and node.args:
+            src = terminal_name(node.args[0])
+            if src is not None:
+                # markers match whole underscore-separated segments of the
+                # name — a bare substring test would make the default
+                # 'ring' fire on '_instring'/'wiring'
+                segments = [s for s in src.lower().split("_") if s]
+                if any(marker in segments for marker in markers):
+                    return node, src
+    for child in ast.iter_child_nodes(node):
+        hit = _recycled_view_call(child, markers)
+        if hit is not None:
+            return hit
+    return None
+
+
+def rule_buf001(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    markers = tuple(m.lower() for m in config.buf001_markers)
+    findings = []
+    for func in _functions(tree):
+        for node in _direct_body_walk(func):
+            escape: str | None = None
+            value: ast.AST | None = None
+            if isinstance(node, ast.Return) and node.value is not None:
+                escape, value = "returned", node.value
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) and node.value is not None:
+                escape, value = "yielded", node.value
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                stores_self = any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    for t in targets
+                )
+                if stores_self and node.value is not None:
+                    escape, value = "stored on self", node.value
+            if escape is None or value is None:
+                continue
+            hit = _recycled_view_call(value, markers)
+            if hit is None:
+                continue
+            call, src = hit
+            findings.append(
+                Finding(
+                    path,
+                    node.lineno,
+                    "BUF001",
+                    f"zero-copy view of recycled buffer '{src}' is {escape}: "
+                    f"once the buffer is reused the view aliases live memory "
+                    f"(recv-ring corruption class) — copy before the escape, "
+                    f"or guard the recycle and suppress with a justification",
+                    end_line=node.end_lineno or node.lineno,
+                )
+            )
+    return findings
+
+
+FILE_RULES = {
+    "ASYNC001": rule_async001,
+    "ASYNC002": rule_async002,
+    "ASYNC003": rule_async003,
+    "ASYNC004": rule_async004,
+    "BUF001": rule_buf001,
+}

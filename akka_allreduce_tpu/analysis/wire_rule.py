@@ -1,0 +1,223 @@
+"""WIRE001 — wire-tag exhaustiveness, checked across the analyzed tree.
+
+The control plane's binary codec (``control/wire.py``) is a hand-rolled
+tag-dispatch pair: ``_TAGS`` maps message type -> tag byte, ``_encode_parts``
+and ``decode`` each carry one ``tag == N`` arm per entry, and every decoded
+message must reach an ``isinstance`` dispatch arm in some handler
+(``control/worker.py``, ``control/bootstrap.py``, the line/grid masters).
+Three places to update per new message type, and nothing ties them together
+at runtime: a missed decode arm is a silent ``ValueError: unknown wire tag``
+under load, a missed dispatch arm a ``TypeError`` mid-round. This rule makes
+the tie mechanical:
+
+- every ``_TAGS`` tag has an encode arm and a decode arm, and every arm's
+  tag exists in ``_TAGS`` (set equality, both directions);
+- every ``_TAGS`` message type name appears in at least one
+  ``isinstance(..., Type)`` / ``match``-class dispatch somewhere in the
+  analyzed files.
+
+The rule activates on any analyzed module that assigns a dict literal named
+``_TAGS`` with int values and defines ``decode`` — i.e. the wire module
+itself; trees without one simply skip the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+from akka_allreduce_tpu.analysis.core import Finding
+from akka_allreduce_tpu.analysis.rules import terminal_name
+
+_ENCODE_FUNCS = ("_encode_parts", "encode")
+_DECODE_FUNCS = ("decode",)
+
+
+def _find_tags(
+    tree: ast.AST,
+) -> tuple[ast.Dict, dict[str, int] | None] | None:
+    """The module's ``_TAGS`` dict assignment.
+
+    Returns ``None`` when the module has no ``_TAGS`` dict at all (the rule
+    does not apply), or ``(dict node, mapping)`` when it does —  with
+    ``mapping=None`` when the dict is not the statically-readable
+    ``{TypeName: int literal}`` shape. The unreadable case must surface as a
+    FINDING, never a silent rule shutdown: one computed tag value would
+    otherwise turn the whole exhaustiveness check off while lint stays
+    green."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        named = any(
+            isinstance(t, ast.Name) and t.id == "_TAGS" for t in targets
+        )
+        if not named or not isinstance(node.value, ast.Dict):
+            continue
+        mapping: dict[str, int] = {}
+        for key, value in zip(node.value.keys, node.value.values):
+            if key is None or not (
+                isinstance(value, ast.Constant) and isinstance(value.value, int)
+            ):
+                return node.value, None  # not statically readable
+            name = terminal_name(key)
+            if name is None:
+                return node.value, None
+            mapping[name] = value.value
+        if mapping:
+            return node.value, mapping
+    return None
+
+
+def _tag_arms(tree: ast.AST, func_names: tuple[str, ...]) -> set[int] | None:
+    """Int constants compared against ``tag`` (``tag == N`` / ``N == tag`` /
+    ``match tag: case N``) inside the highest-priority function of
+    ``func_names`` (earlier names win: ``_encode_parts`` is the arm-carrying
+    body, ``encode`` just joins its segments)."""
+    funcs = {
+        node.name: node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in func_names
+    }
+    for fname in func_names:
+        node = funcs.get(fname)
+        if node is not None:
+            arms: set[int] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Compare) and len(sub.ops) == 1 and isinstance(sub.ops[0], ast.Eq):
+                    sides = [sub.left, sub.comparators[0]]
+                    names = [terminal_name(s) for s in sides]
+                    consts = [
+                        s.value
+                        for s in sides
+                        if isinstance(s, ast.Constant)
+                        and isinstance(s.value, int)
+                    ]
+                    if "tag" in names and consts:
+                        arms.add(consts[0])
+                elif isinstance(sub, ast.Match) and terminal_name(sub.subject) == "tag":
+                    for case in sub.cases:
+                        pat = case.pattern
+                        if isinstance(pat, ast.MatchValue) and isinstance(
+                            pat.value, ast.Constant
+                        ):
+                            arms.add(pat.value.value)
+            return arms
+    return None
+
+
+def _dispatched_type_names(trees: dict[str, ast.AST]) -> set[str]:
+    """Every type name used as an ``isinstance`` classinfo (or a
+    ``match``-case class pattern) anywhere in the analyzed files."""
+    names: set[str] = set()
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "isinstance"
+                and len(node.args) == 2
+            ):
+                classinfo = node.args[1]
+                elts = (
+                    classinfo.elts
+                    if isinstance(classinfo, ast.Tuple)
+                    else [classinfo]
+                )
+                for e in elts:
+                    name = terminal_name(e)
+                    if name is not None:
+                        names.add(name)
+            elif isinstance(node, ast.MatchClass):
+                name = terminal_name(node.cls)
+                if name is not None:
+                    names.add(name)
+    return names
+
+
+def check_wire_exhaustiveness(
+    trees: dict[str, ast.AST], config: ArlintConfig
+) -> list[Finding]:
+    wire_path: str | None = None
+    tags_node: ast.Dict | None = None
+    tags: dict[str, int] | None = None
+    for path, tree in trees.items():
+        found = _find_tags(tree)
+        if found is not None:
+            wire_path, (tags_node, tags) = path, found
+            break
+    if wire_path is None or tags_node is None:
+        return []  # no wire module in this tree: rule does not apply
+    if tags is None:
+        return [
+            Finding(
+                wire_path,
+                tags_node.lineno,
+                "WIRE001",
+                "_TAGS is not a statically-readable {TypeName: int literal} "
+                "dict — exhaustiveness cannot be checked; keep tag values "
+                "literal (or suppress here with a justification)",
+            )
+        ]
+    tree = trees[wire_path]
+    findings: list[Finding] = []
+    by_tag = {v: k for k, v in tags.items()}
+    for kind, funcs in (("encode", _ENCODE_FUNCS), ("decode", _DECODE_FUNCS)):
+        arms = _tag_arms(tree, funcs)
+        if arms is None:
+            findings.append(
+                Finding(
+                    wire_path,
+                    tags_node.lineno,
+                    "WIRE001",
+                    f"no {kind} dispatch function ({'/'.join(funcs)}) found "
+                    f"alongside _TAGS",
+                )
+            )
+            continue
+        for name, tag in sorted(tags.items(), key=lambda kv: kv[1]):
+            if tag not in arms:
+                findings.append(
+                    Finding(
+                        wire_path,
+                        tags_node.lineno,
+                        "WIRE001",
+                        f"wire tag {tag} ({name}) has no 'tag == {tag}' arm "
+                        f"in {kind} dispatch — messages of this type "
+                        f"{'cannot be sent' if kind == 'encode' else 'raise unknown-tag on receive'}",
+                    )
+                )
+        for tag in sorted(arms - set(tags.values())):
+            findings.append(
+                Finding(
+                    wire_path,
+                    tags_node.lineno,
+                    "WIRE001",
+                    f"{kind} dispatch has an arm for tag {tag} which is not "
+                    f"in _TAGS — dead arm or missing _TAGS entry",
+                )
+            )
+    if len(trees) == 1:
+        # only the wire module itself was analyzed (e.g. `arlint
+        # control/wire.py` after editing it): the handler modules are not in
+        # the tree, so absence of dispatch arms proves nothing — the
+        # encode/decode arm checks above still ran, and the dispatch check
+        # runs on every whole-package scan (make lint, tier-1)
+        return findings
+    dispatched = _dispatched_type_names(trees)
+    for name, tag in sorted(tags.items(), key=lambda kv: kv[1]):
+        if name not in dispatched:
+            findings.append(
+                Finding(
+                    wire_path,
+                    tags_node.lineno,
+                    "WIRE001",
+                    f"message type {name} (wire tag {tag}) is decodable but "
+                    f"no isinstance/match dispatch arm in the analyzed tree "
+                    f"handles it — receivers will raise TypeError",
+                )
+            )
+    return findings

@@ -40,7 +40,11 @@ from akka_allreduce_tpu.control.failure import (
 )
 from akka_allreduce_tpu.control.grid_master import GridMaster
 from akka_allreduce_tpu.control.node import AllreduceNode
-from akka_allreduce_tpu.control.remote import RemoteTransport, run_periodic
+from akka_allreduce_tpu.control.remote import (
+    RemoteTransport,
+    observed_task,
+    run_periodic,
+)
 from akka_allreduce_tpu.control.worker import DataSink, DataSource
 
 log = logging.getLogger(__name__)
@@ -95,8 +99,8 @@ class MasterProcess:
     async def start(self) -> cl.Endpoint:
         ep = await self.transport.start()
         interval = self.config.master.heartbeat_interval_s
-        self._poll_task = asyncio.create_task(
-            run_periodic(interval, self._poll_detector)
+        self._poll_task = observed_task(
+            run_periodic(interval, self._poll_detector), name="master-detector"
         )
         log.info("master listening on %s", ep)
         return ep
@@ -402,7 +406,7 @@ class NodeProcess:
                 await self.transport.send(Envelope("master", join))
                 await asyncio.sleep(self.join_retry_s)
 
-        self._join_task = asyncio.create_task(join_until_welcomed())
+        self._join_task = observed_task(join_until_welcomed(), name="node-join")
 
     async def wait_welcomed(self, timeout: float = 10.0) -> int:
         await asyncio.wait_for(self._welcomed.wait(), timeout)
@@ -472,7 +476,9 @@ class NodeProcess:
                 self.node_id,
                 self._master_send_failures,
             )
-            self._rejoin_task = asyncio.ensure_future(self._rejoin_master())
+            self._rejoin_task = observed_task(
+                self._rejoin_master(), name="node-rejoin"
+            )
 
     async def _rejoin_master(self) -> None:
         """The master endpoint stopped answering: run the join handshake
@@ -528,7 +534,9 @@ class NodeProcess:
                     msg.reason,
                 )
                 self._rejoining = True
-                self._rejoin_task = asyncio.ensure_future(self._rejoin_master())
+                self._rejoin_task = observed_task(
+                    self._rejoin_master(), name="node-rejoin"
+                )
             return []
         raise TypeError(f"node cannot handle {type(msg).__name__}")
 
@@ -564,8 +572,9 @@ class NodeProcess:
             "node", lambda _nid, m: self._on_cluster_msg(m)
         )
         interval = self.config.master.heartbeat_interval_s
-        self._heartbeat_task = asyncio.create_task(
-            run_periodic(interval, self._send_heartbeat)
+        self._heartbeat_task = observed_task(
+            run_periodic(interval, self._send_heartbeat),
+            name=f"node-{msg.node_id}-heartbeat",
         )
         self._welcomed.set()
         log.info("node %d welcomed (dims=%d)", msg.node_id, dims)

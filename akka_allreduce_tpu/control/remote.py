@@ -73,6 +73,37 @@ def _byte_views(parts) -> list[memoryview]:
     ]
 
 
+def observed_task(coro, *, name: str) -> asyncio.Task:
+    """``create_task`` with a done-callback that logs a crashed task.
+
+    The event loop holds only a weak reference to tasks, and an un-retained
+    handle can be garbage-collected mid-flight; worse, a retained-but-never-
+    awaited background task (pump, writer, heartbeat ticker) that dies on an
+    unexpected exception dies SILENTLY — the transport just stops moving
+    messages. Every background spawn in this package goes through here
+    (arlint ASYNC003 enforces the shape), so a crash is at least an ERROR
+    log with the task's name before the silence. The task is also strongly
+    referenced in a module-level set until done — the helper must CLOSE the
+    weak-reference hole, not depend on every caller retaining the return
+    value."""
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _observed_tasks.add(task)
+
+    def _done(t: asyncio.Task) -> None:
+        _observed_tasks.discard(t)
+        if t.cancelled():
+            return  # cancellation is the normal teardown path
+        exc = t.exception()
+        if exc is not None:
+            log.error("background task %r died: %r", name, exc)
+
+    task.add_done_callback(_done)
+    return task
+
+
+_observed_tasks: set[asyncio.Task] = set()
+
+
 class _Frame:
     """One queued outbound frame: segments + the envelope(s) it carries."""
 
@@ -197,7 +228,12 @@ class _FrameReceiver(asyncio.BufferedProtocol):
     def get_buffer(self, sizehint: int) -> memoryview:
         if self._body is not None:
             return memoryview(self._body)[self._got : self._need]
-        return memoryview(self._ring)[self._rlen :]
+        # the BufferedProtocol contract REQUIRES handing out this view: the
+        # event loop recv_intos it and reports back via buffer_updated before
+        # the ring is ever parsed or compacted, so the view cannot outlive a
+        # recycle — and decoded messages never alias the ring (small bodies
+        # are copied out, large ones land in pooled per-frame buffers)
+        return memoryview(self._ring)[self._rlen :]  # arlint: disable=BUF001
 
     def buffer_updated(self, nbytes: int) -> None:
         owner = self._owner
@@ -335,7 +371,7 @@ class RemoteTransport:
             lambda: _FrameReceiver(self), self._host, self._port
         )
         self._port = self._server.sockets[0].getsockname()[1]
-        self._pump = asyncio.create_task(self._pump_inbox())
+        self._pump = observed_task(self._pump_inbox(), name="transport-pump")
         return self.endpoint
 
     @property
@@ -500,8 +536,8 @@ class RemoteTransport:
         sender.queued_bytes += nbytes
         loop = asyncio.get_running_loop()
         if sender.writer_task is None or sender.writer_task.done():
-            sender.writer_task = loop.create_task(
-                self._drain_sender(ep, sender)
+            sender.writer_task = observed_task(
+                self._drain_sender(ep, sender), name=f"writer-{ep}"
             )
         if sender.queued_bytes > self.write_buffer_high_water:
             # Bounded user-space buffering, with a DEADLINE: a dead peer
@@ -692,6 +728,13 @@ class RemoteTransport:
                 t0 = time.perf_counter()
                 out = handler(msg)
                 self.stage_seconds["handler"] += time.perf_counter() - t0
+            except asyncio.CancelledError:
+                # defense-in-depth for the arlint ASYNC004 shape: today the
+                # try body has no await (cancellation lands at the queue
+                # get / send_all instead), but a future await inside a
+                # handler must find teardown cancellation escaping, not
+                # absorbed into the broad handler-crash arm below
+                raise
             except Exception:
                 log.exception("handler for %s failed on %s", dest, type(msg).__name__)
                 msg = None

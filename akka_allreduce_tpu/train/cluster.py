@@ -33,6 +33,7 @@ import numpy as np
 from akka_allreduce_tpu.binder.elastic import ElasticAverageBinder
 from akka_allreduce_tpu.control.bootstrap import NodeProcess
 from akka_allreduce_tpu.control.cluster import Endpoint
+from akka_allreduce_tpu.control.remote import observed_task
 
 log = logging.getLogger(__name__)
 
@@ -130,7 +131,9 @@ class ElasticClusterNode:
             self.binder.elastic_rate,
         )
         steps = 0
-        shutdown = asyncio.ensure_future(self.node.run_until_shutdown())
+        shutdown = observed_task(
+            self.node.run_until_shutdown(), name="shutdown-watch"
+        )
         try:
             # A step budget is the node's own contract: train it to the end,
             # syncing while rounds last (the master finishing its round budget
