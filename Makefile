@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1
+.PHONY: lint lint-json baseline native test tier1 trace-demo
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -21,6 +21,13 @@ baseline:
 
 native:
 	$(MAKE) -C native
+
+# observability demo (OBSERVABILITY.md): run a tiny 2-process local cluster,
+# emit per-process Perfetto traces + metrics snapshots, and merge them into
+# trace_demo/trace.json (open at https://ui.perfetto.dev). The same flow is
+# asserted well-formed by tests/test_obs_cluster.py in tier-1.
+trace-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu obs demo --out-dir trace_demo
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -24,6 +24,42 @@ import sys
 import time
 
 
+def _add_obs_flags(p: argparse.ArgumentParser) -> None:
+    """Observability flags shared by the cluster roles (obs/ — OBSERVABILITY.md)."""
+    p.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="FILE",
+        help="write this process's spans as Chrome/Perfetto trace_event "
+        "JSON on exit (merge multiple processes' files with "
+        "`obs merge-trace`)",
+    )
+    p.add_argument(
+        "--flight-dir",
+        default=None,
+        metavar="DIR",
+        help="arm the flight recorder: dump a post-mortem JSONL here on "
+        "unhandled crash or SIGUSR1 (SIGUSR1 dumps, then kills the "
+        "process — kill-with-post-mortem); AKKA_OBS_DIR is the env "
+        "equivalent",
+    )
+
+
+def _install_obs(args) -> None:
+    if getattr(args, "flight_dir", None):
+        from akka_allreduce_tpu.obs import flight
+
+        flight.install(args.flight_dir, signal_exit=True)
+
+
+def _write_trace(args) -> None:
+    if getattr(args, "trace_out", None):
+        from akka_allreduce_tpu.obs import trace as obs_trace
+
+        path = obs_trace.write_chrome_trace(args.trace_out)
+        print(f"trace written to {path}", flush=True)
+
+
 def _add_wire_dtype_flag(p: argparse.ArgumentParser) -> None:
     """TCP wire compression for the host data plane (cluster masters only —
     the knob is distributed to every node via Welcome)."""
@@ -396,6 +432,13 @@ def _run_training(trainer, ds, args, *, label: str, flops_per_step=None) -> int:
     accum = getattr(args, "accum", 1)
     if accum < 1:
         raise SystemExit(f"--accum must be >= 1, got {accum}")
+    # trainer numbers feed the process registry too (OBSERVABILITY.md):
+    # step count / last loss / step time, MFU at the end
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    c_steps = REGISTRY.counter("trainer.steps")
+    g_loss = REGISTRY.gauge("trainer.loss")
+    h_step = REGISTRY.histogram("trainer.step_time_s")
     t0 = time.perf_counter()
     losses = []
     with profile:
@@ -407,6 +450,9 @@ def _run_training(trainer, ds, args, *, label: str, flops_per_step=None) -> int:
                 m = trainer.train_step(x, y)
             dt = time.perf_counter() - st
             losses.append(m.loss)
+            c_steps.inc()
+            g_loss.set(m.loss)
+            h_step.observe(dt)
             logger.log_event(
                 kind="train_step", workload=label, step=m.step, loss=m.loss,
                 contributors=m.contributors, step_time_s=round(dt, 6),
@@ -425,10 +471,14 @@ def _run_training(trainer, ds, args, *, label: str, flops_per_step=None) -> int:
         flops_per_step, total / max(len(losses), 1), trainer.n_devices
     )
     if perf:
+        if "mfu" in perf:
+            REGISTRY.gauge("trainer.mfu").set(perf["mfu"])
+        REGISTRY.gauge("trainer.tflops_per_s").set(perf["tflops_per_s"])
         logger.log_event(
             kind="train_summary", workload=label, steps=len(losses),
             host_loop=True, **perf,
         )
+    logger.log_snapshot(REGISTRY, workload=label)
     logger.close()
     trend = (
         f"loss {losses[0]:.4f} -> {np.mean(losses[-5:]):.4f}"
@@ -870,7 +920,13 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=1.0, help="interval (s)")
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
+    p.add_argument(
+        "--round-deadline", type=float, default=0.0,
+        help="stall watchdog: a round in flight longer than this many "
+        "seconds dumps the flight recorder (0 = off)",
+    )
     _add_wire_dtype_flag(p)
+    _add_obs_flags(p)
     args = p.parse_args(argv)
     from akka_allreduce_tpu.config import WorkerConfig
 
@@ -916,11 +972,13 @@ def _run_cluster_master(args) -> int:
             node_num=args.nodes,
             dimensions=args.dims,
             heartbeat_interval_s=args.heartbeat,
+            round_deadline_s=getattr(args, "round_deadline", 0.0),
         ),
         # both CLI node roles publish snapshots (fixed demo arrays / weights
         # replaced by reference), so the zero-copy scatter path is sound
         worker=WorkerConfig(zero_copy_scatter=True),
     )
+    _install_obs(args)
 
     async def run() -> None:
         metrics = MetricsLogger(args.metrics_out) if args.metrics_out else None
@@ -940,9 +998,13 @@ def _run_cluster_master(args) -> int:
         finally:
             await master.stop()
             if metrics is not None:
+                from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+                metrics.log_snapshot(REGISTRY, role="master")
                 metrics.close()
 
     asyncio.run(run())
+    _write_trace(args)
     return 0
 
 
@@ -964,8 +1026,10 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         "cpu_s/wall_s — the on-cpu/off-cpu partition of the round "
         "window)",
     )
+    _add_obs_flags(p)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
+    _install_obs(args)
 
     import asyncio
 
@@ -1044,6 +1108,7 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             flush=True,
         )
         if args.metrics_out:
+            from akka_allreduce_tpu.obs.metrics import REGISTRY
             from akka_allreduce_tpu.utils.metrics import MetricsLogger
 
             m = MetricsLogger(args.metrics_out)
@@ -1054,10 +1119,13 @@ def _cmd_cluster_node(argv: list[str]) -> int:
                 wire=wire_path,
                 **{k: round(v, 4) for k, v in stages.items()},
             )
+            m.log_snapshot(REGISTRY, role="node", node=nid)
             m.close()
         return 0
 
-    return asyncio.run(run())
+    rc = asyncio.run(run())
+    _write_trace(args)
+    return rc
 
 
 def _mlp_trainer(hidden, lr, seed=0):
@@ -2019,6 +2087,174 @@ def _cmd_soak(argv: list[str]) -> int:
     return 0
 
 
+def _cmd_obs(argv: list[str]) -> int:
+    """Observability toolbox: run the 2-process trace demo, inspect flight
+    dumps, merge per-process Perfetto traces (OBSERVABILITY.md)."""
+    p = argparse.ArgumentParser(
+        "obs",
+        description="observability tools: trace demo, flight-dump inspect, "
+        "trace merge",
+    )
+    sub = p.add_subparsers(dest="action", required=True)
+
+    d = sub.add_parser(
+        "demo",
+        help="run a tiny local cluster (master + N node processes), emit a "
+        "merged Perfetto trace + per-role metrics snapshots",
+    )
+    d.add_argument("--out-dir", default="trace_demo")
+    d.add_argument("--nodes", type=int, default=2)
+    d.add_argument("--rounds", type=int, default=3)
+    d.add_argument("--size", type=int, default=65536)
+    d.add_argument("--chunk", type=int, default=8192)
+
+    i = sub.add_parser(
+        "inspect", help="summarize a flight-recorder JSONL dump"
+    )
+    i.add_argument("file")
+
+    m = sub.add_parser(
+        "merge-trace",
+        help="merge per-process Chrome/Perfetto trace files into one",
+    )
+    m.add_argument("--out", required=True)
+    m.add_argument("inputs", nargs="+")
+
+    args = p.parse_args(argv)
+    import json
+
+    if args.action == "merge-trace":
+        from akka_allreduce_tpu.obs import trace as obs_trace
+
+        out = obs_trace.merge_chrome_traces(args.inputs, args.out)
+        print(f"merged {len(args.inputs)} trace file(s) into {out}")
+        return 0
+
+    if args.action == "inspect":
+        lines = []
+        with open(args.file) as f:
+            for ln in f:
+                if ln.strip():
+                    lines.append(json.loads(ln))
+        header = next(
+            (l for l in lines if l.get("kind") == "flight_header"), {}
+        )
+        state = next((l for l in lines if l.get("kind") == "state"), {})
+        metrics = next((l for l in lines if l.get("kind") == "metrics"), {})
+        spans = [l for l in lines if l.get("kind") == "span"]
+        events = [l for l in lines if l.get("kind") == "event"]
+        print(
+            json.dumps(
+                {
+                    "reason": header.get("reason"),
+                    "pid": header.get("pid"),
+                    "round_in_flight": state.get("worker.round_in_flight"),
+                    "last_transport_stage": state.get("transport.last_stage"),
+                    "stalled_round": state.get("watchdog.stalled_round"),
+                    "spans": len(spans),
+                    "events": len(events),
+                    "rounds_completed": metrics.get("worker.rounds_completed"),
+                    "dropped": {
+                        k.removeprefix("transport.dropped."): v
+                        for k, v in metrics.items()
+                        if k.startswith("transport.dropped.") and v
+                    },
+                },
+                indent=2,
+            )
+        )
+        return 0
+
+    # demo: one master + N nodes as real OS processes over loopback, each
+    # writing its own Perfetto trace; merged at the end so one allreduce
+    # round reads as a single timeline across every process
+    return _run_obs_demo(args)
+
+
+def _run_obs_demo(args) -> int:
+    import json
+    import os
+    import subprocess
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    traces = [os.path.join(args.out_dir, "trace-master.json")]
+    metrics_path = os.path.join(args.out_dir, "metrics-master.jsonl")
+    for f in (metrics_path, *traces):
+        if os.path.exists(f):
+            os.remove(f)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def spawn(*cli):
+        return subprocess.Popen(
+            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+
+    master = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(args.nodes),
+        "--rounds", str(args.rounds), "--size", str(args.size),
+        "--chunk", str(args.chunk), "--heartbeat", "0.1",
+        "--trace-out", traces[0], "--metrics-out", metrics_path,
+    )
+    nodes = []
+    try:
+        seed = None
+        for line in master.stdout:
+            if line.startswith("master listening on "):
+                seed = line.split()[-1]
+                break
+        if seed is None:
+            raise RuntimeError("master never reported its endpoint")
+        for k in range(args.nodes):
+            t = os.path.join(args.out_dir, f"trace-node{k}.json")
+            node_metrics = os.path.join(
+                args.out_dir, f"metrics-node{k}.jsonl"
+            )
+            # MetricsLogger appends: stale files from a previous demo run
+            # would mix two runs' records in one artifact
+            for f in (t, node_metrics):
+                if os.path.exists(f):
+                    os.remove(f)
+            traces.append(t)
+            nodes.append(
+                spawn(
+                    "cluster-node", "--seed", seed, "--trace-out", t,
+                    "--metrics-out", node_metrics,
+                )
+            )
+        master.communicate(timeout=120)
+        for n in nodes:
+            n.communicate(timeout=60)
+    finally:
+        for proc in [master, *nodes]:
+            if proc.poll() is None:
+                proc.kill()
+
+    from akka_allreduce_tpu.obs import trace as obs_trace
+
+    merged = obs_trace.merge_chrome_traces(
+        traces, os.path.join(args.out_dir, "trace.json")
+    )
+    with open(merged) as f:
+        events = json.load(f)["traceEvents"]
+    by_trace: dict[str, set] = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, set()).add(e["cat"])
+    full = [
+        t for t, cats in by_trace.items()
+        if {"line_master", "worker", "transport"} <= cats
+    ]
+    print(
+        f"demo: {len(events)} spans, {len(by_trace)} traces, "
+        f"{len(full)} round trace(s) spanning line_master+worker+transport"
+    )
+    print(f"merged Perfetto trace: {merged} (open at https://ui.perfetto.dev)")
+    print(f"metrics snapshots: {args.out_dir}/metrics-*.jsonl")
+    return 0 if full else 1
+
+
 COMMANDS = {
     "local-demo": _cmd_local_demo,
     "cluster-master": _cmd_cluster_master,
@@ -2039,6 +2275,7 @@ COMMANDS = {
     "train-pp": _cmd_train_pp,
     "lm-generate": _cmd_lm_generate,
     "elastic-demo": _cmd_elastic_demo,
+    "obs": _cmd_obs,
 }
 
 

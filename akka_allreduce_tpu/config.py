@@ -147,6 +147,11 @@ class MasterConfig:
     dimensions: int = 1  # grid dimensionality (2 => butterfly)
     heartbeat_interval_s: float = 1.0
     heartbeat_timeout_s: float = 5.0
+    # stall watchdog (obs.watchdog): a line round in flight longer than this
+    # dumps the flight recorder and counts a stall; 0 disables. Should be
+    # generously above the expected round latency — it exists to turn a hung
+    # run into a post-mortem artifact, not to police slow rounds.
+    round_deadline_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

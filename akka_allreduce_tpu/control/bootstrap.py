@@ -66,11 +66,26 @@ class MasterProcess:
         self.config = config
         self.clock = clock
         self.metrics = metrics
+        self.watchdog = None
+        if config.master.round_deadline_s > 0:
+            from akka_allreduce_tpu.obs.watchdog import RoundWatchdog
+
+            self.watchdog = RoundWatchdog(
+                config.master.round_deadline_s, clock=clock
+            )
         self.grid = GridMaster(
             config.threshold,
             config.master,
             config.line_master,
-            on_round_complete=self._on_round_complete if metrics else None,
+            on_round_complete=(
+                self._on_round_complete if (metrics or self.watchdog) else None
+            ),
+            on_round_start=(
+                self.watchdog.round_started if self.watchdog else None
+            ),
+            # a re-mesh abandons the replaced lines' rounds by design —
+            # their deadlines must retire with them, not fire as stalls
+            on_reorganize=(self.watchdog.reset if self.watchdog else None),
         )
         self.monitor = HeartbeatMonitor(
             PhiAccrualFailureDetector(
@@ -102,10 +117,14 @@ class MasterProcess:
         self._poll_task = observed_task(
             run_periodic(interval, self._poll_detector), name="master-detector"
         )
+        if self.watchdog is not None:
+            self.watchdog.start()  # its own observed_task poll loop
         log.info("master listening on %s", ep)
         return ep
 
     async def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self._poll_task is not None:
             self._poll_task.cancel()
             try:
@@ -274,17 +293,21 @@ class MasterProcess:
         self, line_id: int, r: int, latency_s: float, done: int, n: int
     ) -> None:
         """Per-round observability (SURVEY.md §6): one JSONL record per
-        completed line-round — latency, contributors at threshold, config."""
-        self.metrics.log_event(
-            kind="round",
-            line=line_id,
-            round=r,
-            latency_s=round(latency_s, 6),
-            completions=done,
-            workers=n,
-            config=self.grid.config_id,
-            data_bytes=self.config.metadata.data_size * 4,
-        )
+        completed line-round — latency, contributors at threshold, config —
+        and the watchdog's completion signal (retires the round's deadline)."""
+        if self.watchdog is not None:
+            self.watchdog.round_completed(line_id, r)
+        if self.metrics is not None:
+            self.metrics.log_event(
+                kind="round",
+                line=line_id,
+                round=r,
+                latency_s=round(latency_s, 6),
+                completions=done,
+                workers=n,
+                config=self.grid.config_id,
+                data_bytes=self.config.metadata.data_size * 4,
+            )
 
     def _address_book(self) -> cl.AddressBook:
         return cl.AddressBook(

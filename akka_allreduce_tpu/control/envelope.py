@@ -24,8 +24,15 @@ class Envelope:
     resolving ``dest`` through the route table — used for replies to peers
     that are not (yet) in any address book, e.g. the Welcome to a joiner.
     Local routers ignore it.
+
+    ``trace``, when set, pins the trace context this message propagates
+    (``obs.trace.TraceContext``); when ``None`` the transport stamps the
+    CURRENT context at send time — so replies built inside a handler
+    inherit the inbound message's round trace without every handler
+    knowing tracing exists.
     """
 
     dest: str
     msg: Any
     via: Any = None  # control.cluster.Endpoint | None
+    trace: Any = None  # obs.trace.TraceContext | None

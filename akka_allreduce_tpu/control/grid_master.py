@@ -24,10 +24,14 @@ from akka_allreduce_tpu.config import (
 )
 from akka_allreduce_tpu.control.envelope import Envelope
 from akka_allreduce_tpu.control.line_master import LineMaster
+from akka_allreduce_tpu.obs import metrics as obs_metrics
+from akka_allreduce_tpu.obs import trace as obs_trace
 from akka_allreduce_tpu.parallel.mesh import grid_factors
 from akka_allreduce_tpu.protocol import CompleteAllreduce, ConfirmPreparation
 
 log = logging.getLogger(__name__)
+
+_REORGANIZATIONS = obs_metrics.counter("master.reorganizations")
 
 
 def dim_worker_id(node_id: int, dim: int, dims: int) -> int:
@@ -44,11 +48,15 @@ class GridMaster:
         line_master_config: LineMasterConfig = LineMasterConfig(),
         *,
         on_round_complete=None,  # LineMaster RoundObserver, fanned to all lines
+        on_round_start=None,  # LineMaster RoundStartObserver, same fan-out
+        on_reorganize=None,  # called when a reorganization replaces the lines
     ) -> None:
         self.threshold = threshold
         self.config = config
         self.line_master_config = line_master_config
         self.on_round_complete = on_round_complete
+        self.on_round_start = on_round_start
+        self.on_reorganize = on_reorganize
         self.nodes: set[int] = set()
         self.config_id = 0
         self.organized = False
@@ -89,6 +97,10 @@ class GridMaster:
                 lm.total_completed for lm in self.line_masters.values()
             )
             self.organized = False
+            for lm in self.line_masters.values():
+                lm.abandon_open_spans()
+            if self.on_reorganize is not None:
+                self.on_reorganize()
             self.line_masters.clear()
             self._line_of_worker.clear()
             return []
@@ -116,7 +128,16 @@ class GridMaster:
                 lm.total_completed for lm in self.line_masters.values()
             )
         self.config_id += 1
+        _REORGANIZATIONS.inc()
         self.organized = True
+        # the replaced lines' in-flight rounds are abandoned BY DESIGN:
+        # close their open trace spans (else the round roots vanish
+        # unrecorded) and let any watchdog retire their deadlines (else
+        # every re-mesh reads as a stall)
+        for lm in self.line_masters.values():
+            lm.abandon_open_spans()
+        if self.on_reorganize is not None:
+            self.on_reorganize()
         self.line_masters.clear()
         self._line_of_worker.clear()
         nodes = sorted(self.nodes)
@@ -146,6 +167,7 @@ class GridMaster:
                 self.line_master_config,
                 line_id=line_id,
                 on_round_complete=self.on_round_complete,
+                on_round_start=self.on_round_start,
             )
             self.line_masters[line_id] = lm
             for w in worker_ids:
@@ -173,6 +195,14 @@ class GridMaster:
         lm = self.line_masters.get(line_id)
         if lm is None:
             return []
+        ctx = obs_trace.current()
+        if ctx is not None and ctx.sampled and obs_trace.enabled():
+            # the grid-master layer of the round trace: dispatch of a
+            # worker's confirm/complete back into the owning line
+            with obs_trace.span(
+                "grid_master.dispatch", line=line_id, msg=type(msg).__name__
+            ):
+                return lm.handle(msg)
         return lm.handle(msg)
 
     def handle(self, msg: Any) -> list[Envelope]:

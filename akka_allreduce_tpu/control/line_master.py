@@ -15,6 +15,8 @@ from typing import Any, Callable
 
 from akka_allreduce_tpu.config import LineMasterConfig, ThresholdConfig
 from akka_allreduce_tpu.control.envelope import Envelope, peer_addr
+from akka_allreduce_tpu.obs import metrics as obs_metrics
+from akka_allreduce_tpu.obs import trace as obs_trace
 from akka_allreduce_tpu.protocol import (
     CompleteAllreduce,
     ConfirmPreparation,
@@ -26,6 +28,12 @@ log = logging.getLogger(__name__)
 
 # (line_id, round_num, latency_s, completions at threshold, n_workers)
 RoundObserver = Callable[[int, int, float, int, int], None]
+# (line_id, round_num) at the moment StartAllreduce envelopes are built
+RoundStartObserver = Callable[[int, int], None]
+
+_ROUNDS_COMPLETED = obs_metrics.counter("master.rounds_completed")
+_ROUND_LATENCY = obs_metrics.histogram("master.round_latency_s")
+_ROUNDS_ABANDONED = obs_metrics.counter("master.rounds_abandoned")
 
 
 class LineMaster:
@@ -39,13 +47,19 @@ class LineMaster:
         *,
         clock: Callable[[], float] = time.monotonic,
         on_round_complete: RoundObserver | None = None,
+        on_round_start: RoundStartObserver | None = None,
     ) -> None:
         self.threshold = threshold
         self.config = config
         self.line_id = line_id
         self.clock = clock
         self.on_round_complete = on_round_complete
+        self.on_round_start = on_round_start
         self._started_at: dict[int, float] = {}
+        # round -> open root span: this line master is where a round's
+        # trace is BORN — the id stamped onto the StartAllreduce envelopes
+        # is the one every downstream hop inherits
+        self._round_spans: dict[int, obs_trace.Span] = {}
         self.worker_ids: tuple[int, ...] = ()
         self.config_id: int = -1
         self.next_round = 0  # next round number to start
@@ -63,6 +77,17 @@ class LineMaster:
         self._prepared_at = 0.0
 
     # -- configuration / handshake ------------------------------------------
+
+    def abandon_open_spans(self) -> None:
+        """End every still-open round span as abandoned — called when this
+        line master is superseded by a grid reorganization, so in-flight
+        rounds' root spans reach the trace buffer (and the abandoned
+        counter) instead of being silently GC'd with the instance."""
+        for span in self._round_spans.values():
+            _ROUNDS_ABANDONED.inc()
+            span.set(abandoned=True, reorganized=True)
+            span.end()
+        self._round_spans.clear()
 
     def prepare(
         self,
@@ -160,19 +185,28 @@ class LineMaster:
         # round complete at threshold; abandon older in-flight rounds
         self.completed_up_to = max(self.completed_up_to, r)
         self.total_completed += 1
+        _ROUNDS_COMPLETED.inc()
+        started = self._started_at.get(r)
+        latency = self.clock() - started if started is not None else -1.0
+        if latency >= 0:
+            _ROUND_LATENCY.observe(latency)
         if self.on_round_complete is not None:
-            started = self._started_at.get(r)
             self.on_round_complete(
-                self.line_id,
-                r,
-                self.clock() - started if started is not None else -1.0,
-                len(done),
-                self.n_workers,
+                self.line_id, r, latency, len(done), self.n_workers
             )
+        span = self._round_spans.pop(r, None)
+        if span is not None:
+            span.set(completions=len(done))
+            span.end()
         for stale in [x for x in self.started_rounds if x <= r]:
             self.started_rounds.discard(stale)
             self.completions.pop(stale, None)
             self._started_at.pop(stale, None)
+            stale_span = self._round_spans.pop(stale, None)
+            if stale_span is not None:
+                _ROUNDS_ABANDONED.inc()
+                stale_span.set(abandoned=True)
+                stale_span.end()
         return self._fill_window()
 
     # -- round window --------------------------------------------------------
@@ -191,10 +225,25 @@ class LineMaster:
             r = self.next_round
             self.next_round += 1
             self.started_rounds.add(r)
-            if self.on_round_complete is not None:
-                self._started_at[r] = self.clock()
+            self._started_at[r] = self.clock()
+            # the round's trace is minted HERE: one fresh trace id, a
+            # line_master.round root span that stays open until the
+            # threshold completion, and the context stamped onto every
+            # StartAllreduce so workers/transports continue the same trace
+            span = obs_trace.start_span(
+                "line_master.round",
+                root=True,  # fresh trace id per round, never a child of the
+                # completion handler's ambient context
+                line=self.line_id,
+                round=r,
+                config=self.config_id,
+            )
+            self._round_spans[r] = span
+            if self.on_round_start is not None:
+                self.on_round_start(self.line_id, r)
             out.extend(
-                Envelope(peer_addr(w), StartAllreduce(r)) for w in self.worker_ids
+                Envelope(peer_addr(w), StartAllreduce(r), trace=span.context)
+                for w in self.worker_ids
             )
         return out
 

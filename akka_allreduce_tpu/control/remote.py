@@ -38,14 +38,33 @@ import asyncio
 import logging
 import socket
 import time
+import weakref
 from collections import deque
 from typing import Any, Awaitable, Callable
 
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.control.cluster import Endpoint
 from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.obs import flight as _flight
+from akka_allreduce_tpu.obs import metrics as _metrics
+from akka_allreduce_tpu.obs import trace as _trace
 
 log = logging.getLogger(__name__)
+
+# Silent-loss accounting (OBSERVABILITY.md): every drop path increments a
+# registry counter alongside the per-transport ``dropped`` total, so message
+# loss is countable per CAUSE across the process. Module-level: counter
+# lookups stay off the hot path.
+_DROP_UNDECODABLE = _metrics.counter("transport.dropped.undecodable")
+_DROP_NO_ROUTE = _metrics.counter("transport.dropped.no_route")
+_DROP_NO_HANDLER = _metrics.counter("transport.dropped.no_handler")
+_DROP_OVERSIZE = _metrics.counter("transport.dropped.oversize_frame")
+_DROP_EMPTY = _metrics.counter("transport.dropped.empty_frame")
+_DROP_FILTERED = _metrics.counter("transport.dropped.drop_filter")
+_DROP_BACKPRESSURE = _metrics.counter("transport.dropped.backpressure")
+_DROP_SEND_FAILED = _metrics.counter("transport.dropped.send_failed")
+_DELIVERED = _metrics.counter("transport.delivered")
+_HANDLER_ERRORS = _metrics.counter("transport.handler_errors")
 
 Handler = Callable[[Any], list[Envelope]]
 PrefixHandler = Callable[[int, Any], list[Envelope]]
@@ -102,6 +121,32 @@ def observed_task(coro, *, name: str) -> asyncio.Task:
 
 
 _observed_tasks: set[asyncio.Task] = set()
+
+
+# Every live transport's per-instance accounting, folded into REGISTRY
+# snapshots by one pull-time collector: the hot paths keep their plain dict
+# float-adds, the registry absorbs them only when somebody asks.
+_live_transports: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _collect_transport_stats() -> dict:
+    stages: dict[str, float] = {}
+    delivered = dropped = 0
+    for t in list(_live_transports):
+        for k, v in t.stage_seconds.items():
+            stages[k] = stages.get(k, 0.0) + v
+        delivered += t.delivered
+        dropped += t.dropped
+    out = {
+        f"transport.stage_seconds.{k}": round(v, 6) for k, v in stages.items()
+    }
+    out["transport.instances"] = len(list(_live_transports))
+    out["transport.delivered_live"] = delivered
+    out["transport.dropped_live"] = dropped
+    return out
+
+
+_metrics.REGISTRY.register_collector(_collect_transport_stats)
 
 
 class _Frame:
@@ -263,11 +308,13 @@ class _FrameReceiver(asyncio.BufferedProtocol):
                     owner.max_frame_bytes,
                 )
                 owner.dropped += 1
+                _DROP_OVERSIZE.inc()
                 assert self._transport is not None
                 self._transport.close()
                 return
             if length == 0:
                 owner.dropped += 1  # vacuous frame: nothing to decode
+                _DROP_EMPTY.inc()
                 pos += 4
                 continue
             if length > self._SMALL_BODY_MAX:
@@ -300,17 +347,21 @@ class _FrameReceiver(asyncio.BufferedProtocol):
         owner = self._owner
         try:
             t0 = time.perf_counter()
-            dest, msg = wire.decode_frame_body(memoryview(buf)[:need])
+            dest, msg, tctx = wire.decode_frame_body_ex(
+                memoryview(buf)[:need]
+            )
             owner.stage_seconds["decode"] += time.perf_counter() - t0
+            _flight.set_state("transport.last_stage", "decode")
         except Exception as exc:  # malformed body: drop THIS frame
             # framing is length-prefixed, so the stream stays in sync —
             # one bad message must not kill the connection
             log.warning("undecodable frame (%s); dropping", exc)
             owner.dropped += 1
+            _DROP_UNDECODABLE.inc()
             if pooled is not None:
                 owner._release_recv_buf(pooled)
             return
-        owner._inbox.put_nowait((dest, msg, pooled))
+        owner._inbox.put_nowait((dest, msg, pooled, tctx))
 
 
 class RemoteTransport:
@@ -362,6 +413,9 @@ class RemoteTransport:
             "decode": 0.0,  # wire.decode_frame_body (views into recv buffer)
             "handler": 0.0,  # engine: buffer store/reduce + replies built
         }
+        # the registry sees this transport's stage/drop totals at snapshot
+        # time (pull-model collector — zero registry writes on the hot path)
+        _live_transports.add(self)
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -492,20 +546,29 @@ class RemoteTransport:
     async def send(self, env: Envelope) -> None:
         if self.drop_filter is not None and self.drop_filter(env):
             self.dropped += 1
+            _DROP_FILTERED.inc()
             return
+        # the round trace rides every hop: an explicit envelope context
+        # wins, otherwise the CURRENT context (set by the pump around the
+        # handler that built this reply) propagates
+        tctx = env.trace if env.trace is not None else _trace.current()
         if env.via is None:
             handler = self._local_handler(env.dest)
             if handler is not None:  # local delivery: no wire, same FIFO inbox
-                await self._inbox.put((env.dest, env.msg, None))
+                await self._inbox.put((env.dest, env.msg, None, tctx))
                 return
         ep = env.via if env.via is not None else self._resolve(env.dest)
         if ep is None:
             log.warning("no route for %s; dropping", env.dest)
             self.dropped += 1
+            _DROP_NO_ROUTE.inc()
             return
         t0 = time.perf_counter()
-        parts = wire.encode_frame_parts(env.dest, env.msg, f16=self.wire_f16)
+        parts = wire.encode_frame_parts(
+            env.dest, env.msg, f16=self.wire_f16, trace=tctx
+        )
         self.stage_seconds["encode"] += time.perf_counter() - t0
+        _flight.set_state("transport.last_stage", "encode")
         sender = self._senders.get(ep)
         if sender is None or sender.closed:
             sender = self._senders[ep] = _Sender()
@@ -565,6 +628,7 @@ class RemoteTransport:
                 sender.queued_bytes -= frame.nbytes
                 for e in frame.envs:
                     self.dropped += 1
+                    _DROP_BACKPRESSURE.inc()
                     if self.on_send_error is not None:
                         self.on_send_error(ep, e)
 
@@ -653,6 +717,7 @@ class RemoteTransport:
         for frame in frames:
             for env in frame.envs:
                 self.dropped += 1
+                _DROP_SEND_FAILED.inc()
                 if self.on_send_error is not None:
                     self.on_send_error(ep, env)
 
@@ -699,6 +764,7 @@ class RemoteTransport:
                     self.stage_seconds["socket_write"] += (
                         time.perf_counter() - t0
                     )
+                    _flight.set_state("transport.last_stage", "socket_write")
                 sender.retry_ok = True
                 for frame in batch:
                     sender.queue.popleft()
@@ -714,39 +780,72 @@ class RemoteTransport:
     # -- receiving ----------------------------------------------------------------
 
     async def _pump_inbox(self) -> None:
-        """Single consumer: every handler runs one message at a time."""
+        """Single consumer: every handler runs one message at a time.
+
+        Each delivery runs under the message's trace context (set for the
+        handler AND the replies it sends, so the round trace propagates
+        hop to hop), wrapped in a ``transport.handle`` span when the
+        context is sampled — the per-node transport layer of the merged
+        round timeline.
+        """
         while True:
-            dest, msg, buf = await self._inbox.get()
+            dest, msg, buf, tctx = await self._inbox.get()
             handler = self._local_handler(dest)
             if handler is None:
                 log.warning("no handler for %s; dropping", dest)
                 self.dropped += 1
+                _DROP_NO_HANDLER.inc()
                 if buf is not None:
                     self._release_recv_buf(buf)
                 continue
+            # the whole delivery — handler AND the replies it returns —
+            # runs under the message's context; one token reset restores
+            # the pre-delivery state on every exit path
+            token = _trace._current.set(tctx)
             try:
-                t0 = time.perf_counter()
-                out = handler(msg)
-                self.stage_seconds["handler"] += time.perf_counter() - t0
-            except asyncio.CancelledError:
-                # defense-in-depth for the arlint ASYNC004 shape: today the
-                # try body has no await (cancellation lands at the queue
-                # get / send_all instead), but a future await inside a
-                # handler must find teardown cancellation escaping, not
-                # absorbed into the broad handler-crash arm below
-                raise
-            except Exception:
-                log.exception("handler for %s failed on %s", dest, type(msg).__name__)
+                hspan = (
+                    _trace.start_span(
+                        "transport.handle", msg=type(msg).__name__
+                    )
+                    if tctx is not None and tctx.sampled and _trace.enabled()
+                    else None
+                )
+                if hspan is not None:
+                    _trace._current.set(hspan.context)
+                try:
+                    t0 = time.perf_counter()
+                    out = handler(msg)
+                    self.stage_seconds["handler"] += time.perf_counter() - t0
+                    _flight.set_state("transport.last_stage", "handler")
+                except asyncio.CancelledError:
+                    # defense-in-depth for the arlint ASYNC004 shape: today
+                    # the try body has no await (cancellation lands at the
+                    # queue get / send_all instead), but a future await
+                    # inside a handler must find teardown cancellation
+                    # escaping, not absorbed into the broad handler-crash
+                    # arm below
+                    raise
+                except Exception:
+                    log.exception(
+                        "handler for %s failed on %s", dest, type(msg).__name__
+                    )
+                    _HANDLER_ERRORS.inc()
+                    msg = None
+                    if buf is not None:
+                        self._release_recv_buf(buf)
+                    continue
+                finally:
+                    if hspan is not None:
+                        hspan.end()
+                self.delivered += 1
+                _DELIVERED.inc()
+                # drop our reference to the decoded payload views BEFORE
+                # recycling; the export check in _release_recv_buf protects
+                # against anything the handler (or the replies) retained
                 msg = None
-                if buf is not None:
-                    self._release_recv_buf(buf)
-                continue
-            self.delivered += 1
-            # drop our reference to the decoded payload views BEFORE
-            # recycling; the export check in _release_recv_buf protects
-            # against anything the handler (or the replies) retained
-            msg = None
-            await self.send_all(out)
+                await self.send_all(out)
+            finally:
+                _trace._current.reset(token)
             if buf is not None:
                 self._release_recv_buf(buf)
 

@@ -274,11 +274,62 @@ def decode(data: bytes | memoryview) -> Any:
     raise ValueError(f"unknown wire tag {tag}")
 
 
+# -- trace-context trailer -----------------------------------------------------
+#
+# Version-skew-compatible by construction (reserved-BYTES encoding, not a new
+# tag): a frame carrying trace context appends
+#   [u64 trace_id][u64 span_id][u8 flags][8-byte magic]
+# AFTER the message body. Every per-tag decode arm reads exactly the bytes it
+# needs and ignores anything after them (the payload tags bound-check
+# `offset + payload <= len`, never `==` — native and fallback paths alike),
+# so a decoder built BEFORE this trailer existed accepts trailered frames
+# unchanged, and this decoder accepts trailer-less frames (no magic -> no
+# context). tests/test_wire_roundtrip.py ratchets both directions over every
+# tag. The magic ends the frame (constant offset from the end — no length
+# field to trust) and an accidental 8-byte collision in payload data is a
+# 2^-64 event whose worst case is one dropped frame (at-most-once absorbs it).
+
+_TRACE_STRUCT = struct.Struct("<QQB")
+_TRACE_MAGIC = b"\x00\xf7aRtC\x9e\x01"
+_TRACE_LEN = _TRACE_STRUCT.size + len(_TRACE_MAGIC)
+_TRACE_SAMPLED = 0x01
+
+
+def encode_trace(trace) -> bytes:
+    """Trace context (``obs.trace.TraceContext`` or (trace_id, span_id,
+    sampled) triple) -> wire trailer bytes."""
+    trace_id, span_id, sampled = trace
+    return (
+        _TRACE_STRUCT.pack(
+            trace_id & 0xFFFF_FFFF_FFFF_FFFF,
+            span_id & 0xFFFF_FFFF_FFFF_FFFF,
+            _TRACE_SAMPLED if sampled else 0,
+        )
+        + _TRACE_MAGIC
+    )
+
+
+def split_trace(buf: memoryview):
+    """``(message bytes view, trace context | None)`` for a frame body whose
+    dest prefix is already consumed."""
+    n = len(buf)
+    if n >= _TRACE_LEN + 1 and bytes(buf[n - 8 : n]) == _TRACE_MAGIC:
+        trace_id, span_id, flags = _TRACE_STRUCT.unpack_from(
+            buf, n - _TRACE_LEN
+        )
+        from akka_allreduce_tpu.obs.trace import TraceContext
+
+        return buf[: n - _TRACE_LEN], TraceContext(
+            trace_id, span_id, bool(flags & _TRACE_SAMPLED)
+        )
+    return buf, None
+
+
 def encode_frame_parts(
-    dest: str, msg: Any, *, f16: bool = False
+    dest: str, msg: Any, *, f16: bool = False, trace=None
 ) -> list[bytes | memoryview]:
     """Framed envelope as scatter-gather segments:
-    ``[u32 len][u16 dest_len][dest][tag][body...]``.
+    ``[u32 len][u16 dest_len][dest][tag][body...][trace trailer?]``.
 
     The float payload stays a ``memoryview`` of the caller's array — NO
     payload-sized copy happens here or anywhere on the send path: the
@@ -286,21 +337,32 @@ def encode_frame_parts(
     so the kernel gathers them. The payload memory must stay unmodified
     until the send completes (the engine's frozen-after-reduce buffers and
     snapshot-publishing sources guarantee this). ``f16`` sends float
-    payloads at half width (decode side is automatic)."""
+    payloads at half width (decode side is automatic). ``trace`` appends
+    the 25-byte trace-context trailer (see above — old decoders ignore
+    it)."""
     parts: list[Any] = [b"", _pack_str(dest), *_encode_parts(msg, f16)]
+    if trace is not None:
+        parts.append(encode_trace(trace))
     body_len = sum(len(p) for p in parts)
     parts[0] = _U32.pack(body_len)
     return parts
 
 
-def encode_frame(dest: str, msg: Any, *, f16: bool = False) -> bytes:
+def encode_frame(dest: str, msg: Any, *, f16: bool = False, trace=None) -> bytes:
     """``encode_frame_parts`` joined to one buffer (compat / tests — the
     transport itself sends the segments unjoined)."""
-    return b"".join(encode_frame_parts(dest, msg, f16=f16))
+    return b"".join(encode_frame_parts(dest, msg, f16=f16, trace=trace))
 
 
 def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:
     """Inverse of ``encode_frame`` minus the length prefix."""
+    dest, msg, _ = decode_frame_body_ex(body)
+    return dest, msg
+
+
+def decode_frame_body_ex(body: bytes | memoryview):
+    """``(dest, message, trace context | None)`` — the transport's decode."""
     buf = memoryview(body)
     dest, off = _unpack_str(buf, 0)
-    return dest, decode(buf[off:])
+    rest, trace = split_trace(buf[off:])
+    return dest, decode(rest), trace

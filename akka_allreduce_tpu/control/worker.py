@@ -31,6 +31,9 @@ from akka_allreduce_tpu.config import (
     WorkerConfig,
 )
 from akka_allreduce_tpu.control.envelope import Envelope, master_addr, peer_addr
+from akka_allreduce_tpu.obs import flight as obs_flight
+from akka_allreduce_tpu.obs import metrics as obs_metrics
+from akka_allreduce_tpu.obs import trace as obs_trace
 from akka_allreduce_tpu.protocol import (
     AllReduceInput,
     AllReduceInputRequest,
@@ -47,6 +50,12 @@ log = logging.getLogger(__name__)
 
 DataSource = Callable[[AllReduceInputRequest], AllReduceInput]
 DataSink = Callable[[AllReduceOutput], None]
+
+# process-wide worker stats (summed over a node's per-dimension workers):
+# the in-flight round gauge is what a flight-recorder dump names first
+_ROUNDS_COMPLETED = obs_metrics.counter("worker.rounds_completed")
+_DROPPED = obs_metrics.counter("worker.dropped_messages")
+_ROUND_IN_FLIGHT = obs_metrics.gauge("worker.round_in_flight")
 
 
 class AllreduceWorker:
@@ -167,7 +176,19 @@ class AllreduceWorker:
                 )
             else:  # stale round: already completed locally
                 self.dropped_messages += 1
+                _DROPPED.inc()
                 return []
+        # the round this worker is actively working on — the first thing a
+        # flight-recorder post-mortem wants to know
+        _ROUND_IN_FLIGHT.set(r)
+        obs_flight.set_state("worker.round_in_flight", r)
+        with obs_trace.span(
+            "worker.round_start", worker=self.worker_id, round=r
+        ):
+            return self._scatter_round(msg)
+
+    def _scatter_round(self, msg: StartAllreduce) -> list[Envelope]:
+        r = msg.round_num
         data = self.data_source(AllReduceInputRequest(r)).data
         meta = self.metadata
         assert meta is not None
@@ -216,20 +237,27 @@ class AllreduceWorker:
             buf = rounds.scattered(r)
         except RoundOutOfWindowError:
             self.dropped_messages += 1
+            _DROPPED.inc()
             return []
         crossed = buf.store(msg.value, msg.src_id, msg.chunk_id)
         if not crossed:
             return []
-        value, count = buf.reduce(msg.chunk_id)
-        my_rank = self.peer_ids.index(self.worker_id)
-        out: list[Envelope] = []
-        for dest_id in self.peer_ids:
-            rb = ReduceBlock(value, my_rank, 0, msg.chunk_id, r, count)
-            if dest_id == self.worker_id:
-                out.extend(self._on_reduce(rb))
-            else:
-                out.append(Envelope(peer_addr(dest_id), rb))
-        return out
+        with obs_trace.span(
+            "worker.reduce",
+            worker=self.worker_id,
+            round=r,
+            chunk=msg.chunk_id,
+        ):
+            value, count = buf.reduce(msg.chunk_id)
+            my_rank = self.peer_ids.index(self.worker_id)
+            out: list[Envelope] = []
+            for dest_id in self.peer_ids:
+                rb = ReduceBlock(value, my_rank, 0, msg.chunk_id, r, count)
+                if dest_id == self.worker_id:
+                    out.extend(self._on_reduce(rb))
+                else:
+                    out.append(Envelope(peer_addr(dest_id), rb))
+            return out
 
     def _on_reduce(self, msg: ReduceBlock) -> list[Envelope]:
         rounds = self._require_ready()
@@ -238,16 +266,27 @@ class AllreduceWorker:
             buf = rounds.reduced(r)
         except RoundOutOfWindowError:
             self.dropped_messages += 1
+            _DROPPED.inc()
             return []
         buf.store(msg.value, msg.src_id, msg.chunk_id, msg.count)
         if not buf.reach_completion_threshold():
             return []
         # copy=False: the round is evicted on the next line, so the flushed
         # view's storage is never written again
-        data, counts = buf.get_with_counts(copy=False)
-        rounds.complete(r)  # evicts this round AND abandons older in-flight ones
-        self.completed_rounds += 1
-        self.data_sink(AllReduceOutput(data, counts, r))
+        with obs_trace.span(
+            "worker.flush", worker=self.worker_id, round=r
+        ):
+            data, counts = buf.get_with_counts(copy=False)
+            rounds.complete(r)  # evicts this round AND abandons older ones
+            self.completed_rounds += 1
+            self.data_sink(AllReduceOutput(data, counts, r))
+        _ROUNDS_COMPLETED.inc()
+        obs_flight.set_state("worker.last_completed_round", r)
+        # between rounds nothing is in flight: a post-mortem taken now must
+        # not misdirect the operator to a round that actually completed
+        if obs_flight.get_state("worker.round_in_flight") == r:
+            _ROUND_IN_FLIGHT.set(-1)
+            obs_flight.set_state("worker.round_in_flight", None)
         my_id = self.worker_id
         assert my_id is not None
         if (

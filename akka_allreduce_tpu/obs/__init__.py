@@ -1,0 +1,25 @@
+"""Observability layer: tracing, metrics, and the flight recorder.
+
+Zero-dependency (stdlib only) and safe to import from every layer — the
+control plane, the trainers, and the CLI all feed the same three pillars:
+
+- ``obs.metrics`` — a process-wide registry of counters / gauges /
+  fixed-bucket histograms (lock-cheap, allocation-free on the hot path,
+  ``snapshot()``-to-dict for JSONL sinks).
+- ``obs.trace`` — spans with round-scoped trace IDs that propagate across
+  the TCP control plane (an optional trailer on every wire frame), plus a
+  Chrome/Perfetto ``trace_event`` JSON exporter so a multi-process run
+  renders as one timeline.
+- ``obs.flight`` — an always-on fixed-size ring of recent spans/events,
+  dumped to JSONL on unhandled crash, on ``SIGUSR1``, and when the round
+  watchdog (``obs.watchdog``) sees a round exceed its deadline.
+
+See OBSERVABILITY.md for the span model, metric naming convention, and the
+flight-recorder dump format.
+"""
+
+from __future__ import annotations
+
+from akka_allreduce_tpu.obs import flight, metrics, trace, watchdog
+
+__all__ = ["flight", "metrics", "trace", "watchdog"]

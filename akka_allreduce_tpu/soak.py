@@ -148,12 +148,21 @@ def run_soak(
 
     step_ms: list[float] = []
     losses: list[float] = []
-    remesh_events: list[dict] = []
     restore_rec: dict | None = None
-    saves = 0
-    skipped_busy = 0
-    skipped_dedup = 0
-    max_capture = 0.0
+    # run-scoped metrics registry (obs.metrics): the loop records its
+    # checkpoint / re-mesh bookkeeping HERE and the final SoakReport reads
+    # it BACK, so the report and any live metrics consumer (log_snapshot
+    # below) can never disagree — there is one set of numbers.
+    from akka_allreduce_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    remesh_events = reg.series("soak.remesh_events")
+    c_steps = reg.counter("soak.steps")
+    c_saves = reg.counter("soak.checkpoint.saves")
+    c_skip_busy = reg.counter("soak.checkpoint.skipped_busy")
+    c_skip_dedup = reg.counter("soak.checkpoint.skipped_dedup")
+    g_capture = reg.gauge("soak.checkpoint.max_capture_stall_s")
+    g_loss = reg.gauge("soak.loss")
     compile_steps: set[int] = {0}  # steps whose time includes an XLA compile
     t_start = time.perf_counter()
 
@@ -195,6 +204,7 @@ def run_soak(
                     "n_devices": elastic.trainer.n_devices,
                 }
             )
+            reg.counter(f"soak.remesh.{kind}").inc()
             compile_steps.add(step)
             log(
                 f"step {step}: re-mesh ({kind}) -> "
@@ -202,6 +212,8 @@ def run_soak(
             )
         step_ms.append(dt * 1e3)
         losses.append(m.loss)
+        c_steps.inc()
+        g_loss.set(m.loss)
         if logger:
             logger.log_event(
                 step=step, loss=m.loss, ms=round(dt * 1e3, 2)
@@ -227,18 +239,18 @@ def run_soak(
             if ckpt.busy():
                 # a background save is still in flight: THIS is the
                 # contention the stall metric exists to count
-                skipped_busy += 1
+                c_skip_busy.inc()
             else:
                 t0 = time.perf_counter()
                 launched = ckpt.save(elastic.trainer)
                 cap = time.perf_counter() - t0
                 if launched:
-                    saves += 1
-                    max_capture = max(max_capture, cap)
+                    c_saves.inc()
+                    g_capture.set(max(g_capture.value, cap))
                 else:
                     # not busy and not launched: the step is already durable
                     # (e.g. the restore rewound step_num onto a saved step)
-                    skipped_dedup += 1
+                    c_skip_dedup.inc()
 
     ckpt.wait_until_finished()
     wall = time.perf_counter() - t_start
@@ -253,6 +265,8 @@ def run_soak(
         d_model=d_model,
         n_layers=n_layers,
     )
+    # the report is a READ of the registry — same numbers any live
+    # metrics_snapshot consumer saw, by construction
     report = SoakReport(
         steps=steps,
         wall_s=round(wall, 1),
@@ -263,15 +277,16 @@ def run_soak(
         ),
         first_loss=round(losses[0], 4),
         final_loss=round(losses[-1], 4),
-        remesh_events=remesh_events,
+        remesh_events=list(remesh_events.values),
         restore=restore_rec,
-        checkpoint_saves=saves,
-        checkpoint_skipped_busy=skipped_busy,
-        checkpoint_skipped_dedup=skipped_dedup,
-        max_capture_stall_s=round(max_capture, 3),
+        checkpoint_saves=c_saves.value,
+        checkpoint_skipped_busy=c_skip_busy.value,
+        checkpoint_skipped_dedup=c_skip_dedup.value,
+        max_capture_stall_s=round(g_capture.value, 3),
         generation=elastic.generation,
     )
     if logger:
+        logger.log_snapshot(reg)
         logger.log_event(summary=report.as_dict())
         logger.close()
     return report

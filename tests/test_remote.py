@@ -933,6 +933,12 @@ def test_transport_survives_malformed_frames_between_valid_ones():
     before AND after still deliver."""
     from akka_allreduce_tpu.control.remote import RemoteTransport, _U32
 
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    undecodable = REGISTRY.counter("transport.dropped.undecodable")
+    oversize = REGISTRY.counter("transport.dropped.oversize_frame")
+    u0, o0 = undecodable.value, oversize.value
+
     async def run():
         rx = RemoteTransport()
         got = []
@@ -952,13 +958,54 @@ def test_transport_survives_malformed_frames_between_valid_ones():
             await writer.drain()
             await wait_until(lambda: got == [1, 2], 10.0)
             assert rx.dropped == 1
+            # silent loss is COUNTABLE: the drop landed in the registry's
+            # per-cause counter, not just the per-transport total
+            assert undecodable.value == u0 + 1
             # an absurd length prefix closes the connection instead of
             # buffering it
             writer.write(_U32.pack(1 << 31))
             await writer.drain()
             await wait_until(lambda: rx.dropped == 2, 10.0)
+            assert oversize.value == o0 + 1
             writer.close()
         finally:
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_drop_causes_are_counted_in_registry():
+    """The no-route and no-handler drop paths (log.warning + silent loss
+    before this PR) each advance their own registry counter."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    no_route = REGISTRY.counter("transport.dropped.no_route")
+    no_handler = REGISTRY.counter("transport.dropped.no_handler")
+    filtered = REGISTRY.counter("transport.dropped.drop_filter")
+
+    async def run():
+        rx, tx = RemoteTransport(), RemoteTransport()
+        ep = await rx.start()
+        await tx.start()
+        try:
+            r0 = no_route.value
+            await tx.send(Envelope("nowhere:1", StartAllreduce(1)))
+            assert no_route.value == r0 + 1 and tx.dropped == 1
+
+            h0 = no_handler.value
+            tx.set_route("unregistered", ep)
+            await tx.send(Envelope("unregistered", StartAllreduce(2)))
+            await wait_until(lambda: no_handler.value == h0 + 1, 10.0)
+            assert rx.dropped == 1
+
+            f0 = filtered.value
+            tx.drop_filter = lambda env: True
+            await tx.send(Envelope("unregistered", StartAllreduce(3)))
+            assert filtered.value == f0 + 1
+        finally:
+            tx.drop_filter = None
+            await tx.stop()
             await rx.stop()
 
     asyncio.run(run())

@@ -20,6 +20,7 @@ import pytest
 
 from akka_allreduce_tpu.control import cluster as cl
 from akka_allreduce_tpu.control import wire
+from akka_allreduce_tpu.obs.trace import TraceContext
 from akka_allreduce_tpu.protocol import (
     CompleteAllreduce,
     ConfirmPreparation,
@@ -115,3 +116,67 @@ def test_truncated_payload_is_rejected():
     data = wire.encode(_SAMPLES[ScatterBlock])
     with pytest.raises(ValueError):
         wire.decode(data[: len(data) - 3])
+
+
+# --- trace-context trailer: version-skew compatibility (PR 4) -----------------
+#
+# The trailer is appended AFTER the message body, so compatibility rests on
+# two properties, each ratcheted over every tag:
+#  1. a decoder built WITHOUT trace support ignores trailing bytes — the old
+#     decode_frame_body was `_unpack_str(dest) + decode(rest)`, so feeding
+#     decode() the body WITH the trailer still attached replicates an old
+#     peer byte for byte;
+#  2. the new decoder treats a trailer-less frame as trace-free (old peer ->
+#     new decoder).
+
+_TCTX = TraceContext(
+    trace_id=0x1234_5678_9ABC_DEF0, span_id=0x0FED_CBA9, sampled=True
+)
+
+
+@pytest.mark.parametrize(
+    "msg_type", sorted(wire._TAGS, key=lambda t: wire._TAGS[t]),
+    ids=lambda t: f"tag{wire._TAGS[t]}-{t.__name__}",
+)
+def test_trace_trailer_roundtrip_and_version_skew(msg_type):
+    msg = _SAMPLES[msg_type]
+    framed = wire.encode_frame("worker:9", msg, trace=_TCTX)
+
+    # new decoder, new frame: message AND context come back
+    dest, back, tctx = wire.decode_frame_body_ex(memoryview(framed)[4:])
+    assert dest == "worker:9"
+    assert tctx == _TCTX
+    _assert_equal(msg, back)
+
+    # OLD decoder, new frame: exact replica of the pre-trailer
+    # decode_frame_body (dest parse + decode of everything after), which
+    # sees the trailer as trailing bytes and must ignore them
+    body = memoryview(framed)[4:]
+    _, off = wire._unpack_str(body, 0)
+    _assert_equal(msg, wire.decode(body[off:]))
+
+    # new decoder, OLD frame (no trailer): context is None, message intact
+    old_framed = wire.encode_frame("worker:9", msg)
+    dest2, back2, tctx2 = wire.decode_frame_body_ex(memoryview(old_framed)[4:])
+    assert dest2 == "worker:9" and tctx2 is None
+    _assert_equal(msg, back2)
+
+
+def test_trace_trailer_f16_and_unsampled():
+    """The trailer composes with wire compression, and the sampled bit
+    survives the round trip in both states."""
+    msg = _SAMPLES[ScatterBlock]
+    for sampled in (True, False):
+        ctx = type(_TCTX)(7, 8, sampled)
+        f = wire.encode_frame("w", msg, f16=True, trace=ctx)
+        _, back, tctx = wire.decode_frame_body_ex(memoryview(f)[4:])
+        assert tctx == ctx
+        np.testing.assert_array_equal(back.value, msg.value)
+
+
+def test_trace_trailer_cost_is_constant():
+    """25 bytes per frame, exactly — never payload-proportional."""
+    msg = _SAMPLES[ScatterBlock]
+    plain = wire.encode_frame("w", msg)
+    traced = wire.encode_frame("w", msg, trace=_TCTX)
+    assert len(traced) - len(plain) == wire._TRACE_LEN == 25
