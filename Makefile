@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo
+.PHONY: lint lint-json baseline native test tier1 trace-demo chaos
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -28,6 +28,15 @@ native:
 # asserted well-formed by tests/test_obs_cluster.py in tier-1.
 trace-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu obs demo --out-dir trace_demo
+
+# fixed-seed 30-second chaos soak (RESILIENCE.md): real master + 3 node
+# processes under seeded drop/delay/corruption + a mid-run partition that
+# heals; exits non-zero unless rounds completed UNDER the chaos. The same
+# seed replays the same per-process chaos event logs (chaos_run/*.jsonl).
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu chaos --seed 1234 \
+	  --duration 30 --nodes 3 --th 0.66 --out-dir chaos_run \
+	  --spec "drop:p=0.05;delay:ms=10;corrupt:p=0.02;partition:groups=m+0+1|2,at=10s,heal=8s"
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

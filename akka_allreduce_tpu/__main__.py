@@ -60,6 +60,42 @@ def _write_trace(args) -> None:
         print(f"trace written to {path}", flush=True)
 
 
+def _add_chaos_flags(p: argparse.ArgumentParser) -> None:
+    """Chaos + retry-policy flags for the cluster master roles. The chaos
+    spec is distributed to every node via Welcome (like every other knob),
+    so ONE master flag arms the whole cluster with the same seed; the
+    retry policy travels the same way (RESILIENCE.md)."""
+    p.add_argument(
+        "--chaos-seed", type=int, default=0,
+        help="seed of the deterministic chaos schedule (same seed -> same "
+        "per-process event log)",
+    )
+    p.add_argument(
+        "--chaos-spec", default="",
+        metavar="SPEC",
+        help="fault spec, e.g. 'drop:p=0.05;delay:ms=20;corrupt:p=0.01;"
+        "partition:groups=m+0|1,at=round10,heal=5s' (empty = chaos off)",
+    )
+    p.add_argument(
+        "--chaos-log", default=None, metavar="FILE",
+        help="write this process's chaos event log (JSONL, deterministic "
+        "per seed) here on exit",
+    )
+    p.add_argument(
+        "--send-retries", type=int, default=1,
+        help="transport reconnect-resend budget per failure burst "
+        "(exponential backoff + full jitter; 0 = fail fast)",
+    )
+    p.add_argument(
+        "--send-backoff-base", type=float, default=0.05,
+        help="base backoff seconds (doubles per retry, capped)",
+    )
+    p.add_argument(
+        "--send-backoff-max", type=float, default=2.0,
+        help="backoff cap in seconds",
+    )
+
+
 def _add_wire_dtype_flag(p: argparse.ArgumentParser) -> None:
     """TCP wire compression for the host data plane (cluster masters only —
     the knob is distributed to every node via Welcome)."""
@@ -926,6 +962,7 @@ def _cmd_cluster_master(argv: list[str]) -> int:
         "seconds dumps the flight recorder (0 = off)",
     )
     _add_wire_dtype_flag(p)
+    _add_chaos_flags(p)
     _add_obs_flags(p)
     args = p.parse_args(argv)
     from akka_allreduce_tpu.config import WorkerConfig
@@ -949,15 +986,23 @@ def _run_cluster_master(args) -> int:
 
     from akka_allreduce_tpu.config import (
         AllreduceConfig,
+        ChaosConfig,
         LineMasterConfig,
         MasterConfig,
         MetaDataConfig,
+        RetryPolicy,
         ThresholdConfig,
         WorkerConfig,
     )
     from akka_allreduce_tpu.control.bootstrap import MasterProcess
     from akka_allreduce_tpu.utils.metrics import MetricsLogger
 
+    chaos_spec = getattr(args, "chaos_spec", "")
+    if chaos_spec:
+        # fail fast on a malformed spec — before any process is spawned
+        from akka_allreduce_tpu.control.chaos import parse_spec
+
+        parse_spec(chaos_spec)
     cfg = AllreduceConfig(
         threshold=ThresholdConfig(args.th, args.th, args.th),
         metadata=MetaDataConfig(
@@ -973,10 +1018,18 @@ def _run_cluster_master(args) -> int:
             dimensions=args.dims,
             heartbeat_interval_s=args.heartbeat,
             round_deadline_s=getattr(args, "round_deadline", 0.0),
+            retry=RetryPolicy(
+                max_retries=getattr(args, "send_retries", 1),
+                backoff_base_s=getattr(args, "send_backoff_base", 0.05),
+                backoff_max_s=getattr(args, "send_backoff_max", 2.0),
+            ),
         ),
         # both CLI node roles publish snapshots (fixed demo arrays / weights
         # replaced by reference), so the zero-copy scatter path is sound
         worker=WorkerConfig(zero_copy_scatter=True),
+        chaos=ChaosConfig(
+            seed=getattr(args, "chaos_seed", 0), spec=chaos_spec
+        ),
     )
     _install_obs(args)
 
@@ -985,6 +1038,23 @@ def _run_cluster_master(args) -> int:
         master = MasterProcess(cfg, args.host, args.port, metrics=metrics)
         ep = await master.start()
         print(f"master listening on {ep}", flush=True)
+        # SIGTERM ends an open-ended (--rounds -1) run GRACEFULLY: nodes get
+        # a Shutdown broadcast and every process flushes its metrics/chaos
+        # logs — the chaos runner's --duration mode depends on this
+        import signal as _signal
+
+        from akka_allreduce_tpu.control.remote import observed_task
+
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(
+                _signal.SIGTERM,
+                lambda: observed_task(
+                    master.shutdown("sigterm"), name="sigterm-shutdown"
+                ),
+            )
+        except (NotImplementedError, RuntimeError):  # pragma: no cover
+            pass  # non-Unix event loops: SIGTERM stays abrupt
         try:
             t0, c0 = time.perf_counter(), time.process_time()
             await master.run_until_done()
@@ -997,6 +1067,9 @@ def _run_cluster_master(args) -> int:
             await asyncio.sleep(2 * args.heartbeat)  # let Shutdown flush
         finally:
             await master.stop()
+            if getattr(args, "chaos_log", None) and master.transport.chaos:
+                path = master.transport.chaos.write_log(args.chaos_log)
+                print(f"chaos event log: {path}", flush=True)
             if metrics is not None:
                 from akka_allreduce_tpu.obs.metrics import REGISTRY
 
@@ -1025,6 +1098,11 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         "(fields encode/socket_write/decode/handler as wall spans, plus "
         "cpu_s/wall_s — the on-cpu/off-cpu partition of the round "
         "window)",
+    )
+    p.add_argument(
+        "--chaos-log", default=None, metavar="FILE",
+        help="write this node's chaos event log (JSONL) on exit; the "
+        "chaos spec itself arrives from the master via Welcome",
     )
     _add_obs_flags(p)
     args = p.parse_args(argv)
@@ -1057,6 +1135,9 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             args.host,
             args.port,
             preferred_node_id=args.node_id,
+            # real OS process: the chaos `crash` fault may os._exit here
+            allow_crash=True,
+            chaos_log=args.chaos_log,
         )
         await node.start()
         nid = await node.wait_welcomed()
@@ -1072,6 +1153,8 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             reason = await node.run_until_shutdown()
         finally:
             await node.stop()
+            if args.chaos_log and node.transport.chaos is not None:
+                node.transport.chaos.write_log(args.chaos_log)
         dt = time.perf_counter() - state["t0"]
         cpu = time.process_time() - cpu0
         mbs = state["flushes"] * size * 4 / max(dt, 1e-9) / 1e6
@@ -2043,6 +2126,12 @@ def _cmd_soak(argv: list[str]) -> int:
     p.add_argument("--drop-at", type=int, default=None)
     p.add_argument("--rejoin-at", type=int, default=None)
     p.add_argument("--restore-at", type=int, default=None)
+    p.add_argument(
+        "--chaos", type=int, default=None, metavar="SEED",
+        help="seeded membership chaos: replace the single scripted "
+        "drop/rejoin with deterministic random silence windows per node "
+        "(node 0 never flaps); the same seed replays the same churn",
+    )
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument(
@@ -2078,6 +2167,7 @@ def _cmd_soak(argv: list[str]) -> int:
         drop_at=args.drop_at,
         rejoin_at=args.rejoin_at,
         restore_at=args.restore_at,
+        chaos_seed=args.chaos,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         delta=args.delta_checkpoint,
@@ -2085,6 +2175,174 @@ def _cmd_soak(argv: list[str]) -> int:
     )
     print(json.dumps(report.as_dict()))
     return 0
+
+
+def _cmd_chaos(argv: list[str]) -> int:
+    """Chaos harness: a real master + N node OS processes over loopback,
+    every transport armed with the SAME seeded fault schedule (the master
+    distributes the spec via Welcome), invariants summarized at the end.
+    ``make chaos`` runs the fixed-seed 30-second variant (RESILIENCE.md)."""
+    p = argparse.ArgumentParser(
+        "chaos",
+        description="run a tiny cluster under seeded fault injection and "
+        "report what happened (chaos events vs rounds completed)",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument(
+        "--spec",
+        default="drop:p=0.05;delay:ms=10;corrupt:p=0.02",
+        help="fault spec (see RESILIENCE.md for the grammar)",
+    )
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument(
+        "--rounds", type=int, default=50,
+        help="line-round budget; ignored when --duration is set",
+    )
+    p.add_argument(
+        "--duration", type=float, default=None,
+        help="run open-ended for this many seconds instead of a round "
+        "budget (the 30s soak `make chaos` uses)",
+    )
+    p.add_argument("--size", type=int, default=65536)
+    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument("--out-dir", default="chaos_run")
+    args = p.parse_args(argv)
+    # fail fast on a malformed spec BEFORE spawning anything — a parse
+    # error inside the master subprocess would surface as an opaque
+    # "never reported its endpoint" failure here
+    from akka_allreduce_tpu.control.chaos import parse_spec
+
+    try:
+        parse_spec(args.spec)
+    except ValueError as e:
+        p.error(str(e))
+
+    import json
+    import os
+    import signal as _signal
+    import subprocess
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    metrics_path = os.path.join(args.out_dir, "rounds.jsonl")
+    master_log = os.path.join(args.out_dir, "chaos-master.jsonl")
+    stale = [f for f in os.listdir(args.out_dir) if f.endswith(".jsonl")]
+    for f in stale:  # MetricsLogger appends; never mix two runs' records
+        os.remove(os.path.join(args.out_dir, f))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def spawn(*cli):
+        return subprocess.Popen(
+            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+
+    rounds = -1 if args.duration else args.rounds
+    wedged = False
+    master = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(args.nodes),
+        "--rounds", str(rounds), "--size", str(args.size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--chaos-seed", str(args.seed), "--chaos-spec", args.spec,
+        "--chaos-log", master_log, "--metrics-out", metrics_path,
+    )
+    nodes = []
+    t0 = time.perf_counter()
+    master_done = False
+    try:
+        seed_ep = None
+        for line in master.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("master never reported its endpoint")
+        for k in range(args.nodes):
+            nodes.append(
+                spawn(
+                    "cluster-node", "--seed", seed_ep, "--node-id", str(k),
+                    "--chaos-log",
+                    os.path.join(args.out_dir, f"chaos-node{k}.jsonl"),
+                )
+            )
+        try:
+            if args.duration:
+                time.sleep(args.duration)
+                master.send_signal(_signal.SIGTERM)
+                master.wait(timeout=30)
+                # the Shutdown broadcast is racing any mid-rejoin node:
+                # give every node a grace window to exit (and flush its
+                # chaos log) before the finally-kill
+                for n in nodes:
+                    try:
+                        n.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+            else:
+                out, _ = master.communicate(timeout=600)
+                master_done = "master done" in out
+                for n in nodes:
+                    try:
+                        n.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        n.kill()
+        except subprocess.TimeoutExpired:
+            # a wedged cluster is a RESULT for this harness, not a crash:
+            # fall through to the summary (which will report the wedge and
+            # exit non-zero), never a bare traceback
+            wedged = True
+    finally:
+        for proc in [master, *nodes]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    elapsed = time.perf_counter() - t0
+    rounds_completed = 0
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as f:
+            rounds_completed = sum(
+                1
+                for ln in f
+                if ln.strip() and json.loads(ln).get("kind") == "round"
+            )
+    events: dict[str, int] = {}
+    logs = sorted(
+        f for f in os.listdir(args.out_dir) if f.startswith("chaos-")
+    )
+    for f in logs:
+        with open(os.path.join(args.out_dir, f)) as fh:
+            for ln in fh:
+                if ln.strip():
+                    fault = json.loads(ln)["fault"]
+                    events[fault] = events.get(fault, 0) + 1
+    from akka_allreduce_tpu.control.chaos import CRASH_EXIT_CODE
+
+    summary = {
+        "seed": args.seed,
+        "spec": args.spec,
+        "elapsed_s": round(elapsed, 1),
+        "rounds_completed": rounds_completed,
+        "master_done": master_done or None,
+        "wedged": wedged or None,
+        "chaos_events": events,
+        "chaos_logs": logs,
+        "node_exits": [n.returncode for n in nodes],
+        "injected_crashes": sum(
+            1 for n in nodes if n.returncode == CRASH_EXIT_CODE
+        ),
+    }
+    print(json.dumps(summary))
+    # pass = the cluster made progress UNDER chaos without wedging; with a
+    # round budget the budget must also have finished
+    ok = (
+        not wedged
+        and rounds_completed > 0
+        and (args.duration is not None or master_done)
+    )
+    return 0 if ok else 1
 
 
 def _cmd_obs(argv: list[str]) -> int:
@@ -2276,6 +2534,7 @@ COMMANDS = {
     "lm-generate": _cmd_lm_generate,
     "elastic-demo": _cmd_elastic_demo,
     "obs": _cmd_obs,
+    "chaos": _cmd_chaos,
 }
 
 

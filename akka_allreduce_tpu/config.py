@@ -140,6 +140,63 @@ class NodeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Transport send-retry budget: exponential backoff with FULL jitter.
+
+    A failure burst against one endpoint may consume up to ``max_retries``
+    reconnect-and-resend cycles before the queued envelopes are declared
+    dead (``on_send_error`` per envelope); each retry sleeps a uniform
+    sample of ``[0, min(backoff_max_s, backoff_base_s * 2**attempt))`` —
+    full jitter, so a partition heal is not greeted by every peer
+    reconnecting in the same millisecond. ``max_retries=0`` restores
+    fail-fast semantics (useful under chaos tests that want every fault
+    surfaced immediately).
+    """
+
+    max_retries: int = 1
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}"
+            )
+        if self.backoff_base_s <= 0 or self.backoff_max_s <= 0:
+            raise ValueError(
+                "backoff_base_s/backoff_max_s must be positive, got "
+                f"{self.backoff_base_s}/{self.backoff_max_s}"
+            )
+
+    def backoff_s(self, attempt: int, u: float) -> float:
+        """Backoff before retry ``attempt`` (0-based); ``u`` is the caller's
+        uniform [0,1) sample (kept outside so the policy stays a pure
+        value object)."""
+        cap = min(self.backoff_max_s, self.backoff_base_s * (2.0**attempt))
+        return u * cap
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection for the transports (control/chaos.py).
+
+    ``spec`` is the fault grammar (``"drop:p=0.05;partition:groups=m+0|1,
+    at=round10,heal=5s"`` — see RESILIENCE.md); empty = chaos disabled.
+    Distributed via ``Welcome`` like every other knob, so one master flag
+    arms the whole cluster with the SAME seed — every process derives its
+    own decision stream from (seed, role), and the same seed replays the
+    same event log.
+    """
+
+    seed: int = 0
+    spec: str = ""
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.spec)
+
+
+@dataclasses.dataclass(frozen=True)
 class MasterConfig:
     """Cluster-wide control-plane config (reference ``MasterConfig``)."""
 
@@ -152,6 +209,15 @@ class MasterConfig:
     # generously above the expected round latency — it exists to turn a hung
     # run into a post-mortem artifact, not to police slow rounds.
     round_deadline_s: float = 0.0
+    # transport send-retry budget, distributed via Welcome so every node's
+    # transport escalates identically before declaring a peer dead
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+
+    def __post_init__(self) -> None:
+        # from_json hands sections to their dataclass as plain dicts;
+        # coerce the nested policy so MasterConfig(**json_dict) just works
+        if isinstance(self.retry, dict):
+            object.__setattr__(self, "retry", RetryPolicy(**self.retry))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -166,6 +232,7 @@ class AllreduceConfig:
     line_master: LineMasterConfig = dataclasses.field(default_factory=LineMasterConfig)
     node: NodeConfig = dataclasses.field(default_factory=NodeConfig)
     master: MasterConfig = dataclasses.field(default_factory=MasterConfig)
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
 
     @classmethod
     def from_json(cls, text: str) -> "AllreduceConfig":
@@ -177,6 +244,7 @@ class AllreduceConfig:
             "line_master": LineMasterConfig,
             "node": NodeConfig,
             "master": MasterConfig,
+            "chaos": ChaosConfig,
         }
         unknown = set(raw) - set(sections)
         if unknown:

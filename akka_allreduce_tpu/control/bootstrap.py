@@ -102,6 +102,19 @@ class MasterProcess:
         self._superseded: dict[int, tuple[int, cl.Endpoint]] = {}
         self.transport = RemoteTransport(host, port)
         self.transport.wire_f16 = config.metadata.wire_dtype == "f16"
+        self.transport.retry_policy = config.master.retry
+        if config.chaos.enabled:
+            from akka_allreduce_tpu.control.chaos import (
+                MASTER_ROLE,
+                ChaosInjector,
+            )
+
+            self.transport.chaos = ChaosInjector(
+                config.chaos.seed,
+                config.chaos.spec,
+                role=MASTER_ROLE,
+                dims=config.master.dimensions,
+            )
         self.transport.register("master", self._on_cluster_msg)
         self.transport.register_prefix("line_master", self.grid.handle_for_line)
         self.transport.set_prefix_route("worker", self._worker_endpoint)
@@ -139,6 +152,14 @@ class MasterProcess:
         ``line_master.max_rounds >= 0``); the detector poll loop broadcasts
         ``Shutdown`` to all nodes the moment that happens."""
         await asyncio.wait_for(self._done.wait(), timeout)
+
+    async def shutdown(self, reason: str = "terminated") -> None:
+        """End an open-ended run from the outside (SIGTERM in the CLI, the
+        chaos runner's --duration mode): broadcast ``Shutdown`` so nodes
+        exit cleanly — flushing metrics and chaos logs — then release
+        ``run_until_done``."""
+        await self.transport.send_all(self._broadcast(cl.Shutdown(reason)))
+        self._done.set()
 
     # -- routing helpers -------------------------------------------------------
 
@@ -339,10 +360,15 @@ class MasterProcess:
         if expelled:
             out.extend(self._broadcast(self._address_book()))
         # at-most-once delivery can eat a Prepare (e.g. into a connection
-        # whose peer just restarted): re-send to unconfirmed workers
+        # whose peer just restarted): re-send to unconfirmed workers. The
+        # same discipline covers Start/Complete loss: an in-flight round
+        # with no completion progress for several intervals is re-Started
+        # at the workers that never reported (idempotent on every path —
+        # under sustained loss a bounded round window wedges without this)
         interval = self.config.master.heartbeat_interval_s
         for lm in self.grid.line_masters.values():
             out.extend(lm.reprepare_pending(2.0 * interval))
+            out.extend(lm.restart_stalled(5.0 * interval))
         if out:
             await self.transport.send_all(out)
         if self.grid.is_done and not self._done.is_set():
@@ -377,12 +403,21 @@ class NodeProcess:
         *,
         preferred_node_id: int = -1,
         join_retry_s: float = 0.5,
+        allow_crash: bool = False,
+        chaos_log: str | None = None,
     ) -> None:
         self.seed = seed
         self.data_source = data_source
         self.data_sink = data_sink
         self.preferred_node_id = preferred_node_id
         self.join_retry_s = join_retry_s
+        # chaos plumbing: the spec itself arrives with Welcome (one master
+        # flag arms the cluster); allow_crash gates the `crash` fault to
+        # REAL subprocesses (the CLI role sets it — an in-process test
+        # harness must record a suppressed crash, not kill pytest)
+        self.allow_crash = allow_crash
+        self.chaos_log = chaos_log
+        self._chaos_t0: float | None = None
         self.incarnation = _new_incarnation()
         self.node_id: int | None = None
         self.node: AllreduceNode | None = None
@@ -517,6 +552,16 @@ class NodeProcess:
             if self._heartbeat_task is not None:
                 self._heartbeat_task.cancel()
                 self._heartbeat_task = None
+            if self._join_task is not None:
+                # the ORIGINAL join task retries until _welcomed is set and
+                # may still be sleeping off its first retry interval:
+                # clearing _welcomed below would resurrect it, and its join
+                # carries the STALE incarnation — the master could admit
+                # that ghost identity first and drop the bumped
+                # incarnation's heartbeats as a zombie's until this loop's
+                # join lands (race found by the chaos partition test)
+                self._join_task.cancel()
+                self._join_task = None
             self._welcomed.clear()
             self.incarnation = _new_incarnation()
             join = cl.JoinCluster(
@@ -574,8 +619,42 @@ class NodeProcess:
         # other knob: payloads we send from now on ride at the configured
         # width (decode is stateless — the flag travels per frame)
         self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
+        self.transport.retry_policy = self.config.master.retry
         self.node_id = msg.node_id
         dims = self.config.master.dimensions
+        if self.config.chaos.enabled:
+            from akka_allreduce_tpu.control.chaos import ChaosInjector
+
+            # anchor the fault timeline ONCE per process: a rejoin rebuilds
+            # the injector (the role may even change with the assigned id)
+            # but must not restart partition/stall windows from zero
+            if self._chaos_t0 is None:
+                self._chaos_t0 = time.monotonic()
+            prev = self.transport.chaos
+            if (
+                prev is not None
+                and prev.seed == self.config.chaos.seed
+                and prev.spec == self.config.chaos.spec
+                and prev.role == msg.node_id
+            ):
+                pass  # re-welcome under the same identity: keep the injector
+            else:
+                inj = ChaosInjector(
+                    self.config.chaos.seed,
+                    self.config.chaos.spec,
+                    role=msg.node_id,
+                    dims=dims,
+                    t0=self._chaos_t0,
+                    allow_crash=self.allow_crash,
+                    log_path=self.chaos_log,
+                )
+                if prev is not None:
+                    # a rejoin (or id change) rebuilds the decision streams,
+                    # but the process's event HISTORY must survive — the
+                    # exit-time log write reports the whole run, not just
+                    # the last membership epoch
+                    inj.events = list(prev.events) + inj.events
+                self.transport.chaos = inj
         self.node = AllreduceNode(
             msg.node_id,
             dims,

@@ -22,6 +22,8 @@ from collections import deque
 from enum import Enum
 from typing import Callable
 
+from akka_allreduce_tpu.obs import flight as _flight
+
 
 class MemberState(Enum):
     UP = "up"
@@ -165,6 +167,15 @@ class HeartbeatMonitor:
         self.states[node_id] = state
         ev = MembershipEvent(
             node_id, state, now, self.detector.phi(node_id, now)
+        )
+        # membership edges into the flight-recorder ring: a chaos/stall
+        # post-mortem reads WHEN the detector acted next to what the
+        # transports dropped (RESILIENCE.md)
+        _flight.note(
+            "membership",
+            node=node_id,
+            state=state.value,
+            phi=round(ev.phi, 2) if math.isfinite(ev.phi) else "inf",
         )
         if self._on_event:
             self._on_event(ev)

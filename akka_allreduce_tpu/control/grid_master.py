@@ -86,6 +86,14 @@ class GridMaster:
         if not self.organized:
             return []
         log.info("master: lost node %d -> reorganize", node_id)
+        # degraded mode FIRST: in-flight rounds that already hold every
+        # completion the surviving workers can deliver complete gracefully
+        # (counted, flushed, watchdog retired) before the reorganization
+        # abandons whatever genuinely cannot finish
+        dims = self.config.dimensions
+        gone = [dim_worker_id(node_id, d, dims) for d in range(dims)]
+        for lm in self.line_masters.values():
+            lm.member_unreachable(gone)
         if not self.nodes:
             # cluster emptied: fold the dying configuration's progress and
             # round high-water mark exactly as _organize would, so a later

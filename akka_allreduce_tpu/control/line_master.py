@@ -34,6 +34,8 @@ RoundStartObserver = Callable[[int, int], None]
 _ROUNDS_COMPLETED = obs_metrics.counter("master.rounds_completed")
 _ROUND_LATENCY = obs_metrics.histogram("master.round_latency_s")
 _ROUNDS_ABANDONED = obs_metrics.counter("master.rounds_abandoned")
+_ROUNDS_DEGRADED = obs_metrics.counter("master.rounds_degraded")
+_ROUNDS_RESTARTED = obs_metrics.counter("master.rounds_restarted")
 
 
 class LineMaster:
@@ -56,6 +58,7 @@ class LineMaster:
         self.on_round_complete = on_round_complete
         self.on_round_start = on_round_start
         self._started_at: dict[int, float] = {}
+        self._restarted_at: dict[int, float] = {}  # restart_stalled rate limit
         # round -> open root span: this line master is where a round's
         # trace is BORN — the id stamped onto the StartAllreduce envelopes
         # is the one every downstream hop inherits
@@ -75,6 +78,11 @@ class LineMaster:
         self._confirmed: set[int] = set()
         self._preparing = False
         self._prepared_at = 0.0
+        # workers the detector marked unreachable mid-config: the effective
+        # completion trigger degrades to what the REACHABLE set can deliver,
+        # so in-flight rounds at th=1.0 complete gracefully at detection
+        # instead of wedging until the watchdog trips (degraded mode)
+        self.unreachable: set[int] = set()
 
     # -- configuration / handshake ------------------------------------------
 
@@ -105,6 +113,7 @@ class LineMaster:
         self.completions.clear()
         self.completed_up_to = from_round - 1
         self._confirmed.clear()
+        self.unreachable.clear()  # a new config is built from live members
         self._preparing = True
         self._prepared_at = self.clock()
         return self._prepare_envelopes(self.worker_ids)
@@ -120,6 +129,51 @@ class LineMaster:
             )
             for w in workers
         ]
+
+    def restart_stalled(self, min_age_s: float) -> list[Envelope]:
+        """Re-send ``StartAllreduce`` for in-flight rounds that made no
+        completion progress for ``min_age_s`` — only to workers missing
+        from the round's completion set.
+
+        Delivery is at-most-once: under sustained loss a dropped Start
+        starves a worker out of the round and a dropped Complete starves
+        the round out of its trigger — with a bounded window both in-flight
+        rounds can wedge PERMANENTLY (the chaos harness exposes this within
+        seconds at drop:p=0.05). The retry is idempotent on every path: a
+        worker mid-round re-scatters into dedup'd buffers, a worker that
+        already finished re-asserts its lost CompleteAllreduce, a worker
+        that never started simply starts."""
+        if self._preparing:
+            return []
+        now = self.clock()
+        out: list[Envelope] = []
+        for r in sorted(self.started_rounds):
+            if r <= self.completed_up_to:
+                continue
+            last = max(
+                self._started_at.get(r, 0.0), self._restarted_at.get(r, 0.0)
+            )
+            if now - last < min_age_s:
+                continue
+            done = self.completions.get(r, set())
+            pending = [w for w in self.worker_ids if w not in done]
+            if not pending:
+                continue
+            self._restarted_at[r] = now  # rate limit; latency stays honest
+            _ROUNDS_RESTARTED.inc()
+            log.info(
+                "line %d: round %d stalled %.2fs at %d/%d completions; "
+                "re-starting %s",
+                self.line_id, r, now - last, len(done),
+                self.completion_trigger, pending,
+            )
+            span = self._round_spans.get(r)
+            ctx = span.context if span is not None else None
+            out.extend(
+                Envelope(peer_addr(w), StartAllreduce(r), trace=ctx)
+                for w in pending
+            )
+        return out
 
     def reprepare_pending(self, min_age_s: float) -> list[Envelope]:
         """Re-send PrepareAllreduce to workers that have not confirmed within
@@ -144,7 +198,44 @@ class LineMaster:
 
     @property
     def completion_trigger(self) -> int:
-        return self.threshold.allreduce_count(self.n_workers)
+        """Completions required for a round — the configured threshold,
+        DEGRADED to the reachable-worker count when the detector has marked
+        members unreachable mid-config: the dead cannot report, so waiting
+        for them is a wedge, not a guarantee (never below 1)."""
+        base = self.threshold.allreduce_count(self.n_workers)
+        reachable = self.n_workers - len(self.unreachable)
+        return max(1, min(base, reachable))
+
+    def member_unreachable(self, worker_ids) -> list[Envelope]:
+        """Degraded mode: the detector marked these workers unreachable.
+
+        Lowers the effective completion trigger and immediately re-checks
+        every in-flight round against it — a round that already has every
+        completion the REACHABLE set can deliver completes NOW (graceful
+        degradation) instead of wedging until the watchdog dumps a stall
+        or a reorganization abandons it. No new rounds are started here:
+        the grid master reorganizes right after, and feeding the window of
+        a dying config would only burn round numbers.
+        """
+        affected = set(worker_ids) & set(self.worker_ids)
+        new = affected - self.unreachable
+        if not new:
+            return []
+        self.unreachable |= new
+        trigger = self.completion_trigger
+        for r in sorted(self.started_rounds):
+            if r <= self.completed_up_to or r not in self.started_rounds:
+                continue  # retired by an earlier completion this loop
+            if len(self.completions.get(r, ())) >= trigger:
+                log.info(
+                    "line %d: round %d completes DEGRADED (%d/%d workers "
+                    "unreachable, trigger %d)",
+                    self.line_id, r, len(self.unreachable),
+                    self.n_workers, trigger,
+                )
+                _ROUNDS_DEGRADED.inc()
+                self._complete_round(r, degraded=True)
+        return []
 
     # -- message dispatch ----------------------------------------------------
 
@@ -182,7 +273,16 @@ class LineMaster:
         done.add(msg.src_id)
         if len(done) < self.completion_trigger:
             return []
-        # round complete at threshold; abandon older in-flight rounds
+        self._complete_round(r)
+        return self._fill_window()
+
+    def _complete_round(self, r: int, *, degraded: bool = False) -> None:
+        """The completion body: advance the watermark, account the round,
+        close its span, and abandon older in-flight rounds (the workers'
+        own discipline). Callers decide whether to refill the window —
+        threshold completions do, degraded completions don't (the config
+        is about to be replaced)."""
+        done = self.completions.get(r, set())
         self.completed_up_to = max(self.completed_up_to, r)
         self.total_completed += 1
         _ROUNDS_COMPLETED.inc()
@@ -197,17 +297,19 @@ class LineMaster:
         span = self._round_spans.pop(r, None)
         if span is not None:
             span.set(completions=len(done))
+            if degraded:
+                span.set(degraded=True)
             span.end()
         for stale in [x for x in self.started_rounds if x <= r]:
             self.started_rounds.discard(stale)
             self.completions.pop(stale, None)
             self._started_at.pop(stale, None)
+            self._restarted_at.pop(stale, None)
             stale_span = self._round_spans.pop(stale, None)
             if stale_span is not None:
                 _ROUNDS_ABANDONED.inc()
                 stale_span.set(abandoned=True)
                 stale_span.end()
-        return self._fill_window()
 
     # -- round window --------------------------------------------------------
 

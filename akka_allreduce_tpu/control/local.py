@@ -36,7 +36,16 @@ DropFilter = Callable[[Envelope], bool]
 
 
 class LocalRouter:
-    """FIFO in-process message delivery with fault injection."""
+    """FIFO in-process message delivery with fault injection.
+
+    Fault injection comes in two strengths: the original ``drop_filter``
+    (omit matching messages — the reference's own technique) and the
+    shared ``chaos`` hook point (control/chaos.py — the SAME injector the
+    TCP transport takes): drop, duplicate, reorder (push-to-back; also how
+    a planned delay manifests in a synchronous router), and payload
+    corruption via a wire-codec round trip, so even the in-process mode
+    exercises the real tag-2/3 checksum rejection path.
+    """
 
     def __init__(self, drop_filter: DropFilter | None = None) -> None:
         self._handlers: dict[str, Callable[[Any], list[Envelope]]] = {}
@@ -45,6 +54,7 @@ class LocalRouter:
         ] = {}
         self._queue: deque[Envelope] = deque()
         self.drop_filter = drop_filter
+        self.chaos = None  # control.chaos.ChaosInjector | None
         self.delivered = 0
         self.dropped = 0
 
@@ -58,11 +68,54 @@ class LocalRouter:
         self._prefix_handlers[prefix] = handler
 
     def send_all(self, envelopes: list[Envelope]) -> None:
+        held: list[Envelope] = []
         for env in envelopes:
             if self.drop_filter is not None and self.drop_filter(env):
                 self.dropped += 1
                 continue
+            if self.chaos is not None:
+                act = self.chaos.plan_send(env)
+                if act is not None:
+                    self._apply_chaos(env, act, held)
+                    continue
             self._queue.append(env)
+        # a synchronous router has no clock to hold a message against:
+        # delay/reorder become hold-until-end-of-batch, so every message
+        # sent LATER in the same batch overtakes the held one — the same
+        # FIFO violation the TCP transport's delay fault produces
+        self._queue.extend(held)
+
+    def _apply_chaos(
+        self, env: Envelope, act, held: list[Envelope]
+    ) -> None:
+        if act.drop or act.fail:
+            self.dropped += 1  # no failure callbacks in-process: both drop
+            return
+        if act.corrupt:
+            corrupted = self._corrupt_roundtrip(env, act)
+            if corrupted is None:
+                self.dropped += 1  # checksum rejected the flip, as it must
+                return
+            env = corrupted
+        sink = held if act.delay_s > 0 else self._queue
+        sink.append(env)
+        if act.duplicate:
+            sink.append(env)
+
+    def _corrupt_roundtrip(self, env: Envelope, act) -> Envelope | None:
+        """Apply the payload bit-flip through the REAL wire codec: encode,
+        flip, decode. Returns None when decode rejects the frame (the
+        checksum doing its job — the overwhelmingly common case)."""
+        from akka_allreduce_tpu.control import wire
+
+        try:
+            parts = wire.encode_frame_parts(env.dest, env.msg)
+            parts = self.chaos.corrupt_frame_parts(parts, act)
+            body = b"".join(bytes(p) for p in parts)[4:]
+            dest, msg = wire.decode_frame_body(body)
+            return Envelope(dest, msg, via=env.via)
+        except Exception:
+            return None
 
     def run(self, max_messages: int = 1_000_000) -> int:
         """Deliver until quiescent; returns messages delivered."""
@@ -105,6 +158,21 @@ class LocalAllreduceSystem:
             config.line_master,
         )
         self.router = LocalRouter(drop_filter)
+        if config.chaos.enabled:
+            # dev-mode chaos: ONE injector plays the whole single-process
+            # cluster (role: master — it owns the router), same spec
+            # grammar and seed determinism as the TCP deployment
+            from akka_allreduce_tpu.control.chaos import (
+                MASTER_ROLE,
+                ChaosInjector,
+            )
+
+            self.router.chaos = ChaosInjector(
+                config.chaos.seed,
+                config.chaos.spec,
+                role=MASTER_ROLE,
+                dims=dims,
+            )
         self.nodes: dict[int, AllreduceNode] = {}
         for i in range(n_nodes):
             self.add_node(i, data_sources[i], data_sinks[i], join=False)
@@ -152,10 +220,17 @@ def _main() -> None:
     parser.add_argument("--chunk", type=int, default=262_144)
     parser.add_argument("--dims", type=int, default=1)
     parser.add_argument("--th", type=float, default=1.0, help="all three thresholds")
+    parser.add_argument("--chaos-seed", type=int, default=0)
+    parser.add_argument(
+        "--chaos-spec", default="",
+        help="dev-mode chaos on the in-process router (drop/duplicate/"
+        "reorder/corrupt — RESILIENCE.md); empty = off",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO, format="%(message)s")
 
     from akka_allreduce_tpu.config import (
+        ChaosConfig,
         LineMasterConfig,
         MasterConfig,
         MetaDataConfig,
@@ -170,6 +245,7 @@ def _main() -> None:
         master=MasterConfig(node_num=args.nodes, dimensions=args.dims),
         # demo sources return fixed arrays -> snapshot contract holds
         worker=WorkerConfig(zero_copy_scatter=True),
+        chaos=ChaosConfig(seed=args.chaos_seed, spec=args.chaos_spec),
     )
 
     rng = np.random.default_rng(0)

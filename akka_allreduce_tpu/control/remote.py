@@ -36,12 +36,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import socket
 import time
 import weakref
 from collections import deque
 from typing import Any, Awaitable, Callable
 
+from akka_allreduce_tpu.config import RetryPolicy
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.control.cluster import Endpoint
 from akka_allreduce_tpu.control.envelope import Envelope
@@ -63,8 +65,13 @@ _DROP_EMPTY = _metrics.counter("transport.dropped.empty_frame")
 _DROP_FILTERED = _metrics.counter("transport.dropped.drop_filter")
 _DROP_BACKPRESSURE = _metrics.counter("transport.dropped.backpressure")
 _DROP_SEND_FAILED = _metrics.counter("transport.dropped.send_failed")
+_DROP_CHAOS = _metrics.counter("transport.dropped.chaos")
 _DELIVERED = _metrics.counter("transport.delivered")
 _HANDLER_ERRORS = _metrics.counter("transport.handler_errors")
+# every reconnect-retry any sender performed in this process (satellite of
+# the chaos PR: a flight dump must show WHY a peer was declared dead — the
+# per-endpoint detail rides the pull-time collector below)
+_RECONNECTS = _metrics.counter("remote.endpoint_reconnects")
 
 Handler = Callable[[Any], list[Envelope]]
 PrefixHandler = Callable[[int, Any], list[Envelope]]
@@ -132,17 +139,34 @@ _live_transports: "weakref.WeakSet" = weakref.WeakSet()
 def _collect_transport_stats() -> dict:
     stages: dict[str, float] = {}
     delivered = dropped = 0
+    endpoints: dict[str, dict] = {}
     for t in list(_live_transports):
         for k, v in t.stage_seconds.items():
             stages[k] = stages.get(k, 0.0) + v
         delivered += t.delivered
         dropped += t.dropped
+        for ep, n in t.endpoint_reconnects.items():
+            rec = endpoints.setdefault(
+                f"{ep.host}:{ep.port}", {"reconnects": 0, "backoff_s": 0.0}
+            )
+            rec["reconnects"] += n
+            rec["backoff_s"] = max(
+                rec["backoff_s"], t.endpoint_backoff.get(ep, 0.0)
+            )
     out = {
         f"transport.stage_seconds.{k}": round(v, 6) for k, v in stages.items()
     }
     out["transport.instances"] = len(list(_live_transports))
     out["transport.delivered_live"] = delivered
     out["transport.dropped_live"] = dropped
+    # per-endpoint escalation state: how many reconnect-retries this process
+    # burned against each peer and the backoff currently in force — the
+    # flight-recorder's "why was this peer declared dead" line
+    for key, rec in sorted(endpoints.items()):
+        out[f"transport.endpoint.{key}.reconnects"] = rec["reconnects"]
+        out[f"transport.endpoint.{key}.backoff_s"] = round(
+            rec["backoff_s"], 4
+        )
     return out
 
 
@@ -177,7 +201,7 @@ class _Sender:
     """
 
     __slots__ = (
-        "queue", "queued_bytes", "sock", "writer_task", "retry_ok",
+        "queue", "queued_bytes", "sock", "writer_task", "attempts",
         "waiters", "closed",
     )
 
@@ -186,11 +210,11 @@ class _Sender:
         self.queued_bytes = 0
         self.sock: socket.socket | None = None
         self.writer_task: asyncio.Task | None = None
-        # one reconnect-and-retry is allowed after a period of success: a
-        # cached connection whose peer restarted fails on the first write
-        # after the restart — that staleness is this transport's problem. A
-        # failure on a FRESH connection means the peer is genuinely gone.
-        self.retry_ok = False
+        # consecutive failures in the CURRENT burst (connect or send); a
+        # burst may consume up to RetryPolicy.max_retries reconnect-resend
+        # cycles (exponential backoff + full jitter) before the queue is
+        # declared dead. Reset to zero by any successfully sent batch.
+        self.attempts = 0
         self.waiters: list[asyncio.Future] = []
         self.closed = False
 
@@ -201,7 +225,6 @@ class _Sender:
             except OSError:  # pragma: no cover - close is best effort
                 pass
             self.sock = None
-        self.retry_ok = False
 
     def close(self) -> None:
         self.closed = True
@@ -399,6 +422,19 @@ class RemoteTransport:
         # fault injection (the reference tests by omitting messages,
         # SURVEY.md §5): return True to swallow an outgoing envelope
         self.drop_filter: Callable[[Envelope], bool] | None = None
+        # the chaos hook point (control/chaos.py): when set, every envelope
+        # headed to the wire is offered to plan_send and the returned
+        # ChaosAction is applied (drop/fail/delay/duplicate/corrupt)
+        self.chaos = None  # control.chaos.ChaosInjector | None
+        # send-retry escalation (config.RetryPolicy): reconnect budget and
+        # backoff shape per failure burst, distributed via Welcome
+        self.retry_policy = RetryPolicy()
+        # per-endpoint escalation bookkeeping, exported by the pull-time
+        # collector so flight dumps show why a peer was declared dead
+        self.endpoint_reconnects: dict[Endpoint, int] = {}
+        self.endpoint_backoff: dict[Endpoint, float] = {}
+        self._chaos_tasks: set[asyncio.Task] = set()
+        self._stopped = False
         # wire compression (MetaDataConfig.wire_dtype == "f16"): float
         # payloads cross the socket at half width; local deliveries and the
         # decode side are unaffected (the flag travels in the frame)
@@ -420,6 +456,7 @@ class RemoteTransport:
     # -- lifecycle -------------------------------------------------------------
 
     async def start(self) -> Endpoint:
+        self._stopped = False
         loop = asyncio.get_running_loop()
         self._server = await loop.create_server(
             lambda: _FrameReceiver(self), self._host, self._port
@@ -433,6 +470,11 @@ class RemoteTransport:
         return Endpoint(self._host, self._port)
 
     async def stop(self) -> None:
+        self._stopped = True
+        # held (delayed) chaos frames must not re-open senders mid-teardown
+        for task in list(self._chaos_tasks):
+            task.cancel()
+        self._chaos_tasks.clear()
         if self._server is not None:
             self._server.close()
         # close accepted connections BEFORE wait_closed: on Python >= 3.12
@@ -557,6 +599,53 @@ class RemoteTransport:
             if handler is not None:  # local delivery: no wire, same FIFO inbox
                 await self._inbox.put((env.dest, env.msg, None, tctx))
                 return
+        chaos = self.chaos
+        if chaos is not None:
+            act = chaos.plan_send(env)
+            if act is not None:
+                await self._apply_chaos(env, tctx, act)
+                return
+        await self._send_wire(env, tctx)
+
+    async def _apply_chaos(self, env: Envelope, tctx, act) -> None:
+        """Mechanics for a ChaosAction (control/chaos.py) on this envelope."""
+        if act.drop or act.fail:
+            self.dropped += 1
+            _DROP_CHAOS.inc()
+            if act.fail and self.on_send_error is not None:
+                # partition semantics: the loss is OBSERVABLE, like a refused
+                # connection — failure counting (and thus rejoin-on-heal)
+                # must see it, unlike the silent packet-loss `drop`. An
+                # unroutable dest gets no callback, matching the normal
+                # no-route drop (the callback contract promises an Endpoint)
+                ep = env.via if env.via is not None else self._resolve(env.dest)
+                if ep is not None:
+                    self.on_send_error(ep, env)
+            return
+        if act.delay_s > 0:
+            # hold the frame WITHOUT blocking the caller: later sends to the
+            # same peer overtake it, so delay doubles as reordering pressure
+            task = observed_task(
+                self._chaos_delayed(env, tctx, act), name="chaos-delay"
+            )
+            self._chaos_tasks.add(task)
+            task.add_done_callback(self._chaos_tasks.discard)
+            return
+        await self._send_wire(env, tctx, chaos_act=act)
+        if act.duplicate:
+            await self._send_wire(env, tctx)
+
+    async def _chaos_delayed(self, env: Envelope, tctx, act) -> None:
+        await asyncio.sleep(act.delay_s)
+        if self._stopped:
+            return
+        await self._send_wire(env, tctx, chaos_act=act)
+        if act.duplicate:
+            await self._send_wire(env, tctx)
+
+    async def _send_wire(self, env: Envelope, tctx, *, chaos_act=None) -> None:
+        if self._stopped:
+            return  # a held chaos frame outlived the transport
         ep = env.via if env.via is not None else self._resolve(env.dest)
         if ep is None:
             log.warning("no route for %s; dropping", env.dest)
@@ -567,6 +656,8 @@ class RemoteTransport:
         parts = wire.encode_frame_parts(
             env.dest, env.msg, f16=self.wire_f16, trace=tctx
         )
+        if chaos_act is not None and chaos_act.corrupt:
+            parts = self.chaos.corrupt_frame_parts(parts, chaos_act)
         self.stage_seconds["encode"] += time.perf_counter() - t0
         _flight.set_state("transport.last_stage", "encode")
         sender = self._senders.get(ep)
@@ -703,16 +794,21 @@ class RemoteTransport:
         at the control plane relies on per-send callbacks).
 
         This fires only after the writer's full escalation — a bounded send
-        on the existing connection, then a reconnect AND a bounded resend —
-        has failed, so a burst of callbacks here means the peer was
-        unresponsive across two connection lifetimes (>= 2x
-        connect_timeout_s), not one transient stall; a briefly-slow peer is
-        absorbed by the retry and the kernel buffer."""
+        on the existing connection, then ``retry_policy.max_retries``
+        reconnect-and-resend cycles with jittered backoff — has failed, so
+        a burst of callbacks here means the peer was unresponsive across
+        several connection lifetimes, not one transient stall; a
+        briefly-slow peer is absorbed by the retries and the kernel
+        buffer."""
         log.warning("send to %s failed: %s", ep, exc)
         frames = list(sender.queue)
         sender.queue.clear()
         sender.queued_bytes = 0
         sender.close_sock()
+        # the burst is over: a LATER send to this endpoint starts a fresh
+        # retry budget (the peer may have come back)
+        sender.attempts = 0
+        self.endpoint_backoff[ep] = 0.0
         sender.wake_waiters()
         for frame in frames:
             for env in frame.envs:
@@ -721,17 +817,52 @@ class RemoteTransport:
                 if self.on_send_error is not None:
                     self.on_send_error(ep, env)
 
+    def _note_retry(self, ep: Endpoint, sender: _Sender) -> float | None:
+        """Burn one retry of the burst's budget (``retry_policy``): record
+        the escalation and return the jittered backoff to sleep, or
+        ``None`` when the budget is exhausted — the caller escalates to
+        ``_fail_sender``. The sleep itself belongs to the CALLER, outside
+        the stage-timing window (idle backoff must never read as
+        socket_write time in the per-stage profile)."""
+        sender.attempts += 1
+        if sender.attempts > self.retry_policy.max_retries or sender.closed:
+            return None
+        backoff = self.retry_policy.backoff_s(
+            sender.attempts - 1, random.random()
+        )
+        self.endpoint_reconnects[ep] = (
+            self.endpoint_reconnects.get(ep, 0) + 1
+        )
+        self.endpoint_backoff[ep] = backoff
+        _RECONNECTS.inc()
+        log.info(
+            "send to %s failed; retry %d/%d after %.3fs backoff",
+            ep, sender.attempts, self.retry_policy.max_retries, backoff,
+        )
+        return backoff
+
     async def _drain_sender(self, ep: Endpoint, sender: _Sender) -> None:
         """The endpoint's single writer: drains whole frames, in order, in
-        multi-frame vectored batches; reconnects once per failure burst."""
+        multi-frame vectored batches; a failure burst escalates through the
+        RetryPolicy's reconnect budget (exponential backoff, full jitter)
+        before the queue is declared dead."""
+        backoff: float | None = None
         try:
             while sender.queue and not sender.closed:
+                if backoff is not None:
+                    await asyncio.sleep(backoff)
+                    backoff = None
+                    if sender.closed:
+                        return
                 t0 = time.perf_counter()
                 try:
                     if sender.sock is None:
                         try:
                             await self._connect_sender(ep, sender)
                         except (OSError, asyncio.TimeoutError) as exc:
+                            backoff = self._note_retry(ep, sender)
+                            if backoff is not None:
+                                continue
                             self._fail_sender(ep, sender, exc)
                             return
                     batch: list[_Frame] = []
@@ -752,12 +883,11 @@ class RemoteTransport:
                     except (OSError, asyncio.TimeoutError) as exc:
                         # frames stay queued: a retry resends them whole on a
                         # fresh connection (the peer discards the partial
-                        # frame with the broken stream). Read the retry
-                        # permission BEFORE close_sock resets it.
-                        can_retry = sender.retry_ok
+                        # frame with the broken stream)
                         sender.close_sock()
-                        if can_retry:
-                            continue  # one reconnect-retry per burst
+                        backoff = self._note_retry(ep, sender)
+                        if backoff is not None:
+                            continue
                         self._fail_sender(ep, sender, exc)
                         return
                 finally:
@@ -765,7 +895,9 @@ class RemoteTransport:
                         time.perf_counter() - t0
                     )
                     _flight.set_state("transport.last_stage", "socket_write")
-                sender.retry_ok = True
+                if sender.attempts:
+                    sender.attempts = 0  # a sent batch ends the burst
+                    self.endpoint_backoff[ep] = 0.0
                 for frame in batch:
                     sender.queue.popleft()
                     sender.queued_bytes -= frame.nbytes
@@ -850,12 +982,19 @@ class RemoteTransport:
                 self._release_recv_buf(buf)
 
     async def drain(self, timeout: float = 5.0) -> None:
-        """Wait until the local inbox is empty (test convenience)."""
-        deadline = asyncio.get_event_loop().time() + timeout
+        """Wait until the local inbox is empty (test convenience).
+
+        Polls with a growing sleep (1ms -> 50ms) instead of a fixed tight
+        interval, on the RUNNING loop's clock — shutdown paths that call
+        this must never busy-spin the event loop."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        pause = 0.001
         while not self._inbox.empty():
-            if asyncio.get_event_loop().time() > deadline:
+            if loop.time() > deadline:
                 raise TimeoutError("transport did not drain")
-            await asyncio.sleep(0.01)
+            await asyncio.sleep(pause)
+            pause = min(pause * 2.0, 0.05)
 
 
 async def _wait_writable(

@@ -177,7 +177,18 @@ class AllreduceWorker:
             else:  # stale round: already completed locally
                 self.dropped_messages += 1
                 _DROPPED.inc()
-                return []
+                # the master re-Starts a stalled round at workers missing
+                # from its completion set — being asked about a round we
+                # already finished means our CompleteAllreduce was lost
+                # (at-most-once): re-assert it. Idempotent at the line
+                # master (stale/duplicate completions are ignored).
+                assert self.worker_id is not None
+                return [
+                    Envelope(
+                        master_addr(self.line_id),
+                        CompleteAllreduce(self.worker_id, r),
+                    )
+                ]
         # the round this worker is actively working on — the first thing a
         # flight-recorder post-mortem wants to know
         _ROUND_IN_FLIGHT.set(r)

@@ -68,6 +68,7 @@ def run_soak(
     drop_at: int | None = None,
     rejoin_at: int | None = None,
     restore_at: int | None = None,
+    chaos_seed: int | None = None,
     checkpoint_every: int = 100,
     checkpoint_dir: str | None = None,
     delta: bool = False,
@@ -77,7 +78,13 @@ def run_soak(
     """Run the composed soak loop; every disruption is induced from
     inside (no manual intervention). Defaults follow the round-4 flagship
     recipe (``--remat params --prefetch --compress int8``); the drop /
-    rejoin / restore steps default to 1/4, 1/2 and 3/4 of the run."""
+    rejoin / restore steps default to 1/4, 1/2 and 3/4 of the run.
+
+    ``chaos_seed`` (``soak --chaos SEED``) swaps the single scripted
+    drop/rejoin for a deterministic seeded schedule of per-node silence
+    windows (``control.chaos.membership_schedule``): each node other than
+    0 independently flaps in and out, so one run exercises MANY detector
+    trips and re-meshes — and the same seed replays the same churn."""
     import tempfile
 
     import jax
@@ -131,11 +138,22 @@ def run_soak(
             compress=compress,
         )
 
+    silent_plan = None
+    if chaos_seed is not None:
+        from akka_allreduce_tpu.control.chaos import membership_schedule
+
+        silent_plan = membership_schedule(chaos_seed, nodes, steps)
     elastic = ElasticTrainer(factory, assignment, clock=lambda: now["t"])
+    churn = (
+        f"chaos seed {chaos_seed} "
+        f"({sum(len(v) for v in silent_plan.values())} node-step silences)"
+        if silent_plan is not None
+        else f"drop@{drop_at} rejoin@{rejoin_at}"
+    )
     log(
         f"soak: {elastic.trainer.param_count / 1e6:.1f}M params over "
         f"{elastic.trainer.n_devices} devices / {nodes} nodes; "
-        f"drop@{drop_at} rejoin@{rejoin_at} restore@{restore_at}"
+        f"{churn} restore@{restore_at}"
     )
 
     ckpt_dir = checkpoint_dir or tempfile.mkdtemp(prefix="soak_ckpt_")
@@ -171,10 +189,14 @@ def run_soak(
         return next(ds.batches(rows, 1, seed_offset=seed))
 
     for step in range(steps):
-        alive = [
-            k for k in range(nodes)
-            if not (drop_at <= step < rejoin_at and k == lost)
-        ]
+        if silent_plan is not None:
+            silent = silent_plan.get(step, frozenset())
+            alive = [k for k in range(nodes) if k not in silent]
+        else:
+            alive = [
+                k for k in range(nodes)
+                if not (drop_at <= step < rejoin_at and k == lost)
+            ]
         for k in alive:
             elastic.heartbeat(k)
         # steady 1 s heartbeat cadence: the detector's interval model

@@ -1,0 +1,762 @@
+"""Chaos layer acceptance (RESILIENCE.md):
+
+- determinism: the same seed fed the same traffic emits a byte-identical
+  chaos event log (the tier-1 ratchet of the same-seed replay guarantee);
+- a 3-node cluster over real loopback TCP converges at th<1.0 under
+  seeded 5% drop + delay (real-subprocess variant via the CLI roles);
+- injected payload corruption is ALWAYS rejected by the tag-2/3 wire
+  checksum on the real socket path — counted per cause (`undecodable`),
+  never silently reduced — and rounds still complete at th<1.0;
+- a healed partition drives Rejoin with an incarnation bump and the
+  cluster re-meshes within 10 heartbeat intervals of the heal;
+- the detector marking a member unreachable mid-round completes in-flight
+  rounds DEGRADED (graceful degradation) instead of wedging at th=1.0;
+- the transport's retry budget escalates through backoff and records
+  per-endpoint reconnect counts before declaring a peer dead;
+- chaos introduces NO new wire tags (arlint WIRE001's surface is pinned).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    ChaosConfig,
+    RetryPolicy,
+)
+from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.control import wire
+from akka_allreduce_tpu.control.chaos import (
+    CRASH_EXIT_CODE,
+    MASTER_ROLE,
+    ChaosInjector,
+    membership_schedule,
+    parse_spec,
+)
+from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.protocol import ReduceBlock, ScatterBlock, StartAllreduce
+from tests.test_remote import (
+    _Harness,
+    _config,
+    _read_master_endpoint,
+    _spawn_cli,
+    wait_until,
+)
+
+# --- spec compilation ---------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    faults = parse_spec(
+        "drop:p=0.05;delay:ms=20,p=0.5,jitter_ms=5;duplicate:p=0.01;"
+        "reorder:p=0.02;corrupt:p=0.01;"
+        "partition:groups=m+0|1+2,at=round10,heal=5s;"
+        "stall:node=1,at=3s,for=2s;crash:node=2,at=round8"
+    )
+    by_name = {f.name: f for f in faults}
+    assert len(faults) == 8
+    assert by_name["drop"].p == 0.05
+    assert by_name["delay"].delay_ms == 20 and by_name["delay"].jitter_ms == 5
+    assert by_name["partition"].groups == (
+        frozenset({MASTER_ROLE, 0}),
+        frozenset({1, 2}),
+    )
+    assert by_name["partition"].at == ("round", 10.0)
+    assert by_name["partition"].until == ("time", 5.0)
+    assert by_name["stall"].node == 1 and by_name["stall"].until == ("time", 2.0)
+    assert by_name["crash"].node == 2 and by_name["crash"].at == ("round", 8.0)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "explode:p=1",  # unknown fault
+        "drop:p=1.5",  # probability out of range
+        "drop:p",  # not k=v
+        "delay:p=0.5",  # delay without ms
+        "partition:at=round3",  # partition without groups
+        "partition:groups=m",  # single group
+        "stall:node=1,at=1s",  # stall without for
+        "crash:at=1s",  # crash without node
+        "partition:groups=m+x|1",  # non-numeric member
+        "drop:q=1",  # unknown param
+        "crash:node=1,at=soon",  # unparseable trigger
+        "crash:node=m,at=1s",  # master crash is not injectable
+    ],
+)
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+# --- determinism (tier-1 ratchet) ---------------------------------------------
+
+
+def _synthetic_traffic(n=400):
+    """A deterministic message stream exercising every fault path."""
+    rng = np.random.default_rng(0)
+    envs = []
+    for i in range(n):
+        r = i // 8
+        kind = i % 4
+        if kind == 0:
+            envs.append(Envelope("worker:1", StartAllreduce(r)))
+        elif kind == 1:
+            envs.append(
+                Envelope(
+                    "worker:2",
+                    ScatterBlock(
+                        rng.standard_normal(16).astype(np.float32), 0, 2, 0, r
+                    ),
+                )
+            )
+        elif kind == 2:
+            envs.append(
+                Envelope(
+                    "worker:0",
+                    ReduceBlock(
+                        rng.standard_normal(16).astype(np.float32),
+                        2, 0, 0, r, count=2,
+                    ),
+                )
+            )
+        else:
+            envs.append(Envelope("master", cl.Heartbeat(0, 1)))
+    return envs
+
+
+_DET_SPEC = (
+    "drop:p=0.08;delay:ms=5,p=0.3,jitter_ms=2;duplicate:p=0.05;"
+    "reorder:p=0.05;corrupt:p=0.2;partition:groups=m|0+1+2,at=round20,heal=round30"
+)
+
+
+def _run_injector(seed, envs, role=0):
+    inj = ChaosInjector(seed, _DET_SPEC, role=role, clock=lambda: 0.0)
+    for env in envs:
+        inj.plan_send(env)
+    return inj
+
+
+def test_same_seed_emits_byte_identical_event_log():
+    """The acceptance pin: two injectors with the same seed, fed the same
+    traffic, produce byte-for-byte identical event logs — chaos runs are
+    REPLAYS, not dice rolls."""
+    envs = _synthetic_traffic()
+    a = _run_injector(1234, envs)
+    b = _run_injector(1234, envs)
+    assert a.events, "spec injected nothing — the ratchet would be vacuous"
+    assert a.event_log_jsonl().encode() == b.event_log_jsonl().encode()
+    # every fault class fired at least once over this stream (coverage of
+    # the determinism claim, not just the easy ones)
+    fired = set(a.counts())
+    assert {"drop", "delay", "duplicate", "reorder", "corrupt", "partition"} <= fired, fired
+
+
+def test_different_seed_or_role_changes_the_log():
+    envs = _synthetic_traffic()
+    base = _run_injector(1234, envs)
+    assert base.event_log_jsonl() != _run_injector(1235, envs).event_log_jsonl()
+    # role is part of the derivation: node 0 and node 1 see different faults
+    assert (
+        base.event_log_jsonl()
+        != _run_injector(1234, envs, role=1).event_log_jsonl()
+    )
+
+
+def test_event_log_carries_no_timestamps():
+    """Byte-identity is only honest if nothing wall-clock-shaped leaks in."""
+    envs = _synthetic_traffic(64)
+    inj = _run_injector(1234, envs)
+    for rec in inj.events:
+        assert "t" not in rec and "time" not in rec
+        assert set(rec) >= {"seq", "fault", "role", "dest", "msg", "round"}
+
+
+def test_membership_schedule_is_deterministic_and_keeps_a_survivor():
+    a = membership_schedule(42, 4, 200)
+    b = membership_schedule(42, 4, 200)
+    assert a == b
+    assert a, "no silence windows generated"
+    assert all(0 not in silent for silent in a.values())  # node 0 never flaps
+    assert membership_schedule(43, 4, 200) != a
+
+
+def test_chaos_introduces_no_new_wire_tags():
+    """Design pin (and the WIRE001 satellite): chaos configuration rides
+    Welcome's config JSON — the wire-tag surface arlint ratchets is
+    UNCHANGED. A new chaos control message must update this test, the
+    codec arms, and a dispatch site together (WIRE001 enforces the rest)."""
+    assert sorted(wire._TAGS.values()) == list(range(1, 14))
+    cfg = AllreduceConfig(chaos=ChaosConfig(seed=9, spec="drop:p=0.5"))
+    roundtrip = AllreduceConfig.from_json(cfg.to_json())
+    assert roundtrip.chaos == ChaosConfig(seed=9, spec="drop:p=0.5")
+    assert roundtrip.master.retry == RetryPolicy()
+
+
+# --- corruption on the real socket path (satellite) ---------------------------
+
+
+def test_injected_corruption_rejected_on_real_socket_path():
+    """Bit-flips injected into in-flight tag-2/3 frames via the chaos hook
+    must ALWAYS be rejected by the wire checksum on the real recv path:
+    the per-cause `undecodable` drop counter accounts for every flip, no
+    corrupted payload ever reaches a handler, and rounds still complete at
+    th<1.0 (the loss is absorbed exactly like a drop)."""
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    undecodable = REGISTRY.counter("transport.dropped.undecodable")
+
+    async def run():
+        cfg = _config(3, max_rounds=6, th=0.66)
+        h = _Harness(cfg, 3)
+        try:
+            await h.start(3)
+            # node 2's transport corrupts EVERY outgoing payload frame
+            h.nodes[2].transport.chaos = ChaosInjector(
+                77, "corrupt:p=1", role=2
+            )
+            u0 = undecodable.value
+            await h.master.run_until_done(timeout=30.0)
+            await h.wait_for(lambda: h.flushes(0) >= 6)
+            corrupted = h.nodes[2].transport.chaos.counts().get("corrupt", 0)
+        finally:
+            await h.stop()
+        assert corrupted > 0
+        # every flip was rejected and COUNTED — none slipped through
+        assert undecodable.value - u0 == corrupted
+        # node 2's data never entered any reduction: elements reduced from
+        # both survivors match the 2-node mean exactly (a single corrupt
+        # float accepted anywhere would show up here)
+        out = h.outputs[0][-1]
+        assert out.count.max() <= 3
+        full = out.count == 2
+        assert full.any()
+        np.testing.assert_allclose(
+            out.average()[full],
+            np.mean(h.inputs[:2], axis=0)[full],
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    asyncio.run(run())
+
+
+# --- drop + delay convergence over real subprocesses (acceptance) -------------
+
+
+def test_subprocess_cluster_converges_under_seeded_drop_and_delay(tmp_path):
+    """The acceptance run: a REAL 3-process cluster (CLI roles over
+    loopback) under seeded 5% drop + 20ms delay completes its whole round
+    budget at th=0.66 — thresholds and the retry/rejoin machinery absorb
+    sustained loss. Every process writes its deterministic chaos log."""
+    out = tmp_path / "chaos"
+    master = _spawn_cli(
+        "cluster-master", "--port", "0", "--nodes", "3", "--rounds", "12",
+        "--size", "16384", "--chunk", "4096", "--th", "0.66",
+        "--heartbeat", "0.1",
+        "--chaos-seed", "42",
+        "--chaos-spec", "drop:p=0.05;delay:ms=20,p=0.5",
+        "--chaos-log", str(out / "master.jsonl"),
+    )
+    out.mkdir()
+    nodes = []
+    try:
+        seed = _read_master_endpoint(master)
+        nodes = [
+            _spawn_cli(
+                "cluster-node", "--seed", seed, "--node-id", str(k),
+                "--chaos-log", str(out / f"node{k}.jsonl"),
+            )
+            for k in range(3)
+        ]
+        # generous wall budget: the run normally finishes in ~10s, but a
+        # loaded box can stretch detector churn + re-mesh cycles a lot
+        out_master, _ = master.communicate(timeout=300)
+        assert "master done: 12 line-rounds" in out_master, out_master
+        for n in nodes:
+            n.communicate(timeout=30)
+            assert n.returncode == 0
+    finally:
+        for proc in [master, *nodes]:
+            if proc.poll() is None:
+                proc.kill()
+    # chaos really ran on the node side (the spec traveled via Welcome):
+    # at least one node injected drops and delays, and the logs are
+    # parseable deterministic records
+    events: dict[str, int] = {}
+    for f in out.glob("node*.jsonl"):
+        for ln in f.read_text().splitlines():
+            rec = json.loads(ln)
+            events[rec["fault"]] = events.get(rec["fault"], 0) + 1
+    assert events.get("drop", 0) > 0 and events.get("delay", 0) > 0, events
+
+
+def test_subprocess_chaos_crash_is_absorbed_and_reported(tmp_path):
+    """The `crash` primitive: a node os._exit()s mid-run by schedule (exit
+    code pins it as injected, not accidental); at th=0.66 the survivors
+    finish the whole budget after the detector expels the corpse."""
+    out = tmp_path / "chaos"
+    out.mkdir()
+    master = _spawn_cli(
+        "cluster-master", "--port", "0", "--nodes", "3", "--rounds", "40",
+        "--size", "16384", "--chunk", "4096", "--th", "0.66",
+        "--heartbeat", "0.1",
+        "--chaos-seed", "7", "--chaos-spec", "crash:node=2,at=round2",
+    )
+    nodes = []
+    try:
+        seed = _read_master_endpoint(master)
+        nodes = [
+            _spawn_cli(
+                "cluster-node", "--seed", seed, "--node-id", str(k),
+                "--chaos-log", str(out / f"node{k}.jsonl"),
+            )
+            for k in range(3)
+        ]
+        out_master, _ = master.communicate(timeout=180)
+        assert "master done: 40 line-rounds" in out_master, out_master
+        exits = {}
+        for k, n in enumerate(nodes):
+            n.communicate(timeout=30)
+            exits[k] = n.returncode
+    finally:
+        for proc in [master, *nodes]:
+            if proc.poll() is None:
+                proc.kill()
+    assert exits[2] == CRASH_EXIT_CODE, exits  # died BY injection
+    assert exits[0] == 0 and exits[1] == 0, exits
+    # the crashing node flushed its chaos log on the way down
+    recs = [
+        json.loads(ln)
+        for ln in (out / "node2.jsonl").read_text().splitlines()
+    ]
+    assert any(r["fault"] == "crash" for r in recs)
+
+
+# --- partition + heal ---------------------------------------------------------
+
+
+def test_partition_heal_drives_rejoin_with_incarnation_bump():
+    """A 2|1 partition (master+node0 | node1) makes node 1's sends FAIL
+    (observable, like a refused connection): its failure counter trips and
+    it starts re-joining with a FRESH incarnation. When the partition
+    heals, the join lands, the master re-meshes, and rounds resume for
+    everyone — within 10 heartbeat intervals of the heal."""
+
+    async def run():
+        hb = 0.1
+        cfg = _config(2, max_rounds=-1, hb=hb)
+        h = _Harness(cfg, 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 2)
+            node = h.nodes[1]
+            node.join_retry_s = 0.05
+            inc_before = node.incarnation
+            loop = asyncio.get_event_loop()
+            heal_after = 1.0
+            t0 = loop.time()
+            clock = lambda: loop.time()  # noqa: E731
+            spec = f"partition:groups=m+0|1,at=0s,heal={heal_after}s"
+            # arm BOTH sides of the cut, as the Welcome distribution would
+            h.master.transport.chaos = ChaosInjector(
+                5, spec, role=MASTER_ROLE, clock=clock, t0=t0
+            )
+            node.transport.chaos = ChaosInjector(
+                5, spec, role=1, clock=clock, t0=t0
+            )
+            # the partitioned node's heartbeats FAIL observably -> it gives
+            # up on the master and re-joins with a new incarnation
+            await h.wait_for(lambda: node._rejoining, timeout=10.0)
+            # silence trips the detector; the survivors keep making rounds
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0], timeout=10.0
+            )
+            f0 = h.flushes(0)
+            await h.wait_for(lambda: h.flushes(0) >= f0 + 2)
+            # after the heal, the re-join must land within 10 heartbeats
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0, 1],
+                timeout=max(heal_after - (loop.time() - t0), 0) + 10 * hb,
+            )
+            assert node.incarnation != inc_before  # the bump happened
+            assert h.master._incarnations[1] == node.incarnation
+            f1 = h.flushes(1)
+            await h.wait_for(lambda: h.flushes(1) >= f1 + 2, timeout=10.0)
+            # both sides logged the partition deterministically
+            assert node.transport.chaos.counts().get("partition", 0) > 0
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+# --- degraded mode ------------------------------------------------------------
+
+
+def test_detector_expulsion_completes_inflight_rounds_degraded():
+    """th=1.0 and one member stops reporting: its data plane still flows
+    (so workers 0/1 finish their rounds and report) but its own
+    CompleteAllreduce and heartbeats vanish — the line master holds 2/3
+    completions forever, a classic th=1.0 wedge. When the detector expels
+    the member, the line master lowers the effective trigger and completes
+    those in-flight rounds GRACEFULLY (counted, observable as
+    master.rounds_degraded) instead of leaving them to a watchdog stall or
+    silent abandonment."""
+    from akka_allreduce_tpu.protocol import CompleteAllreduce
+
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    degraded = REGISTRY.counter("master.rounds_degraded")
+
+    async def run():
+        cfg = _config(3, max_rounds=-1, th=1.0)
+        h = _Harness(cfg, 3)
+        try:
+            await h.start(3)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(3)) >= 2)
+            d0 = degraded.value
+            completed_before = h.master.grid.total_completed
+            # node 2 keeps its data plane but stops REPORTING: completions
+            # and heartbeats drop (the wedge needs 2/3 completions to exist)
+            h.nodes[2].transport.drop_filter = lambda env: isinstance(
+                env.msg, (CompleteAllreduce, cl.Heartbeat)
+            )
+            # the wedged in-flight rounds gather both survivors' reports
+            # (or, if the detector already fired, the degradation itself)
+            await h.wait_for(
+                lambda: degraded.value > d0
+                or any(
+                    len(done) >= 2
+                    for lm in h.master.grid.line_masters.values()
+                    for done in lm.completions.values()
+                ),
+                timeout=10.0,
+            )
+            # ...then the detector expels the silent member and the line
+            # master completes them degraded at that moment
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0, 1], timeout=15.0
+            )
+            assert degraded.value > d0
+            assert h.master.grid.total_completed > completed_before
+            # and the survivor line keeps making normal progress
+            f0 = h.flushes(0)
+            await h.wait_for(lambda: h.flushes(0) >= f0 + 2)
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_line_master_degraded_trigger_unit():
+    """Unit pin of the degradation arithmetic: trigger = min(configured,
+    reachable), floored at 1; prepare() resets the unreachable set."""
+    from akka_allreduce_tpu.config import ThresholdConfig
+    from akka_allreduce_tpu.control.line_master import LineMaster
+    from akka_allreduce_tpu.protocol import CompleteAllreduce, ConfirmPreparation
+
+    lm = LineMaster(ThresholdConfig(1.0, 1.0, 1.0))
+    lm.prepare((0, 1, 2), config_id=1, from_round=0)
+    for w in (0, 1, 2):
+        lm.handle(ConfirmPreparation(1, w))
+    assert lm.completion_trigger == 3
+    # two of three report round 0; at th=1.0 nothing completes
+    lm.handle(CompleteAllreduce(0, 0))
+    lm.handle(CompleteAllreduce(1, 0))
+    assert lm.total_completed == 0
+    # detector marks worker 2 unreachable: round 0 completes degraded
+    lm.member_unreachable([2])
+    assert lm.completion_trigger == 2
+    assert lm.total_completed == 1 and lm.completed_up_to == 0
+    # a fresh prepare clears the degradation
+    lm.prepare((0, 1), config_id=2, from_round=10)
+    assert lm.unreachable == set()
+    assert lm.completion_trigger == 2
+
+    # floor: everyone unreachable still leaves a trigger of 1
+    lm2 = LineMaster(ThresholdConfig(1.0, 1.0, 1.0))
+    lm2.prepare((0,), config_id=1, from_round=0)
+    lm2.member_unreachable([0])
+    assert lm2.completion_trigger == 1
+
+
+def test_stalled_round_restart_and_complete_reassert():
+    """The round-level retry the chaos harness exposed: a round with no
+    completion progress is re-Started at exactly the workers that never
+    reported (rate-limited), and a worker re-Started on a round it already
+    finished re-asserts its lost CompleteAllreduce — together they unwedge
+    the two sustained-loss starvation modes (lost Start / lost Complete)."""
+    from akka_allreduce_tpu.config import ThresholdConfig
+    from akka_allreduce_tpu.control.line_master import LineMaster
+    from akka_allreduce_tpu.protocol import (
+        CompleteAllreduce,
+        ConfirmPreparation,
+    )
+
+    clock = {"t": 0.0}
+    lm = LineMaster(
+        ThresholdConfig(1.0, 1.0, 1.0), clock=lambda: clock["t"]
+    )
+    lm.prepare((0, 1, 2), config_id=1, from_round=0)
+    for w in (0, 1, 2):
+        lm.handle(ConfirmPreparation(1, w))
+    lm.handle(CompleteAllreduce(1, 0))  # only worker 1 reported round 0
+    assert lm.restart_stalled(0.5) == []  # too young
+    clock["t"] = 1.0
+    out = lm.restart_stalled(0.5)
+    # re-Start goes to the silent workers only, carrying the round number
+    assert sorted(e.dest for e in out if e.msg.round_num == 0) == [
+        "worker:0", "worker:2",
+    ]
+    assert all("worker:1" != e.dest or e.msg.round_num != 0 for e in out)
+    assert lm.restart_stalled(0.5) == []  # rate-limited until it ages again
+    clock["t"] = 2.0
+    assert lm.restart_stalled(0.5)  # still stalled: fires again
+
+    # the worker side: a Start for an already-completed round re-asserts
+    from akka_allreduce_tpu.config import MetaDataConfig, WorkerConfig
+    from akka_allreduce_tpu.control.worker import AllreduceWorker
+    from akka_allreduce_tpu.protocol import (
+        AllReduceInput,
+        PrepareAllreduce,
+        StartAllreduce,
+    )
+
+    w = AllreduceWorker(
+        lambda req: AllReduceInput(np.ones(8, np.float32)),
+        lambda out: None,
+        WorkerConfig(),
+    )
+    w.configure(MetaDataConfig(data_size=8, max_chunk_size=8), lm.threshold)
+    w.handle(PrepareAllreduce(1, (0,), 0, 5, line_id=0))
+    replies = w.handle(StartAllreduce(3))  # r=3 < from_round=5: stale
+    assert [type(e.msg).__name__ for e in replies] == ["CompleteAllreduce"]
+    assert replies[0].msg.round_num == 3
+    assert replies[0].dest == "line_master:0"
+
+
+# --- retry/backoff hardening --------------------------------------------------
+
+
+def test_retry_policy_validation_and_jitter_shape():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_base_s=0)
+    pol = RetryPolicy(max_retries=3, backoff_base_s=0.1, backoff_max_s=0.5)
+    # full jitter: u scales the exponentially-growing cap
+    assert pol.backoff_s(0, 1.0) == pytest.approx(0.1)
+    assert pol.backoff_s(1, 1.0) == pytest.approx(0.2)
+    assert pol.backoff_s(4, 1.0) == pytest.approx(0.5)  # capped
+    assert pol.backoff_s(2, 0.0) == 0.0  # jitter can land anywhere in [0, cap)
+
+
+def test_send_failure_burst_consumes_retry_budget_and_is_counted():
+    """A dead endpoint: the writer escalates through the configured retry
+    budget (reconnect attempts are COUNTED per endpoint, with the backoff
+    gauge visible to flight dumps) and then fails every queued envelope
+    via on_send_error."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    reconnects = REGISTRY.counter("remote.endpoint_reconnects")
+
+    async def run():
+        import socket as socketmod
+
+        # a port with NOTHING listening (bind+close reserves then frees it)
+        s = socketmod.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        tx = RemoteTransport(connect_timeout_s=0.5)
+        tx.retry_policy = RetryPolicy(
+            max_retries=2, backoff_base_s=0.01, backoff_max_s=0.05
+        )
+        failed: list = []
+        tx.on_send_error = lambda ep, env: failed.append(env)
+        await tx.start()
+        dead = cl.Endpoint("127.0.0.1", dead_port)
+        tx.set_route("sink", dead)
+        r0 = reconnects.value
+        try:
+            await tx.send(Envelope("sink", StartAllreduce(1)))
+            await wait_until(lambda: len(failed) == 1, 10.0)
+            # budget consumed: exactly max_retries reconnect attempts
+            assert tx.endpoint_reconnects[dead] == 2
+            assert reconnects.value - r0 == 2
+            # the collector exports the per-endpoint escalation state
+            snap = REGISTRY.snapshot()
+            key = f"transport.endpoint.127.0.0.1:{dead_port}.reconnects"
+            assert snap[key] >= 2
+            # a later burst starts a FRESH budget
+            await tx.send(Envelope("sink", StartAllreduce(2)))
+            await wait_until(lambda: len(failed) == 2, 10.0)
+            assert tx.endpoint_reconnects[dead] == 4
+        finally:
+            await tx.stop()
+
+    asyncio.run(run())
+
+
+def test_zero_retries_fails_fast():
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+
+    async def run():
+        import socket as socketmod
+
+        s = socketmod.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        tx = RemoteTransport(connect_timeout_s=0.5)
+        tx.retry_policy = RetryPolicy(max_retries=0)
+        failed: list = []
+        tx.on_send_error = lambda ep, env: failed.append(env)
+        await tx.start()
+        tx.set_route("sink", cl.Endpoint("127.0.0.1", dead_port))
+        try:
+            await tx.send(Envelope("sink", StartAllreduce(1)))
+            await wait_until(lambda: len(failed) == 1, 5.0)
+            assert tx.endpoint_reconnects == {}
+        finally:
+            await tx.stop()
+
+    asyncio.run(run())
+
+
+# --- transport chaos mechanics ------------------------------------------------
+
+
+def test_transport_chaos_drop_delay_duplicate_mechanics():
+    """The RemoteTransport applies planned actions faithfully: drops are
+    counted per cause, delayed frames arrive (late), duplicates arrive
+    twice — all over the real socket."""
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+    from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+    chaos_drops = REGISTRY.counter("transport.dropped.chaos")
+
+    async def run():
+        rx, tx = RemoteTransport(), RemoteTransport()
+        got: list[int] = []
+        rx.register("sink", lambda m: got.append(m.round_num) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        tx.chaos = ChaosInjector(
+            11,
+            "drop:p=0.3;delay:ms=10,p=0.3;duplicate:p=0.2",
+            role=0,
+            clock=lambda: 0.0,
+        )
+        c0 = chaos_drops.value
+        try:
+            n = 60
+            for r in range(n):
+                await tx.send(Envelope("sink", StartAllreduce(r)))
+            counts = tx.chaos.counts()
+            dropped = counts.get("drop", 0)
+            dups = counts.get("duplicate", 0)
+            assert dropped and dups and counts.get("delay"), counts
+            # duplicates minus drops: every surviving frame arrives, the
+            # duplicated ones twice (delays only change WHEN)
+            expect = n - dropped + dups
+            await wait_until(lambda: len(got) == expect, 10.0)
+            assert chaos_drops.value - c0 == dropped
+            assert set(got) == {
+                r for r in range(n)
+            } - {e["round"] for e in tx.chaos.events if e["fault"] == "drop"}
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_local_router_chaos_corrupt_and_drop():
+    """The SAME injector drives the in-process router: drops are counted
+    and corruption goes through the real wire codec, where the checksum
+    rejects it (in-process mode exercises the rejection path too)."""
+    from akka_allreduce_tpu.control.local import LocalRouter
+
+    router = LocalRouter()
+    got: list = []
+    router.register("worker:1", lambda m: got.append(m) or [])
+    router.chaos = ChaosInjector(21, "corrupt:p=1", role=MASTER_ROLE)
+    payload = np.arange(32, dtype=np.float32)
+    router.send_all(
+        [Envelope("worker:1", ScatterBlock(payload, 0, 1, 0, r)) for r in range(5)]
+    )
+    router.run()
+    assert got == []  # every corrupted frame was rejected by the checksum
+    assert router.dropped == 5
+    assert router.chaos.counts()["corrupt"] == 5
+
+    router2 = LocalRouter()
+    got2: list = []
+    router2.register("worker:1", lambda m: got2.append(m.round_num) or [])
+    router2.chaos = ChaosInjector(22, "drop:p=0.5", role=MASTER_ROLE)
+    router2.send_all(
+        [Envelope("worker:1", StartAllreduce(r)) for r in range(40)]
+    )
+    router2.run()
+    dropped = router2.chaos.counts()["drop"]
+    assert dropped and len(got2) == 40 - dropped
+
+
+def test_crash_is_suppressed_in_process():
+    """allow_crash=False (the in-process default): a fired crash fault is
+    RECORDED, never executed — the harness must not kill the test runner."""
+    inj = ChaosInjector(1, "crash:node=0,at=0s", role=0, clock=lambda: 1.0)
+    inj.plan_send(Envelope("master", cl.Heartbeat(0, 1)))
+    assert inj.crashes_suppressed == 1
+    assert [e["fault"] for e in inj.events] == ["crash"]
+    # the log records what HAPPENED: a suppressed crash, not an exit
+    assert inj.events[0]["suppressed"] is True and "exit" not in inj.events[0]
+    # one-shot: it does not fire again
+    inj.plan_send(Envelope("master", cl.Heartbeat(0, 1)))
+    assert inj.crashes_suppressed == 1
+
+
+def test_stall_peer_holds_outgoing_then_recovers():
+    """stall_peer freezes a node's outbound traffic for a window (the
+    app-level analog of a SIGSTOP'd process): the master's detector expels
+    it, and when the window ends its heartbeats resume and the master
+    re-lines it without a new join."""
+
+    async def run():
+        hb = 0.1
+        cfg = _config(2, max_rounds=-1, hb=hb)
+        h = _Harness(cfg, 2)
+        try:
+            await h.start(2)
+            await h.wait_for(lambda: min(h.flushes(i) for i in range(2)) >= 1)
+            loop = asyncio.get_event_loop()
+            h.nodes[1].transport.chaos = ChaosInjector(
+                8,
+                "stall:node=1,at=0s,for=1.2s",
+                role=1,
+                clock=lambda: loop.time(),
+            )
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0], timeout=15.0
+            )
+            # window over: held/new heartbeats flow again -> re-lined
+            await h.wait_for(
+                lambda: sorted(h.master.grid.nodes) == [0, 1], timeout=15.0
+            )
+            f1 = h.flushes(1)
+            await h.wait_for(lambda: h.flushes(1) >= f1 + 2, timeout=10.0)
+            assert h.nodes[1].transport.chaos.counts().get("stall", 0) > 0
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
