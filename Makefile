@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo chaos
+.PHONY: lint lint-json baseline native test tier1 trace-demo chaos chaos-recover
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -37,6 +37,16 @@ chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu chaos --seed 1234 \
 	  --duration 30 --nodes 3 --th 0.66 --out-dir chaos_run \
 	  --spec "drop:p=0.05;delay:ms=10;corrupt:p=0.02;partition:groups=m+0+1|2,at=10s,heal=8s"
+
+# fixed-seed crash + disk-loss recovery drill (RESILIENCE.md "Recovery"):
+# one node's seeded chaos crash is followed by deleting its checkpoint
+# directory; the respawned node must restore its state from live peer
+# replicas (byte-identical blobs) and the round budget must still finish.
+# Exit 0 iff every assertion holds; tests/test_peer_restore.py runs the
+# same scenario inside tier-1.
+chaos-recover:
+	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
+	  chaos-recover --seed 1234 --out-dir chaos_recover_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -1104,20 +1104,39 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         help="write this node's chaos event log (JSONL) on exit; the "
         "chaos spec itself arrives from the master via Welcome",
     )
+    p.add_argument(
+        "--state-dir", default=None, metavar="DIR",
+        help="arm peer state transfer (RESILIENCE.md 'Recovery'): "
+        "delta-checkpoint this node's running state here, replicate the "
+        "chunks to peers after every save, and on (re)join restore from "
+        "disk — or, when this directory is gone, pull the chunks back "
+        "from live peers",
+    )
+    p.add_argument(
+        "--state-every", type=int, default=5,
+        help="save + replicate state every N flushed rounds",
+    )
+    p.add_argument(
+        "--replicas", type=int, default=2,
+        help="how many peers each checkpoint is pushed to (K)",
+    )
     _add_obs_flags(p)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     _install_obs(args)
 
     import asyncio
+    import json
 
     import numpy as np
 
     from akka_allreduce_tpu.control.bootstrap import NodeProcess
     from akka_allreduce_tpu.control.cluster import Endpoint
+    from akka_allreduce_tpu.control.remote import observed_task
     from akka_allreduce_tpu.protocol import AllReduceInput
 
-    state = {"payload": None, "flushes": 0, "t0": None}
+    state = {"payload": None, "flushes": 0, "t0": None, "node": None,
+             "save_task": None, "step_base": 0, "save_enabled": False}
 
     def source(req):
         if state["payload"] is None:
@@ -1126,6 +1145,31 @@ def _cmd_cluster_node(argv: list[str]) -> int:
 
     def sink(out):
         state["flushes"] += 1
+        node = state["node"]
+        n = state["flushes"]
+        if (
+            node is None
+            or node.state is None
+            or not state["save_enabled"]
+            or not args.state_every
+            or n % args.state_every
+        ):
+            # saves stay gated until the startup restore DECIDED: a reborn
+            # node writing fresh saves into its emptied store mid-restore
+            # would shadow the peer state it is trying to recover
+            return
+        prev = state["save_task"]
+        if prev is not None and not prev.done():
+            return  # bounded: at most one save+replicate cycle in flight
+        snap = {
+            "payload": state["payload"],
+            # the reduced view aliases a recycled recv buffer — snapshot it
+            "reduced": np.array(out.data, dtype=np.float32, copy=True),
+        }
+        step = state["step_base"] + n
+        state["save_task"] = observed_task(
+            node.save_state(step, snap), name=f"state-save-{step}"
+        )
 
     async def run() -> int:
         node = NodeProcess(
@@ -1138,7 +1182,10 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             # real OS process: the chaos `crash` fault may os._exit here
             allow_crash=True,
             chaos_log=args.chaos_log,
+            state_dir=args.state_dir,
+            replicas=args.replicas,
         )
+        state["node"] = node
         await node.start()
         nid = await node.wait_welcomed()
         size = node.config.metadata.data_size
@@ -1146,6 +1193,31 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         state["payload"] = (
             np.random.default_rng(seed).standard_normal(size).astype(np.float32)
         )
+        if args.state_dir:
+            # the rejoin restore path: disk when it is current, else a
+            # parallel chunk pull from live peer holders (statetransfer)
+            rest = await node.restore_state()
+            if rest is not None and rest.get("complete"):
+                try:
+                    step, saved = node.state.store.load_state()
+                except (FileNotFoundError, ValueError) as e:
+                    print(f"state restore unreadable: {e}", flush=True)
+                else:
+                    payload = saved.get("payload")
+                    if payload is not None and payload.size == size:
+                        state["payload"] = np.ascontiguousarray(
+                            payload, dtype=np.float32
+                        )
+                    # continue the save-step numbering where it left off so
+                    # post-restore adverts stay monotonic (flushes itself
+                    # keeps counting only THIS process's rounds)
+                    state["step_base"] = int(step)
+            state["save_enabled"] = True
+            print(
+                "RESTORE "
+                + json.dumps(rest if rest is not None else {"source": "none"}),
+                flush=True,
+            )
         state["t0"] = time.perf_counter()
         cpu0 = time.process_time()
         print(f"node {nid} joined {args.seed}", flush=True)
@@ -2138,8 +2210,18 @@ def _cmd_soak(argv: list[str]) -> int:
         "--delta-checkpoint", action="store_true",
         help="async delta store instead of async Orbax",
     )
+    p.add_argument(
+        "--peer-restore", action="store_true",
+        help="requires --delta-checkpoint: replicate every completed delta "
+        "save into a replica chunk store, WIPE the local store at the "
+        "mid-run restore (disk loss), and rebuild it chunk-verified from "
+        "the replica — the report's restore.source reads 'peer' and the "
+        "disk-vs-peer A/B is one JSON field (RESILIENCE.md 'Recovery')",
+    )
     p.add_argument("--metrics-out", default=None)
     args = p.parse_args(argv)
+    if args.peer_restore and not args.delta_checkpoint:
+        p.error("--peer-restore replicates delta chunks; add --delta-checkpoint")
     if args.remat == "full" and not args.no_prefetch:
         p.error(
             "--remat full excludes prefetch (the prefetched layer rides "
@@ -2171,6 +2253,7 @@ def _cmd_soak(argv: list[str]) -> int:
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         delta=args.delta_checkpoint,
+        peer_restore=args.peer_restore,
         metrics_out=args.metrics_out,
     )
     print(json.dumps(report.as_dict()))
@@ -2343,6 +2426,310 @@ def _cmd_chaos(argv: list[str]) -> int:
         and (args.duration is not None or master_done)
     )
     return 0 if ok else 1
+
+
+def _blobs_match_replicas(
+    state_dirs, victim: int, restore: dict, n_nodes: int, failures: list
+) -> bool | None:
+    """Byte-identity for the chaos-recover drill, against the RESTORE
+    record's own leaf->sha evidence (printed by the node at restore time —
+    immune to the node's later saves/prunes racing this check): every
+    restored blob must exist on some replica with bytes that hash back to
+    its content-addressed name (the same verify gate the restore itself
+    passed — hash equality IS byte equality here), and when the victim's
+    copy is still on disk it is compared raw as well."""
+    from akka_allreduce_tpu.control.statetransfer import ChunkStore, npy_sha
+
+    shas = set(restore.get("leaves", {}).values())
+    if not shas:
+        failures.append("restore record carries no leaf evidence")
+        return None
+    own = ChunkStore(state_dirs[victim])
+    ok = True
+    for sha in sorted(shas):
+        replica_bytes = None
+        for k in range(n_nodes):
+            if k == victim:
+                continue
+            peer = ChunkStore(state_dirs[k])
+            try:
+                # the replicas are LIVE and pruning; a blob vanishing
+                # between has() and read() is the next peer's problem,
+                # not a harness crash
+                if peer.has(sha):
+                    replica_bytes = peer.read(sha)
+                    break
+            except FileNotFoundError:
+                continue
+        if replica_bytes is None:
+            ok = False
+            failures.append(f"blob {sha[:12]} held by no replica")
+            continue
+        if npy_sha(replica_bytes) != sha:
+            ok = False
+            failures.append(f"replica blob {sha[:12]} fails content hash")
+        try:
+            mine = own.read(sha) if own.has(sha) else None
+        except FileNotFoundError:  # pruned between has() and read()
+            mine = None
+        if mine is not None and mine != replica_bytes:
+            ok = False
+            failures.append(f"blob {sha[:12]} differs from replica")
+    return ok
+
+
+def _cmd_chaos_recover(argv: list[str]) -> int:
+    """Crash + disk-loss recovery drill (RESILIENCE.md "Recovery", ISSUE 6
+    acceptance): a real master + N state-armed node processes run a round
+    budget under a SEEDED chaos crash of one node; the harness then deletes
+    the crashed node's checkpoint directory (disk loss) and respawns it.
+    The node must rejoin, pull its state back from live peer replicas
+    (``RESTORE {"source": "peer", ...}``), keep contributing, and the
+    budget must finish — with the restored blobs byte-identical to the
+    replica copies. ``make chaos-recover`` runs the fixed-seed variant;
+    tests/test_peer_restore.py wires it into tier-1."""
+    p = argparse.ArgumentParser(
+        "chaos-recover",
+        description="seeded crash + checkpoint-dir loss; assert the node "
+        "recovers via peer restore and the round budget completes",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument(
+        "--crash-round", type=int, default=25,
+        help="round at which the victim's seeded crash fires (several "
+        "save/replicate cycles must fit before it — see --state-every)",
+    )
+    p.add_argument(
+        "--min-post-rounds", type=int, default=40,
+        help="full-membership rounds that must complete AFTER the peer "
+        "restore before the run is allowed to finish (the post-recovery "
+        "half of the training budget)",
+    )
+    p.add_argument(
+        "--phase-timeout", type=float, default=240.0,
+        help="wall-clock bound on each recovery phase",
+    )
+    p.add_argument("--size", type=int, default=65536)
+    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument("--state-every", type=int, default=5)
+    p.add_argument("--out-dir", default="chaos_recover_run")
+    args = p.parse_args(argv)
+    if args.nodes < 3:
+        p.error("need >= 3 nodes: the victim plus at least 2 replica holders")
+
+    import json
+    import os
+    import shutil
+    import signal as _signal
+    import subprocess
+    import threading
+
+    from akka_allreduce_tpu.control.chaos import CRASH_EXIT_CODE
+
+    victim = args.nodes - 1
+    spec = f"crash:node={victim},at=round{args.crash_round}"
+    os.makedirs(args.out_dir, exist_ok=True)
+    metrics_path = os.path.join(args.out_dir, "rounds.jsonl")
+    if os.path.exists(metrics_path):
+        os.remove(metrics_path)  # MetricsLogger appends; one run per file
+    state_dirs = [
+        os.path.join(args.out_dir, f"state{k}") for k in range(args.nodes)
+    ]
+    for d in state_dirs:
+        if os.path.isdir(d):
+            shutil.rmtree(d)  # a fresh drill must not inherit old state
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def spawn(*cli):
+        return subprocess.Popen(
+            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+
+    def spawn_node(seed_ep, k):
+        return spawn(
+            "cluster-node", "--seed", seed_ep, "--node-id", str(k),
+            "--state-dir", state_dirs[k],
+            "--state-every", str(args.state_every),
+        )
+
+    failures: list[str] = []
+    restore = None
+    crash_exit = None
+    master_done = False
+    byte_identical = None
+    reborn = None
+    reborn_lines: list[str] = []
+    rounds_at_crash = rounds_at_done = 0
+
+    def full_rounds() -> int:
+        """Completed line-rounds with FULL membership so far — post-rejoin
+        progress only counts when the reborn node is back in the line."""
+        if not os.path.exists(metrics_path):
+            return 0
+        n = 0
+        with open(metrics_path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                rec = json.loads(ln)
+                if rec.get("kind") == "round" and rec.get("workers") == args.nodes:
+                    n += 1
+        return n
+
+    def await_phase(pred, what: str) -> bool:
+        deadline = time.monotonic() + args.phase_timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.2)
+        failures.append(f"timed out waiting for {what}")
+        return False
+
+    master = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(args.nodes),
+        "--rounds", "-1", "--size", str(args.size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--chaos-seed", str(args.seed), "--chaos-spec", spec,
+        "--metrics-out", metrics_path,
+    )
+    nodes = []
+    try:
+        seed_ep = None
+        for line in master.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("master never reported its endpoint")
+        nodes = [spawn_node(seed_ep, k) for k in range(args.nodes)]
+        # phase 1: the seeded crash fires (deterministic round trigger; the
+        # run is open-ended, so no machine is "too fast" for the drill)
+        try:
+            crash_exit = nodes[victim].wait(timeout=args.phase_timeout)
+        except subprocess.TimeoutExpired:
+            failures.append("victim never crashed (chaos round not reached)")
+        if crash_exit is not None and crash_exit != CRASH_EXIT_CODE:
+            failures.append(
+                f"victim exited {crash_exit}, not the chaos crash "
+                f"{CRASH_EXIT_CODE}"
+            )
+        rounds_at_crash = full_rounds()
+        # phase 2: the disk dies with the process
+        shutil.rmtree(state_dirs[victim], ignore_errors=True)
+        # phase 3: same identity, empty disk — recovery must come from
+        # peers; its stdout is pumped on a thread so RESTORE is observable
+        # while the cluster keeps running
+        if not failures:
+            reborn = spawn_node(seed_ep, victim)
+            pump = threading.Thread(
+                target=lambda: reborn_lines.extend(reborn.stdout),
+                daemon=True,
+            )
+            pump.start()
+            await_phase(
+                lambda: any(
+                    ln.startswith("RESTORE ") for ln in list(reborn_lines)
+                ),
+                "the respawned node's restore report",
+            )
+            for line in list(reborn_lines):
+                if line.startswith("RESTORE "):
+                    restore = json.loads(line[len("RESTORE "):])
+            # byte-identity is checked NOW, against the RESTORED step's
+            # manifest, while its blobs and the replicas' copies are all
+            # still on disk — the node keeps saving (and pruning) after
+            # this, and the FINAL save's replication is asynchronous, so a
+            # shutdown-time check against `latest()` would race both
+            if restore is not None and restore.get("complete"):
+                byte_identical = _blobs_match_replicas(
+                    state_dirs, victim, restore, args.nodes, failures
+                )
+            # phase 4: the post-recovery training budget — min_post_rounds
+            # MORE full-membership rounds with the restored node in the line
+            target = full_rounds() + args.min_post_rounds
+            await_phase(
+                lambda: full_rounds() >= target,
+                f"{args.min_post_rounds} full-membership rounds post-restore",
+            )
+        rounds_at_done = full_rounds()
+        # phase 5: end the open-ended run gracefully (Shutdown broadcast)
+        master.send_signal(_signal.SIGTERM)
+        try:
+            out_master, _ = master.communicate(timeout=60)
+            master_done = "master done" in out_master
+        except subprocess.TimeoutExpired:
+            failures.append("master did not shut down on SIGTERM")
+        for n in (n for i, n in enumerate(nodes) if i != victim):
+            try:
+                n.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                n.kill()
+        if reborn is not None:
+            # its stdout is owned by the pump thread — wait, don't
+            # communicate (two readers on one pipe)
+            try:
+                reborn.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                reborn.kill()
+            pump.join(timeout=10)
+    finally:
+        for proc in [master, *nodes, *([reborn] if reborn else [])]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    post_rounds = 0
+    for line in reborn_lines:
+        if line.startswith("RESTORE ") and restore is None:
+            restore = json.loads(line[len("RESTORE "):])
+        if "shut down" in line and " rounds" in line:
+            try:
+                post_rounds = int(line.split(":")[-1].split()[0])
+            except ValueError:
+                pass
+    if restore is None:
+        failures.append("respawned node never reported a restore")
+    else:
+        if restore.get("source") != "peer":
+            failures.append(f"restore source {restore.get('source')!r} != 'peer'")
+        if not restore.get("complete"):
+            failures.append("peer restore incomplete")
+        elif byte_identical is None:
+            failures.append("byte-identity was never checked")
+    if not master_done:
+        failures.append("run did not finish cleanly")
+    if reborn is not None and reborn.returncode not in (0, None):
+        failures.append(f"respawned node exited {reborn.returncode}")
+    if not post_rounds:
+        failures.append("no post-restore round progress at the reborn node")
+
+    rounds_completed = 0
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as f:
+            rounds_completed = sum(
+                1 for ln in f
+                if ln.strip() and json.loads(ln).get("kind") == "round"
+            )
+    summary = {
+        "seed": args.seed,
+        "spec": spec,
+        "rounds_completed": rounds_completed,
+        "full_rounds_at_crash": rounds_at_crash,
+        "full_rounds_post_restore": rounds_at_done - rounds_at_crash,
+        "master_done": master_done,
+        "crash_exit": crash_exit,
+        "restore": restore,
+        "post_restore_rounds": post_rounds,
+        "byte_identical": byte_identical,
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    return 0 if not failures else 1
 
 
 def _cmd_obs(argv: list[str]) -> int:
@@ -2535,6 +2922,7 @@ COMMANDS = {
     "elastic-demo": _cmd_elastic_demo,
     "obs": _cmd_obs,
     "chaos": _cmd_chaos,
+    "chaos-recover": _cmd_chaos_recover,
 }
 
 

@@ -45,6 +45,7 @@ from akka_allreduce_tpu.control.remote import (
     observed_task,
     run_periodic,
 )
+from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.control.worker import DataSink, DataSource
 
 log = logging.getLogger(__name__)
@@ -115,10 +116,16 @@ class MasterProcess:
                 role=MASTER_ROLE,
                 dims=config.master.dimensions,
             )
+        # peer checkpoint registry (statetransfer, RESILIENCE.md "Recovery"):
+        # origin node id -> newest advertised manifest + which nodes hold it.
+        # The master never touches chunk BYTES — it is the directory a
+        # rejoiner consults for "what was my newest state, who has it".
+        self._ckpt: dict[int, dict] = {}
         self.transport.register("master", self._on_cluster_msg)
         self.transport.register_prefix("line_master", self.grid.handle_for_line)
         self.transport.set_prefix_route("worker", self._worker_endpoint)
         self.transport.set_prefix_route("node", self.book.get)
+        self.transport.set_prefix_route("ckpt", self._node_endpoint)
         self._poll_task: asyncio.Task | None = None
         self._done = asyncio.Event()
 
@@ -167,6 +174,9 @@ class MasterProcess:
         nid = worker_id // self.config.master.dimensions
         return None if nid in self.unreachable else self.book.get(nid)
 
+    def _node_endpoint(self, node_id: int) -> cl.Endpoint | None:
+        return None if node_id in self.unreachable else self.book.get(node_id)
+
     def _broadcast(self, msg: Any) -> list[Envelope]:
         return [
             Envelope(f"node:{nid}", msg)
@@ -182,6 +192,10 @@ class MasterProcess:
             return self._on_join(msg, now)
         if isinstance(msg, cl.Heartbeat):
             return self._on_heartbeat(msg, now)
+        if isinstance(msg, st.CheckpointAdvert):
+            return self._on_ckpt_advert(msg)
+        if isinstance(msg, st.ManifestRequest):
+            return self._on_manifest_request(msg)
         if isinstance(msg, cl.LeaveCluster):
             self.monitor.leave(msg.node_id, now)
             out = self.grid.member_unreachable(msg.node_id)
@@ -189,8 +203,94 @@ class MasterProcess:
             self.unreachable.discard(msg.node_id)
             self._incarnations.pop(msg.node_id, None)
             self._superseded.pop(msg.node_id, None)
+            # a departed process can no longer serve chunks; its manifests
+            # stay known (replicas may still hold the bytes)
+            self._drop_ckpt_holder(msg.node_id)
             return out + self._broadcast(self._address_book())
         raise TypeError(f"master cannot handle {type(msg).__name__}")
+
+    # -- peer checkpoint registry ----------------------------------------------
+
+    #: manifests remembered per origin — enough to fall back past an
+    #: owner-only newest step (saved, crashed before replication finished)
+    _CKPT_KEEP = 3
+
+    def _on_ckpt_advert(self, msg: st.CheckpointAdvert) -> list[Envelope]:
+        rec = self._ckpt.setdefault(msg.origin, {"manifests": {}, "holders": {}})
+        if msg.manifest_json:
+            manifests = rec["manifests"]
+            manifests[msg.step] = msg.manifest_json
+            for old in sorted(manifests)[: -self._CKPT_KEEP]:
+                manifests.pop(old)
+        holders = rec["holders"]
+        holders[msg.node_id] = max(holders.get(msg.node_id, -1), msg.step)
+        log.info(
+            "master: node %d holds checkpoint of node %d at step %d",
+            msg.node_id, msg.origin, msg.step,
+        )
+        return []
+
+    def _on_manifest_request(self, msg: st.ManifestRequest) -> list[Envelope]:
+        """Answer with the NEWEST step that has at least one live holder
+        other than the requester — not merely the newest step advertised:
+        an owner that saved and then crashed before replication finished
+        must get its replicas' (slightly older) step back, not an
+        unservable newest step and a dead end.
+
+        When NO step has a complete live holder (the owner died mid-
+        replication — partial replicas hold chunks but never advertised),
+        fall back to SCAVENGE mode: offer the OLDEST remembered manifest
+        (its chunks were pushed first, so they are the most likely to have
+        landed) with every live member as a candidate — content addressing
+        plus the rejoiner's per-chunk ChunkMissing failover reassemble the
+        state from whatever partial replicas hold; a chunk that truly
+        exists nowhere surfaces as an incomplete restore, not a wedge."""
+        rec = self._ckpt.get(msg.node_id)
+        reply = st.ManifestReply(-1, "", ())
+        if rec is not None and rec["manifests"]:
+            for step in sorted(rec["manifests"], reverse=True):
+                holders = tuple(
+                    sorted(
+                        nid
+                        for nid, hstep in rec["holders"].items()
+                        if hstep >= step
+                        and nid != msg.node_id
+                        and nid in self.book
+                        and nid not in self.unreachable
+                    )
+                )
+                if holders:
+                    reply = st.ManifestReply(
+                        step, rec["manifests"][step], holders
+                    )
+                    break
+            else:
+                candidates = tuple(
+                    sorted(
+                        nid
+                        for nid in self.book
+                        if nid != msg.node_id and nid not in self.unreachable
+                    )
+                )
+                if candidates:
+                    oldest = min(rec["manifests"])
+                    log.info(
+                        "master: no complete holder for node %d; offering "
+                        "step %d for scavenge from %s",
+                        msg.node_id, oldest, candidates,
+                    )
+                    reply = st.ManifestReply(
+                        oldest, rec["manifests"][oldest], candidates
+                    )
+        return [Envelope(st.ChunkService.addr(msg.node_id), reply)]
+
+    def _drop_ckpt_holder(self, node_id: int) -> None:
+        """``node_id``'s process is gone (leave, or restart with a new
+        incarnation): whatever its old process advertised holding is no
+        longer servable — and after a disk loss may not even exist. Its
+        next adverts rebuild the truth from what actually survived."""
+        for rec in self._ckpt.values():
+            rec["holders"].pop(node_id, None)
 
     def _on_join(self, msg: cl.JoinCluster, now: float) -> list[Envelope]:
         nid = msg.preferred_node_id
@@ -238,6 +338,10 @@ class MasterProcess:
             self.monitor.heartbeat(nid, now)
             return [welcome]
         restarted = nid in self.grid.nodes
+        # a NEW incarnation under this id is a new process: anything the old
+        # process claimed to hold may have died with it (or its disk) — its
+        # own fresh adverts will restore the holder map from what survived
+        self._drop_ckpt_holder(nid)
         prev_inc = self._incarnations.get(nid)
         prev_ep = self.book.get(nid)
         if prev_inc is not None and prev_ep is not None and prev_ep != ep:
@@ -405,12 +509,27 @@ class NodeProcess:
         join_retry_s: float = 0.5,
         allow_crash: bool = False,
         chaos_log: str | None = None,
+        state_dir: str | None = None,
+        replicas: int = 2,
     ) -> None:
         self.seed = seed
         self.data_source = data_source
         self.data_sink = data_sink
         self.preferred_node_id = preferred_node_id
         self.join_retry_s = join_retry_s
+        # peer state transfer (statetransfer.py): when set, this node hosts
+        # a chunk service over the delta-store directory, replicates its
+        # saves to `replicas` peers, and can restore from peers on rejoin
+        self.state_dir = state_dir
+        self.replicas = replicas
+        self.state: st.ChunkService | None = None
+        self._chunk_store: st.ChunkStore | None = (
+            st.ChunkStore(state_dir) if state_dir else None
+        )
+        # EVERY live replication task, not a single slot: a later save's
+        # (insta-skipping) task must not shadow a still-running one at
+        # stop() — all of them get cancelled at teardown
+        self._replicate_tasks: set[asyncio.Task] = set()
         # chaos plumbing: the spec itself arrives with Welcome (one master
         # flag arms the cluster); allow_crash gates the `crash` fault to
         # REAL subprocesses (the CLI role sets it — an in-process test
@@ -428,6 +547,11 @@ class NodeProcess:
         self.transport.set_route("master", seed)
         self.transport.set_prefix_route("line_master", lambda _lid: seed)
         self.transport.set_prefix_route("worker", self._peer_endpoint)
+        # lambda, not a bound .get: the AddressBook handler REASSIGNS
+        # self._endpoints wholesale on every membership change
+        self.transport.set_prefix_route(
+            "ckpt", lambda nid: self._endpoints.get(nid)
+        )
         self._heartbeat_task: asyncio.Task | None = None
         self._join_task: asyncio.Task | None = None
         self._welcomed = asyncio.Event()
@@ -500,6 +624,13 @@ class NodeProcess:
                 except asyncio.CancelledError:
                     pass
                 setattr(self, attr, None)
+        for task in list(self._replicate_tasks):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        self._replicate_tasks.clear()
         await self.transport.stop()
 
     # -- routing helpers -------------------------------------------------------
@@ -521,6 +652,10 @@ class NodeProcess:
             self._master_send_failures = 0
 
     def _on_send_error(self, ep: cl.Endpoint, env: Envelope) -> None:
+        if self.state is not None:
+            # a lost replication push must be re-pushed next round, not
+            # dedup-skipped forever (statetransfer.note_send_failure)
+            self.state.note_send_failure(env)
         if env.dest != "master" or not self._welcomed.is_set() or self._left:
             return
         self._master_send_failures += 1
@@ -673,6 +808,46 @@ class NodeProcess:
         self.transport.register_prefix(
             "node", lambda _nid, m: self._on_cluster_msg(m)
         )
+        out: list[Envelope] = []
+        if self._chunk_store is not None:
+            # (re)build the chunk service under the assigned identity — the
+            # STORE persists across rejoins (it is the disk), the service's
+            # per-peer push dedup resets with the membership epoch
+            self.state = st.ChunkService(
+                self.transport,
+                msg.node_id,
+                self._chunk_store,
+                replicas=self.replicas,
+                retry=self.config.master.retry,
+            )
+            self.transport.register(
+                st.ChunkService.addr(msg.node_id), self.state.handle
+            )
+            # the disk survived whatever restarted us: advertise everything
+            # it holds — our OWN state and any replica holdings — so the
+            # master's holder map (wiped of our old incarnation's entries)
+            # re-learns what actually survived on this disk
+            latest = self._chunk_store.latest()
+            if latest is not None:
+                out.append(
+                    Envelope(
+                        "master",
+                        st.CheckpointAdvert(
+                            msg.node_id, msg.node_id, latest[0], latest[1]
+                        ),
+                    )
+                )
+            for origin in sorted(self._chunk_store.replica_origins()):
+                held = self._chunk_store.latest(origin)
+                if held is not None:
+                    out.append(
+                        Envelope(
+                            "master",
+                            st.CheckpointAdvert(
+                                msg.node_id, origin, held[0], held[1]
+                            ),
+                        )
+                    )
         interval = self.config.master.heartbeat_interval_s
         self._heartbeat_task = observed_task(
             run_periodic(interval, self._send_heartbeat),
@@ -680,7 +855,111 @@ class NodeProcess:
         )
         self._welcomed.set()
         log.info("node %d welcomed (dims=%d)", msg.node_id, dims)
-        return []
+        return out
+
+    # -- peer state transfer ---------------------------------------------------
+
+    @staticmethod
+    def _manifest_leaves(manifest_json: str) -> dict:
+        """{leaf key: blob sha} of a manifest — restore evidence callers
+        (the chaos-recover drill) can verify against replicas without
+        racing this node's later saves and prunes."""
+        import json
+
+        try:
+            return dict(json.loads(manifest_json).get("leaves", {}))
+        except (ValueError, AttributeError):
+            return {}
+
+    def replica_peers(self) -> list[int]:
+        """Live peers chosen as replica targets (address-book ring)."""
+        if self.state is None:
+            return []
+        return self.state.replica_peers(list(self._endpoints))
+
+    async def save_state(self, step: int, state: dict) -> dict | None:
+        """Delta-save a flat ``{name: array}`` state dict, advertise it to
+        the master, and kick a bounded background replication to the K
+        replica peers (skipped, counted, when one is already in flight).
+        Returns the save stats, or None when no state dir is configured."""
+        if self.state is None or self._chunk_store is None:
+            return None
+        # deliberately ON the event loop: ChunkStore is single-threaded by
+        # design (prune sweeps tmp files; a concurrent thread's in-flight
+        # write would be swept mid-publish), and the whole save is
+        # synchronous — nothing else interleaves with it. Demo states are
+        # small; big states belong to the train-side AsyncDeltaCheckpointer
+        # whose writer THREAD owns its store exclusively.
+        stats = self._chunk_store.save_state(step, state)
+        latest = self._chunk_store.latest()
+        assert latest is not None
+        await self.transport.send(
+            Envelope(
+                "master",
+                st.CheckpointAdvert(
+                    self.state.node_id, self.state.node_id, latest[0], latest[1]
+                ),
+            )
+        )
+        peers = self.replica_peers()
+        if peers:
+            # replicate_latest self-skips (and COUNTS) when a round is
+            # already in flight — no pre-check here, or the documented
+            # replicate.skipped_busy metric would never fire on this path
+            task = observed_task(
+                self.state.replicate_latest(peers),
+                name=f"node-{self.node_id}-replicate-{step}",
+            )
+            self._replicate_tasks.add(task)
+            task.add_done_callback(self._replicate_tasks.discard)
+        return stats
+
+    async def restore_state(self, *, rounds: int = 3) -> dict | None:
+        """The rejoin restore path (RESILIENCE.md "Recovery"): prefer the
+        local disk when it already holds the newest known step; otherwise
+        pull the manifest's chunks from live peer holders — per-chunk
+        retry/failover, resumable across ``rounds`` attempts with a FRESH
+        holder map each time (a partition heal mid-restore changes who is
+        reachable). Returns restore stats (``source`` disk|peer) or None
+        when there is nothing to restore anywhere."""
+        if self.state is None or self._chunk_store is None:
+            return None
+        t0 = time.perf_counter()
+        reply = await self.state.request_manifest()
+        latest = self._chunk_store.latest()
+        known_step = reply.step if reply is not None else -1
+        if latest is not None and latest[0] >= known_step:
+            stats = {
+                "source": "disk",
+                "step": latest[0],
+                "seconds": round(time.perf_counter() - t0, 3),
+                "complete": True,
+                "leaves": self._manifest_leaves(latest[1]),
+            }
+            st.note_disk_restore(stats["seconds"])
+            return stats
+        if reply is None or reply.step < 0:
+            return None
+        stats = None
+        for attempt in range(max(1, rounds)):
+            if not reply.holders:
+                break
+            stats = await self.state.restore_from_peers(
+                reply.step, reply.manifest_json, list(reply.holders)
+            )
+            if stats["complete"]:
+                stats["seconds"] = round(time.perf_counter() - t0, 3)
+                stats["leaves"] = self._manifest_leaves(reply.manifest_json)
+                return stats
+            if attempt + 1 < rounds:
+                fresh = await self.state.request_manifest()
+                if fresh is not None and fresh.step >= reply.step:
+                    reply = fresh
+        log.warning(
+            "node %s: peer restore of step %d incomplete (holders=%s)",
+            self.node_id, reply.step, list(reply.holders),
+        )
+        return stats
 
     async def _send_heartbeat(self) -> None:
         assert self.node_id is not None

@@ -141,6 +141,12 @@ class FaultSpec:
     # runtime window state (set by the injector)
     active_since_s: float | None = None
     done: bool = False
+    # one-shot round triggers ARM only after this process observes a round
+    # BELOW the trigger: a process that joins a cluster already past the
+    # trigger round (the chaos-recover respawn) must not re-fire a crash
+    # that belongs to the epoch that approached it — without this, a
+    # crash:node=K,at=roundN kills node K again on every rejoin forever
+    armed: bool = False
 
 
 def parse_spec(spec: str) -> list[FaultSpec]:
@@ -209,6 +215,16 @@ def parse_spec(spec: str) -> list[FaultSpec]:
             # test_master_restart_recovery) — accepting node=m here would
             # log crash events that can never happen
             raise ValueError("crash:node=m is not supported (nodes only)")
+        if name == "crash" and f.at == ("round", 0.0):
+            # round triggers arm only after a round BELOW the trigger is
+            # observed (so a rejoined process cannot re-fire a past crash);
+            # round0 can never arm — reject it instead of silently never
+            # firing
+            raise ValueError(
+                "crash:at=round0 cannot arm (round triggers fire when the "
+                "round sequence crosses them from below); use at=round1+ "
+                "or a time trigger"
+            )
         if name == "stall" and f.until is None:
             raise ValueError("stall requires for=")
         if name == "delay" and f.delay_ms <= 0:
@@ -355,9 +371,17 @@ class ChaosInjector:
         if f.done:
             return False
         kind, value = f.at
-        if kind == "round" and self.round >= value or (
-            kind == "time" and now >= value
-        ):
+        if kind == "round":
+            # arm only while approaching the trigger from below (see
+            # FaultSpec.armed): a rejoined process observing round 122
+            # must not re-fire an at=round30 crash
+            if 0 <= self.round < value:
+                f.armed = True
+            if f.armed and self.round >= value:
+                f.done = True
+                return True
+            return False
+        if now >= value:
             f.done = True
             return True
         return False

@@ -27,6 +27,7 @@ _log = logging.getLogger(__name__)
 
 from akka_allreduce_tpu import native
 from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.protocol import (
     CompleteAllreduce,
     ConfirmPreparation,
@@ -37,6 +38,7 @@ from akka_allreduce_tpu.protocol import (
 )
 
 # one tag per message type; payload-carrying tags end the body with raw f32
+# (tags 2/3) or raw checksummed bytes (tag 18 — peer chunk transfer)
 _TAGS: dict[type, int] = {
     StartAllreduce: 1,
     ScatterBlock: 2,
@@ -51,6 +53,14 @@ _TAGS: dict[type, int] = {
     cl.AddressBook: 11,
     cl.Shutdown: 12,
     cl.Rejoin: 13,
+    # peer state transfer (control/statetransfer.py, RESILIENCE.md "Recovery")
+    st.CheckpointAdvert: 14,
+    st.ManifestRequest: 15,
+    st.ManifestReply: 16,
+    st.ChunkFetch: 17,
+    st.ChunkData: 18,
+    st.ChunkMissing: 19,
+    st.ReplicaManifest: 20,
 }
 
 _U16 = struct.Struct("<H")
@@ -66,6 +76,29 @@ def _unpack_str(buf: memoryview, off: int) -> tuple[str, int]:
     (n,) = _U16.unpack_from(buf, off)
     off += 2
     return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+
+def _pack_str32(text: str) -> bytes:
+    """u32-length string — manifest JSON routinely exceeds the u16 bound
+    (one entry per checkpoint leaf)."""
+    raw = text.encode("utf-8")
+    return _U32.pack(len(raw)) + raw
+
+
+def _unpack_str32(buf: memoryview, off: int) -> tuple[str, int]:
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+
+def _chunk_payload_view(payload) -> memoryview:
+    """Raw byte view of a ChunkData payload (bytes / bytearray / memoryview
+    / u8 ndarray) — stays a view, so the transport's vectored send moves
+    the chunk bytes zero-copy exactly like a float payload segment."""
+    if isinstance(payload, np.ndarray):
+        return memoryview(np.ascontiguousarray(payload)).cast("B")
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    return mv if mv.format == "B" and mv.contiguous else mv.cast("B")
 
 
 # Top bit of the u32 element count flags a float16 payload (the wire-
@@ -213,6 +246,45 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
         return [head, _pack_str(msg.reason)]
     if tag == 13:
         return [head, _pack_str(msg.reason)]
+    if tag == 14:
+        return [
+            head,
+            struct.pack("<iiq", msg.node_id, msg.origin, msg.step),
+            _pack_str32(msg.manifest_json),
+        ]
+    if tag == 15:
+        return [head, struct.pack("<i", msg.node_id)]
+    if tag == 16:
+        holders = msg.holders
+        return [
+            head,
+            struct.pack("<q", msg.step),
+            _pack_str32(msg.manifest_json),
+            struct.pack(f"<H{len(holders)}i", len(holders), *holders),
+        ]
+    if tag == 17:
+        return [head, _pack_str(msg.sha), struct.pack("<i", msg.requester)]
+    if tag == 18:
+        # chunk payload: raw checksummed bytes, zero-copy like tags 2/3 —
+        # the payload segment is a memoryview the vectored send gathers
+        payload = _chunk_payload_view(msg.payload)
+        return [
+            head,
+            struct.pack("<Biq", 1 if msg.push else 0, msg.origin, msg.step),
+            _pack_str(msg.sha),
+            struct.pack(
+                "<II", payload.nbytes, native.wire_checksum(payload)
+            ),
+            payload,
+        ]
+    if tag == 19:
+        return [head, _pack_str(msg.sha), struct.pack("<i", msg.holder)]
+    if tag == 20:
+        return [
+            head,
+            struct.pack("<qi", msg.step, msg.origin),
+            _pack_str32(msg.manifest_json),
+        ]
     raise AssertionError(f"unhandled tag {tag}")
 
 
@@ -271,6 +343,44 @@ def decode(data: bytes | memoryview) -> Any:
     if tag == 13:
         reason, _ = _unpack_str(buf, off)
         return cl.Rejoin(reason)
+    if tag == 14:
+        node_id, origin, step = struct.unpack_from("<iiq", buf, off)
+        manifest, _ = _unpack_str32(buf, off + 16)
+        return st.CheckpointAdvert(node_id, origin, step, manifest)
+    if tag == 15:
+        return st.ManifestRequest(*struct.unpack_from("<i", buf, off))
+    if tag == 16:
+        (step,) = struct.unpack_from("<q", buf, off)
+        manifest, off = _unpack_str32(buf, off + 8)
+        (n,) = _U16.unpack_from(buf, off)
+        holders = struct.unpack_from(f"<{n}i", buf, off + 2)
+        return st.ManifestReply(step, manifest, holders)
+    if tag == 17:
+        sha, off = _unpack_str(buf, off)
+        return st.ChunkFetch(sha, *struct.unpack_from("<i", buf, off))
+    if tag == 18:
+        push, origin, step = struct.unpack_from("<Biq", buf, off)
+        sha, off = _unpack_str(buf, off + 13)
+        nbytes, ck = struct.unpack_from("<II", buf, off)
+        off += 8
+        # bound with <=, never ==: trailing bytes (e.g. the trace trailer)
+        # must be tolerated, exactly like the tag-2/3 payload decode
+        if off + nbytes > len(buf):
+            raise ValueError("truncated chunk payload")
+        payload = buf[off : off + nbytes]
+        if native.wire_checksum(payload) != ck:
+            raise ValueError("chunk payload checksum mismatch")
+        # zero-copy u8 view into the receive buffer, like the float tags —
+        # the recv-pool export check keeps recycling safe
+        value = np.frombuffer(payload, dtype=np.uint8)
+        return st.ChunkData(sha, value, origin, step, bool(push))
+    if tag == 19:
+        sha, off = _unpack_str(buf, off)
+        return st.ChunkMissing(sha, *struct.unpack_from("<i", buf, off))
+    if tag == 20:
+        step, origin = struct.unpack_from("<qi", buf, off)
+        manifest, _ = _unpack_str32(buf, off + 12)
+        return st.ReplicaManifest(step, manifest, origin)
     raise ValueError(f"unknown wire tag {tag}")
 
 

@@ -35,7 +35,14 @@ class SoakReport:
     first_loss: float
     final_loss: float
     remesh_events: list  # [{step, kind, seconds, n_devices}]
-    restore: dict | None  # {at_step, restored_step, seconds}
+    # {at_step, restored_step, seconds, source: disk|peer, [pull]} — the
+    # disk-vs-peer A/B is readable from this one record: `seconds` always
+    # measures the SAME span (wipe-if-any + state fetch + trainer restore),
+    # and `source` names which path supplied the bytes
+    restore: dict | None
+    # peer replication bookkeeping when the replica sidecar is on
+    # (chunks/bytes copied into the replica store across the run)
+    replication: dict | None
     checkpoint_saves: int
     # a skip because a background save is still in flight (real contention —
     # the stall signal) vs a skip because the step is already durable (the
@@ -72,6 +79,7 @@ def run_soak(
     checkpoint_every: int = 100,
     checkpoint_dir: str | None = None,
     delta: bool = False,
+    peer_restore: bool = False,
     metrics_out: str | None = None,
     log=print,
 ) -> SoakReport:
@@ -84,7 +92,17 @@ def run_soak(
     drop/rejoin for a deterministic seeded schedule of per-node silence
     windows (``control.chaos.membership_schedule``): each node other than
     0 independently flaps in and out, so one run exercises MANY detector
-    trips and re-meshes — and the same seed replays the same churn."""
+    trips and re-meshes — and the same seed replays the same churn.
+
+    ``peer_restore`` (requires ``delta``) drives the mid-run restore
+    through the peer state-transfer path instead of the local disk
+    (RESILIENCE.md "Recovery"): every completed delta save is replicated
+    into a replica ``ChunkStore`` sidecar, and at ``restore_at`` the local
+    delta store is WIPED (the disk-loss scenario) and rebuilt chunk by
+    chunk from the replica through the same verify-before-publish gate the
+    TCP pull uses — the report's ``restore.source`` flips to ``"peer"``
+    and ``restore.seconds`` measures the full wipe+pull+restore span, so
+    the disk-vs-peer A/B is one flag and one JSON field apart."""
     import tempfile
 
     import jax
@@ -157,8 +175,22 @@ def run_soak(
     )
 
     ckpt_dir = checkpoint_dir or tempfile.mkdtemp(prefix="soak_ckpt_")
+    if peer_restore and not delta:
+        raise ValueError(
+            "peer_restore replicates delta-checkpoint chunks; pass delta=True"
+        )
     ckpt_cls = AsyncDeltaCheckpointer if delta else AsyncTrainerCheckpointer
     ckpt = ckpt_cls(ckpt_dir)
+    replica = None
+    replication: dict | None = None
+    if peer_restore:
+        from akka_allreduce_tpu.control.statetransfer import ChunkStore
+
+        # the replica sidecar: the in-process stand-in for the K=2 peer
+        # stores the TCP cluster pushes to — same layout, same
+        # verify-before-publish copy path (copy_delta)
+        replica = ChunkStore(ckpt_dir + "_replica")
+        replication = {"rounds": 0, "chunks_copied": 0, "bytes_copied": 0}
     ds = data.lm_copy_task(seq_len, vocab=vocab)
     logger = (
         metrics_mod.MetricsLogger(metrics_out) if metrics_out else None
@@ -181,6 +213,29 @@ def run_soak(
     c_skip_dedup = reg.counter("soak.checkpoint.skipped_dedup")
     g_capture = reg.gauge("soak.checkpoint.max_capture_stall_s")
     g_loss = reg.gauge("soak.loss")
+    # restore accounting (RESILIENCE.md "Recovery"): the source split and
+    # the seconds live in the SAME registry the report reads, so the soak
+    # JSON and any live metrics consumer agree by construction
+    c_restore_disk = reg.counter("soak.restore.from_disk")
+    c_restore_peer = reg.counter("soak.restore.from_peer")
+    g_restore_s = reg.gauge("soak.restore.seconds")
+    replicated = {"step": -1}
+
+    def replicate_completed() -> None:
+        """Mirror the newest COMPLETED delta save into the replica store
+        (content-addressed: an unchanged leaf copies zero bytes)."""
+        if replica is None or ckpt.busy():
+            return
+        latest = ckpt.latest_step()
+        if latest is None or latest <= replicated["step"]:
+            return
+        from akka_allreduce_tpu.control.statetransfer import ChunkStore, copy_delta
+
+        s = copy_delta(ChunkStore(ckpt_dir), replica, step=latest)
+        replicated["step"] = latest
+        replication["rounds"] += 1
+        replication["chunks_copied"] += s["chunks_copied"]
+        replication["bytes_copied"] += s["bytes_copied"]
     compile_steps: set[int] = {0}  # steps whose time includes an XLA compile
     t_start = time.perf_counter()
 
@@ -244,19 +299,46 @@ def run_soak(
         if step == restore_at and ckpt.latest_step() is not None:
             t0 = time.perf_counter()
             ckpt.wait_until_finished()
+            source, pull = "disk", None
+            if replica is not None:
+                # the disk-loss drill: catch the replica up, WIPE the local
+                # delta store, rebuild it chunk-verified from the replica —
+                # then restore through the ordinary checkpointer path so
+                # the restored state is byte-identical to the disk path
+                import shutil
+
+                from akka_allreduce_tpu.control.statetransfer import (
+                    ChunkStore,
+                    copy_delta,
+                )
+
+                replicate_completed()
+                own = ChunkStore(ckpt_dir)
+                shutil.rmtree(own.blobs)
+                for m in own.manifests().values():
+                    m.unlink()
+                own.blobs.mkdir()
+                pull = copy_delta(replica, own, verify=True)
+                source = "peer"
             restored = ckpt.restore(elastic.trainer)
             rs = time.perf_counter() - t0
             restore_rec = {
                 "at_step": step,
                 "restored_step": int(restored),
                 "seconds": round(rs, 3),
+                "source": source,
             }
+            if pull is not None:
+                restore_rec["pull"] = pull
+            (c_restore_peer if source == "peer" else c_restore_disk).inc()
+            g_restore_s.set(restore_rec["seconds"])
             compile_steps.add(step + 1)  # rewound shapes may recompile
             log(
                 f"step {step}: restored checkpoint of step {restored} "
-                f"in {rs:.2f}s; training continues from there"
+                f"from {source} in {rs:.2f}s; training continues from there"
             )
 
+        replicate_completed()
         if checkpoint_every and step and step % checkpoint_every == 0:
             if ckpt.busy():
                 # a background save is still in flight: THIS is the
@@ -301,6 +383,7 @@ def run_soak(
         final_loss=round(losses[-1], 4),
         remesh_events=list(remesh_events.values),
         restore=restore_rec,
+        replication=replication,
         checkpoint_saves=c_saves.value,
         checkpoint_skipped_busy=c_skip_busy.value,
         checkpoint_skipped_dedup=c_skip_dedup.value,

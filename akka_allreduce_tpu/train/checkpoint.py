@@ -490,9 +490,21 @@ class DeltaCheckpointer:
     def _write_delta(self, host: dict, custom: bool, step: int) -> dict:
         """Hash every leaf, write the new blobs + manifest, prune. Pure
         host-side work on an already-host tree — the half a background
-        writer thread can run."""
-        import hashlib
+        writer thread can run.
+
+        Durability order matters: every blob is fsynced before its atomic
+        rename, and the manifest is fsynced before ITS rename — a crash
+        mid-save must leave the old manifests intact and can never publish
+        a manifest that names truncated chunk files (the page-cache-loss
+        corruption class; regression-pinned in tests/test_checkpoint.py)."""
         import json
+        import os as _os
+
+        from akka_allreduce_tpu.control.statetransfer import (
+            fsync_write,
+            leaf_sha,
+            publish_file,
+        )
 
         flat = self._flatten(host)
         manifest = {
@@ -505,19 +517,10 @@ class DeltaCheckpointer:
         )
         for key, leaf in flat.items():
             arr = np.asarray(leaf)
-            # hash the raw buffer via memoryview (no tobytes copy — the
-            # all-leaves-unchanged case this store optimizes would
-            # otherwise double host memory traffic). NB ascontiguousarray
-            # promotes 0-d to 1-d, so only use it as a hashing VIEW and
-            # save the original
-            buf = (
-                arr
-                if arr.flags["C_CONTIGUOUS"]
-                else np.ascontiguousarray(arr)
-            )
-            h = hashlib.sha256(str((arr.dtype, arr.shape)).encode())
-            h.update(buf.data)
-            sha = h.hexdigest()
+            # ONE definition of the content hash (statetransfer.leaf_sha):
+            # the peer chunk transfer verifies fetched blobs against these
+            # names, so the hash here and the verifier must never diverge
+            sha = leaf_sha(arr)
             blob = self.blobs / f"{sha}.npy"
             if blob.exists():
                 stats["reused_bytes"] += arr.nbytes
@@ -526,15 +529,19 @@ class DeltaCheckpointer:
                 tmp = blob.with_suffix(".tmp")
                 with open(tmp, "wb") as f:  # np.save(path) appends .npy
                     np.save(f, arr, allow_pickle=False)
-                tmp.replace(blob)  # atomic publish
+                    f.flush()
+                    _os.fsync(f.fileno())
+                publish_file(tmp, blob)  # atomic + directory fsync
                 stats["written_bytes"] += arr.nbytes
                 stats["written_leaves"] += 1
             manifest["leaves"][key] = sha
         tmp = self.directory / f".manifest_{step}.tmp"
-        tmp.write_text(json.dumps(manifest))
-        # atomic rename: a crash mid-save leaves old manifests + maybe some
-        # orphan blobs, never a torn manifest
-        tmp.replace(self.directory / f"manifest_{step}.json")
+        # fsync BEFORE the atomic rename (statetransfer.fsync_write — one
+        # definition of the durability recipe): a crash mid-save leaves old
+        # manifests + maybe some orphan blobs, never a torn manifest or one
+        # whose blobs' bytes were still in the page cache
+        fsync_write(tmp, json.dumps(manifest).encode())
+        publish_file(tmp, self.directory / f"manifest_{step}.json")
         self._prune()
         return stats
 

@@ -87,6 +87,7 @@ def test_parse_spec_full_grammar():
         "drop:q=1",  # unknown param
         "crash:node=1,at=soon",  # unparseable trigger
         "crash:node=m,at=1s",  # master crash is not injectable
+        "crash:node=1,at=round0",  # round triggers arm from below; round0 can't
     ],
 )
 def test_parse_spec_rejects_malformed(bad):
@@ -189,10 +190,21 @@ def test_membership_schedule_is_deterministic_and_keeps_a_survivor():
 
 def test_chaos_introduces_no_new_wire_tags():
     """Design pin (and the WIRE001 satellite): chaos configuration rides
-    Welcome's config JSON — the wire-tag surface arlint ratchets is
-    UNCHANGED. A new chaos control message must update this test, the
-    codec arms, and a dispatch site together (WIRE001 enforces the rest)."""
-    assert sorted(wire._TAGS.values()) == list(range(1, 14))
+    Welcome's config JSON — chaos itself contributes ZERO wire tags. The
+    full surface is now 1-20 (14-20 are PR 6's peer state transfer,
+    control/statetransfer.py — every one round-tripped in
+    test_wire_roundtrip.py); a new chaos control message must update this
+    test, the codec arms, and a dispatch site together (WIRE001 enforces
+    the rest)."""
+    assert sorted(wire._TAGS.values()) == list(range(1, 21))
+    from akka_allreduce_tpu.control import chaos as chaos_mod
+    from akka_allreduce_tpu.control import statetransfer as st_mod
+
+    for cls in wire._TAGS:
+        assert cls.__module__ != chaos_mod.__name__
+    assert sum(
+        1 for cls in wire._TAGS if cls.__module__ == st_mod.__name__
+    ) == 7
     cfg = AllreduceConfig(chaos=ChaosConfig(seed=9, spec="drop:p=0.5"))
     roundtrip = AllreduceConfig.from_json(cfg.to_json())
     assert roundtrip.chaos == ChaosConfig(seed=9, spec="drop:p=0.5")

@@ -847,3 +847,49 @@ class TestDeltaCheckpointer:
         fresh = mk(7)
         assert store.restore(fresh) == 1
         np.testing.assert_array_equal(fresh.get_flat_params(), ref)
+
+
+# --- corruption-on-crash regression (ISSUE 6 satellite; no trainer needed) ----
+
+
+class TestDeltaDurability:
+    """A crash mid-save must never publish a manifest that names torn or
+    unsynced chunk files. These drive ``_write_delta`` on plain host dicts
+    (the writer-thread half), so they run even where the XLA trainer
+    suites cannot."""
+
+    def test_crash_between_blobs_publishes_no_manifest(self, tmp_path, monkeypatch):
+        """Simulated crash after the first blob, before the second: no
+        manifest becomes visible (old latest_step is preserved), and no
+        half-written temp file is left masquerading as a manifest."""
+        from akka_allreduce_tpu.train.checkpoint import DeltaCheckpointer
+
+        d = DeltaCheckpointer(tmp_path / "ckpt")
+        d._write_delta({"a": np.zeros(4, np.float32)}, False, 1)
+        calls = {"n": 0}
+        real_save = np.save
+
+        def dying_save(f, arr, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise OSError("simulated crash mid-save")
+            return real_save(f, arr, **kw)
+
+        monkeypatch.setattr(np, "save", dying_save)
+        with pytest.raises(OSError):
+            d._write_delta(
+                {
+                    "a": np.ones(4, np.float32),
+                    "b": np.full(4, 2.0, np.float32),
+                },
+                False,
+                2,
+            )
+        monkeypatch.undo()
+        # the torn save is invisible: step 1 is still the newest manifest
+        assert d.latest_step() == 1
+        assert not (d.directory / "manifest_2.json").exists()
+        # and the next prune sweeps the orphan temp files (crash recovery)
+        d._write_delta({"a": np.zeros(4, np.float32)}, False, 3)
+        assert not list(d.blobs.glob("*.tmp"))
+        assert not list(d.directory.glob(".manifest_*.tmp"))

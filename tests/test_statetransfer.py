@@ -1,0 +1,567 @@
+"""Peer state transfer (control/statetransfer.py, RESILIENCE.md "Recovery").
+
+Layers under test, bottom up:
+
+- content hashing: ONE definition (``leaf_sha``) shared by the delta
+  checkpointer's blob names and the chunk transfer's verify gate;
+- ``ChunkStore``: durable content-addressed blobs + per-origin manifests,
+  verify-before-publish, per-origin pruning, path-traversal rejection;
+- ``copy_delta``: the in-process replication path (soak's replica sidecar)
+  fails closed on corrupt source bytes;
+- ``ChunkService``: the sync handler's fetch/push/manifest arms, replica
+  peer selection, replication dedup;
+- master registry: adverts build the holder map, ManifestRequest answers
+  with the newest manifest + LIVE holders, a rejoining incarnation's stale
+  holder entries are dropped;
+- end to end over real loopback TCP: save -> replicate to K=2 peers ->
+  wipe the owner's store (disk loss) -> rejoin restore pulls the chunks
+  back from peers, byte-identical, with disk preferred when it is current.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.config import RetryPolicy
+from akka_allreduce_tpu.control import statetransfer as st
+from akka_allreduce_tpu.control.bootstrap import MasterProcess, NodeProcess
+from akka_allreduce_tpu.control.envelope import Envelope
+from tests.test_remote import _Harness, _config, wait_until
+
+
+# --- content hashing ----------------------------------------------------------
+
+
+def test_leaf_sha_matches_delta_checkpointer_blob_names(tmp_path):
+    """The peer transfer verifies fetched chunks against manifest blob
+    names; those names are written by DeltaCheckpointer._write_delta —
+    the two hash definitions must be the same function, literally."""
+    from akka_allreduce_tpu.train.checkpoint import DeltaCheckpointer
+
+    d = DeltaCheckpointer(tmp_path / "ckpt")
+    state = {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": np.float32(2.5),  # 0-d leaf: the ascontiguousarray trap
+    }
+    d._write_delta(state, False, 3)
+    manifest = json.loads((d.directory / "manifest_3.json").read_text())
+    for key, sha in manifest["leaves"].items():
+        arr = state[key.strip("[]'")]
+        assert st.leaf_sha(arr) == sha
+        # and the serialized blob bytes hash back to the same name — the
+        # end-to-end verification a peer restore performs
+        assert st.npy_sha((d.blobs / f"{sha}.npy").read_bytes()) == sha
+
+
+def test_fsync_before_publish_ordering(tmp_path, monkeypatch):
+    """The crash-durability regression (ISSUE 6 satellite): every blob is
+    fsynced before its rename, and the manifest is fsynced before ITS
+    rename — so a crash can never publish a manifest that names truncated
+    (page-cache-lost) chunk files."""
+    import os
+
+    from akka_allreduce_tpu.train.checkpoint import DeltaCheckpointer
+
+    events: list[tuple] = []
+    real_fsync, real_replace = os.fsync, os.replace
+
+    def spy_fsync(fd):
+        try:
+            name = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:  # pragma: no cover - non-procfs platforms
+            name = "?"
+        events.append(("fsync", name))
+        real_fsync(fd)
+
+    def spy_replace(src, dst, **kw):
+        events.append(("replace", str(src), str(dst)))
+        return real_replace(src, dst, **kw)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "replace", spy_replace)
+    d = DeltaCheckpointer(tmp_path / "ckpt")
+    d._write_delta(
+        {"a": np.arange(4, dtype=np.float32), "b": np.ones(2, np.float32)},
+        False,
+        1,
+    )
+    replaces = [e for e in events if e[0] == "replace"]
+    assert replaces, "no atomic publish happened at all"
+    for _, src, dst in replaces:
+        before = events[: events.index(("replace", src, dst))]
+        synced = {e[1] for e in before if e[0] == "fsync"}
+        assert src in synced, f"{dst} renamed before {src} was fsynced"
+    # the manifest publishes LAST, after every blob it names is durable
+    assert replaces[-1][2].endswith("manifest_1.json")
+    blob_dsts = [dst for _, _, dst in replaces[:-1]]
+    assert all(dst.endswith(".npy") for dst in blob_dsts)
+
+
+def test_truncated_blob_fails_closed_on_copy(tmp_path):
+    """A manifest pointing at a truncated chunk file (the crash-corruption
+    class) must surface as a loud error on the replication/restore path,
+    never as silently replicated garbage."""
+    src = st.ChunkStore(tmp_path / "src")
+    src.save_state(1, {"x": np.arange(64, dtype=np.float32)})
+    (sha,) = json.loads(src.latest()[1])["leaves"].values()
+    blob = src.blob_path(sha)
+    blob.write_bytes(blob.read_bytes()[:-16])  # torn write
+    with pytest.raises(ValueError):
+        st.copy_delta(src, st.ChunkStore(tmp_path / "dst"))
+
+
+# --- ChunkStore ---------------------------------------------------------------
+
+
+def test_chunk_store_delta_save_load_roundtrip(tmp_path):
+    s = st.ChunkStore(tmp_path)
+    a = np.arange(8, dtype=np.float32)
+    s.save_state(5, {"payload": a, "reduced": a * 2})
+    stats = s.save_state(10, {"payload": a, "reduced": a * 3})
+    # the unchanged leaf cost zero bytes — the delta property replication
+    # inherits (an unchanged leaf is never re-pushed either)
+    assert stats["reused_leaves"] == 1 and stats["written_leaves"] == 1
+    step, back = s.load_state()
+    assert step == 10
+    np.testing.assert_array_equal(back["payload"], a)
+    np.testing.assert_array_equal(back["reduced"], a * 3)
+
+
+def test_chunk_store_verify_gate(tmp_path):
+    s = st.ChunkStore(tmp_path)
+    arr = np.arange(4, dtype=np.float32)
+    sha = st.leaf_sha(arr)
+    with pytest.raises(ValueError):
+        s.write(sha, b"not an npy file")
+    with pytest.raises(ValueError):  # valid npy, wrong name
+        s.write(sha, st.npy_bytes(arr + 1))
+    assert not s.has(sha)
+    assert s.write(sha, st.npy_bytes(arr))
+    assert s.has(sha)
+    assert not s.write(sha, st.npy_bytes(arr))  # dedup: already present
+
+
+def test_chunk_store_rejects_hostile_sha(tmp_path):
+    s = st.ChunkStore(tmp_path)
+    for bad in ("", "../../etc/passwd", "a/b", "x.npy"):
+        with pytest.raises(ValueError):
+            s.blob_path(bad)
+
+
+def test_chunk_store_prunes_per_origin(tmp_path):
+    s = st.ChunkStore(tmp_path, max_to_keep=2)
+    for step in (1, 2, 3):
+        s.save_state(step, {"x": np.full(4, step, np.float32)})
+    assert sorted(s.manifests()) == [2, 3]
+    # replica manifests for two origins prune independently of our own
+    for origin in (7, 8):
+        for step in (1, 2, 3):
+            arr = np.full(4, 100 * origin + step, np.float32)
+            sha = st.leaf_sha(arr)
+            s.write(sha, st.npy_bytes(arr), verify=False)
+            s.write_manifest(
+                step,
+                json.dumps({"step": step, "custom": False, "leaves": {"x": sha}}),
+                origin,
+            )
+    s.prune()
+    assert sorted(s.manifests(7)) == [2, 3]
+    assert sorted(s.manifests(8)) == [2, 3]
+    assert sorted(s.manifests()) == [2, 3]
+    # every blob on disk is referenced by a kept manifest, none leaked
+    live = set()
+    for origin in (None, 7, 8):
+        for f in s.manifests(origin).values():
+            live.update(json.loads(f.read_text())["leaves"].values())
+    on_disk = {p.stem for p in s.blobs.glob("*.npy")}
+    assert on_disk == live
+
+
+def test_copy_delta_replicates_and_skips_present(tmp_path):
+    src = st.ChunkStore(tmp_path / "src")
+    dst = st.ChunkStore(tmp_path / "dst")
+    src.save_state(4, {"a": np.arange(5, dtype=np.float32)})
+    s1 = st.copy_delta(src, dst, dst_origin=9)
+    assert s1["chunks_copied"] == 1 and s1["chunks_skipped"] == 0
+    s2 = st.copy_delta(src, dst, dst_origin=9)
+    assert s2["chunks_copied"] == 0 and s2["chunks_skipped"] == 1
+    assert dst.latest(9)[0] == 4
+    assert dst.latest() is None  # replica namespace, not its own
+
+
+# --- ChunkService handler -----------------------------------------------------
+
+
+def _service(tmp_path, node_id=1, replicas=2):
+    return st.ChunkService(
+        object(), node_id, st.ChunkStore(tmp_path), replicas=replicas,
+        retry=RetryPolicy(max_retries=2, backoff_base_s=0.01),
+    )
+
+
+def test_service_fetch_hit_and_miss(tmp_path):
+    svc = _service(tmp_path)
+    arr = np.arange(4, dtype=np.float32)
+    sha = st.leaf_sha(arr)
+    svc.store.write(sha, st.npy_bytes(arr), verify=False)
+    (env,) = svc.handle(st.ChunkFetch(sha, requester=7))
+    assert env.dest == "ckpt:7"
+    assert isinstance(env.msg, st.ChunkData)
+    assert bytes(memoryview(env.msg.payload)) == st.npy_bytes(arr)
+    (miss,) = svc.handle(st.ChunkFetch("ab" * 32, requester=7))
+    assert isinstance(miss.msg, st.ChunkMissing)
+    assert miss.msg.holder == 1
+
+
+def test_service_push_verifies_before_publish(tmp_path):
+    svc = _service(tmp_path)
+    arr = np.arange(4, dtype=np.float32)
+    sha = st.leaf_sha(arr)
+    # corrupt push: rejected, not stored
+    assert svc.handle(st.ChunkData(sha, b"garbage", 0, 5, push=True)) == []
+    assert not svc.store.has(sha)
+    # good push: stored
+    svc.handle(st.ChunkData(sha, st.npy_bytes(arr), 0, 5, push=True))
+    assert svc.store.has(sha)
+
+
+def test_service_replica_manifest_adverts_only_when_complete(tmp_path):
+    svc = _service(tmp_path)
+    arr = np.arange(4, dtype=np.float32)
+    sha = st.leaf_sha(arr)
+    manifest = json.dumps({"step": 5, "custom": False, "leaves": {"x": sha}})
+    # chunks not here yet: no manifest stored, no advert (an incomplete
+    # replica must never enter the holder map) — instead the origin is
+    # told exactly which chunks are missing, so its push dedup forgets
+    # them and the next replication round re-pushes
+    out = svc.handle(st.ReplicaManifest(5, manifest, origin=0))
+    assert [type(e.msg) for e in out] == [st.ChunkMissing]
+    assert out[0].dest == "ckpt:0" and out[0].msg.sha == sha
+    assert svc.store.latest(0) is None
+    svc.handle(st.ChunkData(sha, st.npy_bytes(arr), 0, 5, push=True))
+    (advert,) = svc.handle(st.ReplicaManifest(5, manifest, origin=0))
+    assert advert.dest == "master"
+    assert isinstance(advert.msg, st.CheckpointAdvert)
+    assert (advert.msg.node_id, advert.msg.origin, advert.msg.step) == (1, 0, 5)
+    assert svc.store.latest(0)[0] == 5
+
+
+def test_unsolicited_chunk_missing_forgets_push_dedup(tmp_path):
+    """The reborn-replica repair loop: a ChunkMissing that matches no
+    pending fetch is a replica telling us it does NOT hold a chunk we
+    dedup-skipped (its disk restarted) — the per-peer pushed set must
+    forget it so the next replication round re-pushes, or the replica
+    falls out of the replication factor forever."""
+    svc = _service(tmp_path)
+    sha = st.leaf_sha(np.arange(4, dtype=np.float32))
+    svc._pushed[3] = {sha, "deadbeef" * 8}
+    assert svc.handle(st.ChunkMissing(sha, holder=3)) == []
+    assert svc._pushed[3] == {"deadbeef" * 8}
+    # unknown peer / unknown sha: harmless no-ops
+    svc.handle(st.ChunkMissing("ab" * 32, holder=9))
+
+
+def test_send_failure_unmarks_push_dedup(tmp_path):
+    """The other half of push-dedup repair: an OBSERVABLE send failure
+    (backpressure drop, dead connection) un-marks the chunk immediately —
+    without waiting for the replica's next ChunkMissing feedback cycle."""
+    svc = _service(tmp_path, node_id=0)
+    sha = st.leaf_sha(np.arange(4, dtype=np.float32))
+    svc._pushed[2] = {sha}
+    push = st.ChunkData(sha, b"", origin=0, step=5, push=True)
+    svc.note_send_failure(Envelope("ckpt:2", push))
+    assert svc._pushed[2] == set()
+    # fetch replies and non-chunk traffic never touch the dedup state
+    svc._pushed[2] = {sha}
+    svc.note_send_failure(Envelope("ckpt:2", st.ChunkData(sha, b"")))
+    svc.note_send_failure(Envelope("master", st.ManifestRequest(0)))
+    assert svc._pushed[2] == {sha}
+
+
+def test_replica_peer_ring_selection(tmp_path):
+    svc = _service(tmp_path, node_id=2, replicas=2)
+    assert svc.replica_peers([0, 1, 2, 3, 4]) == [3, 4]
+    assert svc.replica_peers([0, 1, 2]) == [0, 1]  # wraps
+    assert svc.replica_peers([2]) == []  # nobody else
+    assert svc.replica_peers([0, 2]) == [0]  # fewer peers than K
+    svc5 = _service(tmp_path, node_id=5, replicas=2)
+    assert svc5.replica_peers([0, 3, 5, 9]) == [9, 0]
+
+
+# --- master checkpoint registry -----------------------------------------------
+
+
+def test_master_registry_and_manifest_reply():
+    async def run():
+        master = MasterProcess(_config(3), port=0)
+        manifest = '{"step": 7, "leaves": {}}'
+        # owner + two replicas advert step 7 for origin 2
+        master._on_cluster_msg(st.CheckpointAdvert(2, 2, 7, manifest))
+        master._on_cluster_msg(st.CheckpointAdvert(0, 2, 7, manifest))
+        master._on_cluster_msg(st.CheckpointAdvert(1, 2, 7, manifest))
+        # a stale holder from an older step must not be listed for step 7
+        master._on_cluster_msg(st.CheckpointAdvert(4, 2, 3, manifest))
+        from akka_allreduce_tpu.control.cluster import Endpoint
+
+        for nid in (0, 1, 2, 4):
+            master.book[nid] = Endpoint("127.0.0.1", 9000 + nid)
+        (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
+        reply = reply_env.msg
+        assert reply_env.dest == "ckpt:2"
+        assert reply.step == 7 and reply.manifest_json == manifest
+        # requester excluded, stale holder excluded
+        assert reply.holders == (0, 1)
+        # an unreachable holder drops out of the peer map
+        master.unreachable.add(0)
+        (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
+        assert reply_env.msg.holders == (1,)
+        # unknown origin: explicit "nothing known"
+        (none_env,) = master._on_cluster_msg(st.ManifestRequest(9))
+        assert none_env.msg.step == -1 and none_env.msg.holders == ()
+        # a new incarnation of node 1 drops node 1's stale holder entries;
+        # with step 7 now unservable the master FALLS BACK to the newest
+        # step that still has a live holder (the saved-but-never-replicated
+        # crash case) instead of answering a dead end
+        master._drop_ckpt_holder(1)
+        (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
+        assert reply_env.msg.step == 3 and reply_env.msg.holders == (4,)
+        # no COMPLETE holder at any step -> SCAVENGE: the oldest remembered
+        # manifest (its chunks were pushed first) with every live member as
+        # a candidate — per-chunk failover reassembles from partial replicas
+        master._drop_ckpt_holder(4)
+        (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
+        assert reply_env.msg.step == 3
+        assert reply_env.msg.holders == (1, 4)  # live, minus unreachable 0
+        # nobody else alive at all: genuinely nothing to offer
+        master.book = {2: master.book[2]}
+        (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
+        assert reply_env.msg.step == -1 and reply_env.msg.holders == ()
+
+    asyncio.run(run())
+
+
+# --- end to end over real loopback TCP ----------------------------------------
+
+
+class _StateHarness(_Harness):
+    """_Harness whose nodes carry per-node state dirs (peer transfer on)."""
+
+    def __init__(self, config, n_nodes, tmp_path):
+        super().__init__(config, n_nodes)
+        self.tmp_path = tmp_path
+
+    def state_dir(self, i: int):
+        return self.tmp_path / f"state{i}"
+
+    async def add_node(self, i: int) -> NodeProcess:
+        node = NodeProcess(
+            self.seed,
+            self._source(i),
+            self._sink(i),
+            preferred_node_id=i,
+            state_dir=str(self.state_dir(i)),
+        )
+        await node.start()
+        await node.wait_welcomed()
+        self.nodes[i] = node
+        return node
+
+
+def test_cluster_peer_restore_end_to_end(tmp_path):
+    """The tentpole over real sockets: node 2 delta-saves + replicates to
+    its K=2 ring peers; its store is wiped (disk loss) and a fresh-identity
+    restore pulls every chunk back from the peers — byte-identical blobs,
+    state arrays equal, and the local-disk path preferred when current."""
+
+    async def run():
+        h = _StateHarness(_config(3, max_rounds=-1), 3, tmp_path)
+        try:
+            await h.start(3)
+            node2 = h.nodes[2]
+            state = {
+                "payload": np.arange(32, dtype=np.float32),
+                "reduced": np.linspace(0, 1, 32).astype(np.float32),
+            }
+            await node2.save_state(10, state)
+            # replication is a background task: wait until both ring peers
+            # stored the replica manifest AND adverted to the master
+            await wait_until(
+                lambda: len(
+                    h.master._ckpt.get(2, {"holders": {}})["holders"]
+                ) >= 3
+            )
+            own = node2._chunk_store
+            manifest_json = own.latest()[1]
+            shas = set(json.loads(manifest_json)["leaves"].values())
+            for k in (0, 1):
+                peer_store = h.nodes[k]._chunk_store
+                assert peer_store.latest(origin=2)[0] == 10
+                for sha in shas:
+                    assert peer_store.read(sha) == own.read(sha)
+
+            # disk intact -> restore prefers it (no network pull)
+            rest = await node2.restore_state()
+            assert rest["source"] == "disk" and rest["step"] == 10
+
+            # disk loss: wipe and pull back from peers
+            import shutil
+
+            originals = {sha: own.read(sha) for sha in shas}
+            shutil.rmtree(own.directory)
+            own.blobs.mkdir(parents=True)
+            rest = await node2.restore_state()
+            assert rest is not None and rest["complete"], rest
+            assert rest["source"] == "peer" and rest["step"] == 10
+            assert rest["chunks_fetched"] == len(shas)
+            for sha, data in originals.items():
+                assert own.read(sha) == data  # byte-identical restore
+            step, back = own.load_state()
+            assert step == 10
+            np.testing.assert_array_equal(back["payload"], state["payload"])
+            np.testing.assert_array_equal(back["reduced"], state["reduced"])
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_restarted_replica_readvertises_its_holdings(tmp_path):
+    """A new incarnation wipes its holder entries at the master (its disk
+    MAY be gone) — but when the disk in fact survived, the welcome-time
+    adverts must re-register both its own state AND its replica holdings,
+    or surviving replicas would silently drop out of the failover map."""
+
+    async def run():
+        h = _StateHarness(_config(3, max_rounds=-1), 3, tmp_path)
+        try:
+            await h.start(3)
+            await h.nodes[2].save_state(
+                10, {"payload": np.arange(8, dtype=np.float32)}
+            )
+            await wait_until(
+                lambda: len(
+                    h.master._ckpt.get(2, {"holders": {}})["holders"]
+                ) >= 3
+            )
+            # replica node 0 restarts: entries wiped on join, then re-learned
+            # from its intact disk via the welcome adverts
+            await h.nodes[0].stop()
+            await h.add_node(0)
+            await wait_until(
+                lambda: h.master._ckpt[2]["holders"].get(0) == 10
+            )
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_scavenge_restore_from_partial_replicas(tmp_path):
+    """The crash-mid-replication tail: the owner died before ANY replica
+    completed (nobody adverted), but its chunks landed scattered across
+    partial replicas. The master's scavenge fallback offers the oldest
+    manifest with every live member as a candidate, and the per-chunk
+    ChunkMissing failover reassembles the state — each chunk from
+    whichever peer happens to hold it."""
+
+    async def run():
+        h = _StateHarness(_config(3, max_rounds=-1), 3, tmp_path)
+        try:
+            await h.start(3)
+            node2 = h.nodes[2]
+            a = np.arange(16, dtype=np.float32)
+            b = np.linspace(0, 1, 16).astype(np.float32)
+            own = node2._chunk_store
+            own.save_state(5, {"payload": a, "reduced": b})
+            step, manifest_json = own.latest()
+            # the owner adverts (as a save would) but replication "died":
+            # each peer got only ONE of the two chunks, no manifests
+            from akka_allreduce_tpu.control.envelope import Envelope
+
+            await node2.transport.send(
+                Envelope(
+                    "master", st.CheckpointAdvert(2, 2, step, manifest_json)
+                )
+            )
+            sha_a, sha_b = st.leaf_sha(a), st.leaf_sha(b)
+            h.nodes[0]._chunk_store.write(sha_a, st.npy_bytes(a))
+            h.nodes[1]._chunk_store.write(sha_b, st.npy_bytes(b))
+            await wait_until(lambda: 2 in h.master._ckpt)
+
+            # disk loss + restore: no complete holder exists anywhere
+            import shutil
+
+            shutil.rmtree(own.directory)
+            own.blobs.mkdir(parents=True)
+            rest = await node2.restore_state()
+            assert rest is not None and rest["complete"], rest
+            assert rest["source"] == "peer" and rest["step"] == step
+            got_step, back = own.load_state()
+            assert got_step == step
+            np.testing.assert_array_equal(back["payload"], a)
+            np.testing.assert_array_equal(back["reduced"], b)
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_restore_with_nothing_known_returns_none(tmp_path):
+    async def run():
+        h = _StateHarness(_config(2, max_rounds=-1), 2, tmp_path)
+        try:
+            await h.start(2)
+            assert await h.nodes[0].restore_state() is None
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_fetch_fails_over_to_replica_holder(tmp_path):
+    """Per-chunk failover: the first holder answers ChunkMissing (it lost
+    the blob), the second serves it — the pull succeeds without burning a
+    timeout, and the envelope path is the ordinary address book route."""
+
+    async def run():
+        h = _StateHarness(_config(3, max_rounds=-1), 3, tmp_path)
+        try:
+            await h.start(3)
+            arr = np.arange(16, dtype=np.float32)
+            sha = st.leaf_sha(arr)
+            # only node 1 holds the blob; node 0 will answer ChunkMissing
+            h.nodes[1]._chunk_store.write(sha, st.npy_bytes(arr), verify=False)
+            svc = h.nodes[2].state
+            ok = await svc._fetch_chunk(sha, [0, 1])
+            assert ok and h.nodes[2]._chunk_store.has(sha)
+        finally:
+            await h.stop()
+
+    asyncio.run(run())
+
+
+def test_save_state_replication_skips_while_busy(tmp_path):
+    """Bounded bandwidth: a second replication kicked while one is in
+    flight is skipped and counted, never queued behind itself."""
+
+    async def run():
+        h = _StateHarness(_config(3, max_rounds=-1), 3, tmp_path)
+        try:
+            await h.start(3)
+            svc = h.nodes[2].state
+            svc._replicating = True  # pin "in flight"
+            from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+            before = REGISTRY.counter("replicate.skipped_busy").value
+            assert await svc.replicate_latest([0, 1]) is None
+            assert (
+                REGISTRY.counter("replicate.skipped_busy").value == before + 1
+            )
+        finally:
+            await h.stop()
+
+    asyncio.run(run())

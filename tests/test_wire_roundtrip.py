@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.obs.trace import TraceContext
 from akka_allreduce_tpu.protocol import (
@@ -31,6 +32,13 @@ from akka_allreduce_tpu.protocol import (
 )
 
 _PAYLOAD = np.arange(7, dtype=np.float32) - 3.0
+
+# a realistic chunk payload: serialized .npy bytes whose content hash IS the
+# blob name (what ChunkData actually carries between peers)
+_CHUNK_ARR = np.arange(11, dtype=np.float32) * 0.5
+_CHUNK_BYTES = st.npy_bytes(_CHUNK_ARR)
+_CHUNK_SHA = st.leaf_sha(_CHUNK_ARR)
+_MANIFEST = '{"step": 5, "custom": false, "leaves": {"[\'a\']": "%s"}}' % _CHUNK_SHA
 
 # one representative instance per wire type; every field non-default so a
 # dropped/reordered struct field cannot round-trip by luck
@@ -52,6 +60,15 @@ _SAMPLES = {
     ),
     cl.Shutdown: cl.Shutdown("max-rounds"),
     cl.Rejoin: cl.Rejoin("unknown-node"),
+    # peer state transfer (tags 14-20): every field non-default, raw-buffer
+    # payloads included, so a dropped struct field cannot round-trip by luck
+    st.CheckpointAdvert: st.CheckpointAdvert(1, 2, 40, _MANIFEST),
+    st.ManifestRequest: st.ManifestRequest(3),
+    st.ManifestReply: st.ManifestReply(40, _MANIFEST, (0, 1, 4)),
+    st.ChunkFetch: st.ChunkFetch(_CHUNK_SHA, 2),
+    st.ChunkData: st.ChunkData(_CHUNK_SHA, _CHUNK_BYTES, 1, 40, True),
+    st.ChunkMissing: st.ChunkMissing(_CHUNK_SHA, 4),
+    st.ReplicaManifest: st.ReplicaManifest(40, _MANIFEST, 1),
 }
 
 
@@ -59,9 +76,11 @@ def _assert_equal(msg, back) -> None:
     assert type(back) is type(msg)
     for field in vars(msg):
         a, b = getattr(msg, field), getattr(back, field)
-        if isinstance(a, np.ndarray):
+        if field == "payload":  # raw chunk bytes decode as a u8 view
+            assert bytes(memoryview(b)) == bytes(memoryview(a))
+        elif isinstance(a, np.ndarray):
             np.testing.assert_array_equal(np.asarray(b, dtype=a.dtype), a)
-        elif field == "peer_ids":
+        elif field in ("peer_ids", "holders"):
             assert tuple(b) == tuple(a)
         else:
             assert b == a, f"{field}: {b!r} != {a!r}"
@@ -100,22 +119,77 @@ def test_payload_tags_roundtrip_f16(msg_type):
 
 
 @pytest.mark.parametrize(
-    "msg_type", [ScatterBlock, ReduceBlock], ids=["tag2", "tag3"]
+    "msg_type",
+    [ScatterBlock, ReduceBlock, st.ChunkData],
+    ids=["tag2", "tag3", "tag18"],
 )
 @pytest.mark.parametrize("f16", [False, True], ids=["f32", "f16"])
 def test_payload_corruption_is_rejected(msg_type, f16):
-    """The [count][checksum] branch: one flipped payload byte must fail
-    decode (ValueError from the checksum verify), never deliver bad floats."""
+    """The checksum branch (float [count][checksum] on tags 2/3, the raw
+    chunk [nbytes][checksum] on tag 18): one flipped payload byte must fail
+    decode (ValueError from the checksum verify), never deliver bad bytes."""
     data = bytearray(wire.encode(_SAMPLES[msg_type], f16=f16))
-    data[-2] ^= 0x40  # flip a bit inside the float payload
+    data[-2] ^= 0x40  # flip a bit inside the payload
     with pytest.raises(ValueError):
         wire.decode(bytes(data))
 
 
-def test_truncated_payload_is_rejected():
-    data = wire.encode(_SAMPLES[ScatterBlock])
+@pytest.mark.parametrize(
+    "msg_type", [ScatterBlock, st.ChunkData], ids=["tag2", "tag18"]
+)
+def test_truncated_payload_is_rejected(msg_type):
+    data = wire.encode(_SAMPLES[msg_type])
     with pytest.raises(ValueError):
         wire.decode(data[: len(data) - 3])
+
+
+# --- tag 18 raw-buffer payload specifics --------------------------------------
+
+
+def test_chunk_payload_roundtrips_end_to_end_verifiable():
+    """The chunk transfer's two verification layers compose: the wire
+    checksum passes decode, and the decoded bytes still hash back to the
+    manifest's blob name (st.npy_sha) — transport cannot silently alter a
+    chunk between a peer's disk and the restorer's verify gate."""
+    back = wire.decode(wire.encode(_SAMPLES[st.ChunkData]))
+    assert st.npy_sha(bytes(memoryview(back.payload))) == _CHUNK_SHA
+
+
+def test_chunk_payload_f16_flag_is_a_noop():
+    """Chunk payloads are raw bytes, not floats: the wire-compression flag
+    must leave them byte-identical (a compressed checkpoint chunk would be
+    corruption, not compression)."""
+    plain = wire.encode(_SAMPLES[st.ChunkData])
+    flagged = wire.encode(_SAMPLES[st.ChunkData], f16=True)
+    assert plain == flagged
+
+
+def test_chunk_payload_segment_is_zero_copy():
+    """encode_frame_parts must carry the chunk bytes as a memoryview
+    segment (the scatter-gather send path), never a joined copy."""
+    msg = st.ChunkData(_CHUNK_SHA, _CHUNK_BYTES, 1, 40, False)
+    parts = wire.encode_frame_parts("ckpt:2", msg)
+    views = [p for p in parts if isinstance(p, memoryview)]
+    assert len(views) == 1
+    assert views[0].nbytes == len(_CHUNK_BYTES)
+    assert bytes(views[0]) == _CHUNK_BYTES
+
+
+def test_chunk_decode_is_view_into_buffer():
+    """Decode hands back a zero-copy u8 view of the receive buffer, like
+    the float payload tags — the recv-pool export check is what keeps
+    recycling safe, so the view must actually alias the buffer."""
+    buf = bytearray(wire.encode(_SAMPLES[st.ChunkData]))
+    back = wire.decode(buf)
+    assert isinstance(back.payload, np.ndarray)
+    with pytest.raises(BufferError):
+        buf.pop()  # a live export refuses resize => the view aliases buf
+
+
+def test_empty_chunk_payload_roundtrips():
+    msg = st.ChunkData("00" * 32, b"", 0, 1, False)
+    back = wire.decode(wire.encode(msg))
+    assert bytes(memoryview(back.payload)) == b""
 
 
 # --- trace-context trailer: version-skew compatibility (PR 4) -----------------
