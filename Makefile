@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo chaos chaos-recover
+.PHONY: lint lint-json baseline native test tier1 trace-demo chaos chaos-recover chaos-failover
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -47,6 +47,15 @@ chaos:
 chaos-recover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
 	  chaos-recover --seed 1234 --out-dir chaos_recover_run
+
+# fixed-seed master-kill failover drill (RESILIENCE.md "Tier 4"): a seeded
+# chaos crash kills the LEADER mid-round; the warm standby must take over
+# under a bumped epoch, the round budget must complete with no round applied
+# twice (cross-epoch dedup), and a node killed + disk-wiped AFTER the
+# failover must still peer-restore via the replicated holder registry.
+chaos-failover:
+	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
+	  chaos-failover --seed 1234 --out-dir chaos_failover_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -1035,7 +1035,14 @@ def _run_cluster_master(args) -> int:
 
     async def run() -> None:
         metrics = MetricsLogger(args.metrics_out) if args.metrics_out else None
-        master = MasterProcess(cfg, args.host, args.port, metrics=metrics)
+        master = MasterProcess(
+            cfg, args.host, args.port, metrics=metrics,
+            # a real OS process: the chaos `crash:node=m` fault may
+            # os._exit here (the chaos-failover drill's leader kill) —
+            # and the injector flushes the chaos log on its way down
+            allow_crash=True,
+            chaos_log=getattr(args, "chaos_log", None),
+        )
         ep = await master.start()
         print(f"master listening on {ep}", flush=True)
         # SIGTERM ends an open-ended (--rounds -1) run GRACEFULLY: nodes get
@@ -1136,7 +1143,8 @@ def _cmd_cluster_node(argv: list[str]) -> int:
     from akka_allreduce_tpu.protocol import AllReduceInput
 
     state = {"payload": None, "flushes": 0, "t0": None, "node": None,
-             "save_task": None, "step_base": 0, "save_enabled": False}
+             "save_task": None, "step_base": 0, "save_enabled": False,
+             "last_flush_round": -1, "dup_flushes": 0}
 
     def source(req):
         if state["payload"] is None:
@@ -1145,6 +1153,15 @@ def _cmd_cluster_node(argv: list[str]) -> int:
 
     def sink(out):
         state["flushes"] += 1
+        # flushed round ids are strictly increasing BY CONSTRUCTION (the
+        # worker abandons older rounds on completion, and the cross-epoch
+        # floor survives rejoins) — a non-increasing flush means a round
+        # was applied twice. The chaos-failover drill asserts this stays 0
+        # across a master failover.
+        if out.iteration <= state["last_flush_round"]:
+            state["dup_flushes"] += 1
+        else:
+            state["last_flush_round"] = out.iteration
         node = state["node"]
         n = state["flushes"]
         if (
@@ -1245,7 +1262,7 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         wire_path = "native" if _native.loaded() else "python"
         print(
             f"node {nid} shut down ({reason}): {state['flushes']} rounds, "
-            f"{mbs:.1f} MB/s reduced",
+            f"{mbs:.1f} MB/s reduced, dup_flushes={state['dup_flushes']}",
             flush=True,
         )
         # wall decomposition (VERDICT r3 #9). Two views, different units:
@@ -1271,11 +1288,130 @@ def _cmd_cluster_node(argv: list[str]) -> int:
                 kind="node_stage_times", node=nid, wall_s=round(dt, 3),
                 cpu_s=round(cpu, 3),
                 rounds=state["flushes"], mb_per_s=round(mbs, 1),
+                dup_flushes=state["dup_flushes"],
                 wire=wire_path,
                 **{k: round(v, 4) for k, v in stages.items()},
             )
             m.log_snapshot(REGISTRY, role="node", node=nid)
             m.close()
+        return 0
+
+    rc = asyncio.run(run())
+    _write_trace(args)
+    return rc
+
+
+def _cmd_cluster_standby(argv: list[str]) -> int:
+    """Warm-standby master role (RESILIENCE.md 'Tier 4'): registers with
+    the leader, absorbs the replicated state-digest stream, and takes over
+    — bumping the leadership epoch — when its lease on the leader expires.
+    Nodes walk the standby list distributed via Welcome/AddressBook and
+    re-join here; the round budget then completes under the new epoch."""
+    p = argparse.ArgumentParser(
+        "cluster-standby",
+        description="warm-standby master: replicate the leader's control-"
+        "plane state and take over on leader loss (epoch-fenced failover)",
+    )
+    p.add_argument("--seed", required=True, help="leader master host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument(
+        "--heartbeat", type=float, default=1.0,
+        help="lease tick + expected digest cadence (s); match the "
+        "leader's --heartbeat",
+    )
+    p.add_argument(
+        "--phi", type=float, default=8.0,
+        help="phi-accrual threshold of the leader lease (lower = faster, "
+        "riskier takeover)",
+    )
+    p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
+    _add_obs_flags(p)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    _install_obs(args)
+
+    import asyncio
+    import json
+
+    from akka_allreduce_tpu.config import AllreduceConfig
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+    from akka_allreduce_tpu.control.cluster import Endpoint
+    from akka_allreduce_tpu.config import MasterConfig
+    from akka_allreduce_tpu.utils.metrics import MetricsLogger
+
+    async def run() -> int:
+        metrics = MetricsLogger(args.metrics_out) if args.metrics_out else None
+        # placeholder config: everything that matters (thresholds, chaos,
+        # retry, round budget) is ADOPTED from the leader's digest at
+        # takeover — only the lease cadence is ours to configure
+        cfg = AllreduceConfig(
+            master=MasterConfig(heartbeat_interval_s=args.heartbeat)
+        )
+        master = MasterProcess(
+            cfg, args.host, args.port,
+            standby_of=Endpoint.parse(args.seed),
+            phi_threshold=args.phi,
+            metrics=metrics,
+            allow_crash=True,
+        )
+
+        def on_takeover(m: MasterProcess) -> None:
+            # machine-readable line the chaos-failover drill gates on
+            print(
+                "TAKEOVER "
+                + json.dumps(
+                    {
+                        "epoch": m.epoch,
+                        "members": sorted(m.grid.nodes),
+                        "resume_round": m.grid.resume_round,
+                        "completed_carried": m.grid._completed_before_reorg,
+                        "ckpt_origins": sorted(m._ckpt),
+                    }
+                ),
+                flush=True,
+            )
+
+        master.on_takeover = on_takeover
+        ep = await master.start()
+        print(f"standby listening on {ep} (leader {args.seed})", flush=True)
+        import signal as _signal
+
+        from akka_allreduce_tpu.control.remote import observed_task
+
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(
+                _signal.SIGTERM,
+                lambda: observed_task(
+                    master.shutdown("sigterm"), name="sigterm-shutdown"
+                ),
+            )
+        except (NotImplementedError, RuntimeError):  # pragma: no cover
+            pass
+        try:
+            t0 = time.perf_counter()
+            await master.run_until_done()
+            if master.active:
+                print(
+                    f"master done: {master.rounds_completed} line-rounds "
+                    f"completed (epoch {master.epoch}, wall "
+                    f"{time.perf_counter() - t0:.2f}s since standby start)",
+                    flush=True,
+                )
+                await asyncio.sleep(2 * args.heartbeat)  # let Shutdown flush
+            else:
+                print(
+                    f"standby released ({master.shutdown_reason})",
+                    flush=True,
+                )
+        finally:
+            await master.stop()
+            if metrics is not None:
+                from akka_allreduce_tpu.obs.metrics import REGISTRY
+
+                metrics.log_snapshot(REGISTRY, role="standby")
+                metrics.close()
         return 0
 
     rc = asyncio.run(run())
@@ -2260,6 +2396,59 @@ def _cmd_soak(argv: list[str]) -> int:
     return 0
 
 
+def _drill_spawn(env):
+    """Subprocess factory shared by the chaos drills — ONE parent python
+    owns every role (separate shell jobs may land in isolated sandbox
+    network namespaces and never reach each other's loopback ports)."""
+    import subprocess
+
+    def spawn(*cli):
+        return subprocess.Popen(
+            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+
+    return spawn
+
+
+def _drill_full_rounds(path, workers: int) -> int:
+    """Completed line-rounds with FULL membership recorded in a master's
+    metrics JSONL — recovery progress only counts when every node is back
+    in the line. Tolerates the torn last line of a live writer."""
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path) as f:
+        for ln in f:
+            if not ln.strip():
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue  # the writer is mid-append
+            if rec.get("kind") == "round" and rec.get("workers") == workers:
+                n += 1
+    return n
+
+
+def _drill_phase_waiter(timeout_s: float, failures: list):
+    """``await_phase(pred, what)`` with one shared timeout/report shape."""
+
+    def await_phase(pred, what: str) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.2)
+        failures.append(f"timed out waiting for {what}")
+        return False
+
+    return await_phase
+
+
 def _cmd_chaos(argv: list[str]) -> int:
     """Chaos harness: a real master + N node OS processes over loopback,
     every transport armed with the SAME seeded fault schedule (the master
@@ -2314,13 +2503,7 @@ def _cmd_chaos(argv: list[str]) -> int:
     for f in stale:  # MetricsLogger appends; never mix two runs' records
         os.remove(os.path.join(args.out_dir, f))
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-
-    def spawn(*cli):
-        return subprocess.Popen(
-            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
-            env=env, stdout=subprocess.PIPE, text=True,
-        )
-
+    spawn = _drill_spawn(env)
     rounds = -1 if args.duration else args.rounds
     wedged = False
     master = spawn(
@@ -2542,12 +2725,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         if os.path.isdir(d):
             shutil.rmtree(d)  # a fresh drill must not inherit old state
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-
-    def spawn(*cli):
-        return subprocess.Popen(
-            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
-            env=env, stdout=subprocess.PIPE, text=True,
-        )
+    spawn = _drill_spawn(env)
 
     def spawn_node(seed_ep, k):
         return spawn(
@@ -2566,28 +2744,9 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     rounds_at_crash = rounds_at_done = 0
 
     def full_rounds() -> int:
-        """Completed line-rounds with FULL membership so far — post-rejoin
-        progress only counts when the reborn node is back in the line."""
-        if not os.path.exists(metrics_path):
-            return 0
-        n = 0
-        with open(metrics_path) as f:
-            for ln in f:
-                if not ln.strip():
-                    continue
-                rec = json.loads(ln)
-                if rec.get("kind") == "round" and rec.get("workers") == args.nodes:
-                    n += 1
-        return n
+        return _drill_full_rounds(metrics_path, args.nodes)
 
-    def await_phase(pred, what: str) -> bool:
-        deadline = time.monotonic() + args.phase_timeout
-        while time.monotonic() < deadline:
-            if pred():
-                return True
-            time.sleep(0.2)
-        failures.append(f"timed out waiting for {what}")
-        return False
+    await_phase = _drill_phase_waiter(args.phase_timeout, failures)
 
     master = spawn(
         "cluster-master", "--port", "0", "--nodes", str(args.nodes),
@@ -2732,6 +2891,294 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     return 0 if not failures else 1
 
 
+def _cmd_chaos_failover(argv: list[str]) -> int:
+    """Master-kill failover drill (RESILIENCE.md "Tier 4", ISSUE 7
+    acceptance): a real leader + warm standby + N state-armed nodes run an
+    open-ended round budget; a SEEDED chaos crash (``crash:node=m``) kills
+    the leader mid-round. The standby must take over within one lease
+    window (TAKEOVER line), rounds must resume under the bumped epoch with
+    no round applied twice (every node's ``dup_flushes`` stays 0 — the
+    cross-epoch dedup), and a node killed+disk-wiped AFTER the failover
+    must still restore from peers via the REPLICATED holder registry. The
+    run then ends gracefully via SIGTERM at the promoted master. ``make
+    chaos-failover`` runs the fixed-seed variant; exit 0 iff every
+    assertion holds."""
+    p = argparse.ArgumentParser(
+        "chaos-failover",
+        description="seeded leader kill mid-round; assert warm-standby "
+        "takeover, epoch fencing, cross-epoch round dedup, and a "
+        "post-failover peer restore",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument(
+        "--crash-round", type=int, default=25,
+        help="round at which the leader's seeded crash fires",
+    )
+    p.add_argument(
+        "--min-post-rounds", type=int, default=40,
+        help="full-membership rounds that must complete under the standby "
+        "AFTER the post-failover peer restore (the drill's round budget)",
+    )
+    p.add_argument(
+        "--extra-spec", default="",
+        help="additional chaos faults layered onto the leader kill "
+        "(e.g. 'drop:p=0.02')",
+    )
+    p.add_argument(
+        "--phase-timeout", type=float, default=240.0,
+        help="wall-clock bound on each drill phase",
+    )
+    p.add_argument("--size", type=int, default=65536)
+    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument("--state-every", type=int, default=5)
+    p.add_argument("--out-dir", default="chaos_failover_run")
+    args = p.parse_args(argv)
+    if args.nodes < 3:
+        p.error("need >= 3 nodes: a restore victim plus 2 replica holders")
+
+    import json
+    import os
+    import re
+    import shutil
+    import signal as _signal
+    import subprocess
+    import threading
+
+    from akka_allreduce_tpu.control.chaos import CRASH_EXIT_CODE, parse_spec
+
+    spec = f"crash:node=m,at=round{args.crash_round}"
+    if args.extra_spec:
+        spec = f"{spec};{args.extra_spec}"
+    try:
+        parse_spec(spec)
+    except ValueError as e:
+        p.error(str(e))
+    os.makedirs(args.out_dir, exist_ok=True)
+    leader_metrics = os.path.join(args.out_dir, "rounds-leader.jsonl")
+    standby_metrics = os.path.join(args.out_dir, "rounds-standby.jsonl")
+    for f in (leader_metrics, standby_metrics):
+        if os.path.exists(f):
+            os.remove(f)  # MetricsLogger appends; one run per file
+    state_dirs = [
+        os.path.join(args.out_dir, f"state{k}") for k in range(args.nodes)
+    ]
+    for d in state_dirs:
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    spawn = _drill_spawn(env)
+
+    def spawn_node(seed_ep, k):
+        return spawn(
+            "cluster-node", "--seed", seed_ep, "--node-id", str(k),
+            "--state-dir", state_dirs[k],
+            "--state-every", str(args.state_every),
+        )
+
+    def pump(proc, into: list):
+        t = threading.Thread(
+            target=lambda: into.extend(proc.stdout), daemon=True
+        )
+        t.start()
+        return t
+
+    def full_rounds(path) -> int:
+        return _drill_full_rounds(path, args.nodes)
+
+    failures: list[str] = []
+    await_phase = _drill_phase_waiter(args.phase_timeout, failures)
+
+    victim = args.nodes - 1
+    crash_exit = None
+    rounds_at_crash = 0
+    takeover = None
+    restore = None
+    standby_done = False
+    dup_flushes: dict[int, int] = {}
+    node_exits: dict[int, int | None] = {}
+    standby_lines: list[str] = []
+    reborn_lines: list[str] = []
+    reborn = None
+
+    leader = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(args.nodes),
+        "--rounds", "-1", "--size", str(args.size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--chaos-seed", str(args.seed), "--chaos-spec", spec,
+        "--chaos-log", os.path.join(args.out_dir, "chaos-leader.jsonl"),
+        "--metrics-out", leader_metrics,
+    )
+    standby = None
+    nodes = []
+    try:
+        seed_ep = None
+        for line in leader.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("leader never reported its endpoint")
+        standby = spawn(
+            "cluster-standby", "--seed", seed_ep,
+            "--heartbeat", str(args.heartbeat),
+            "--metrics-out", standby_metrics,
+        )
+        standby_ep = None
+        for line in standby.stdout:
+            if line.startswith("standby listening on "):
+                standby_ep = line.split()[3]
+                break
+        if standby_ep is None:
+            raise RuntimeError("standby never reported its endpoint")
+        standby_pump = pump(standby, standby_lines)
+        nodes = [spawn_node(seed_ep, k) for k in range(args.nodes)]
+        # phase 1: the seeded master kill fires (round trigger mid-run)
+        try:
+            crash_exit = leader.wait(timeout=args.phase_timeout)
+        except subprocess.TimeoutExpired:
+            failures.append("leader never crashed (chaos round not reached)")
+        if crash_exit is not None and crash_exit != CRASH_EXIT_CODE:
+            failures.append(
+                f"leader exited {crash_exit}, not the chaos crash "
+                f"{CRASH_EXIT_CODE}"
+            )
+        rounds_at_crash = full_rounds(leader_metrics)
+        # phase 2: the standby's lease expires and it takes over
+        if not failures:
+            await_phase(
+                lambda: any(
+                    ln.startswith("TAKEOVER ") for ln in list(standby_lines)
+                ),
+                "the standby's TAKEOVER line",
+            )
+            for ln in list(standby_lines):
+                if ln.startswith("TAKEOVER "):
+                    takeover = json.loads(ln[len("TAKEOVER "):])
+        # phase 3: rounds resume under the new epoch with full membership
+        if not failures:
+            await_phase(
+                lambda: full_rounds(standby_metrics) >= 5,
+                "post-takeover full-membership rounds",
+            )
+        # phase 4: kill a NODE after the failover, wipe its disk, respawn
+        # it at the promoted master — the restore must find peer holders
+        # via the registry the digest replicated (plus re-adverts)
+        if not failures:
+            nodes[victim].send_signal(_signal.SIGKILL)
+            nodes[victim].wait()
+            node_exits[victim] = nodes[victim].returncode
+            shutil.rmtree(state_dirs[victim], ignore_errors=True)
+            reborn = spawn_node(standby_ep, victim)
+            reborn_pump = pump(reborn, reborn_lines)
+            await_phase(
+                lambda: any(
+                    ln.startswith("RESTORE ") for ln in list(reborn_lines)
+                ),
+                "the respawned node's restore report",
+            )
+            for ln in list(reborn_lines):
+                if ln.startswith("RESTORE "):
+                    restore = json.loads(ln[len("RESTORE "):])
+        # phase 5: the drill's round budget completes under the standby
+        if not failures:
+            target = full_rounds(standby_metrics) + args.min_post_rounds
+            await_phase(
+                lambda: full_rounds(standby_metrics) >= target,
+                f"{args.min_post_rounds} full-membership rounds "
+                "post-restore",
+            )
+        # phase 6: graceful end at the PROMOTED master
+        standby.send_signal(_signal.SIGTERM)
+        try:
+            standby.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            failures.append("promoted standby did not shut down on SIGTERM")
+        standby_pump.join(timeout=10)
+        standby_done = any("master done" in ln for ln in standby_lines)
+        for k, n in enumerate(nodes):
+            if k == victim:
+                continue
+            try:
+                out, _ = n.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                n.kill()
+                out = ""
+            node_exits[k] = n.returncode
+            m = re.search(r"dup_flushes=(\d+)", out or "")
+            if m:
+                dup_flushes[k] = int(m.group(1))
+        if reborn is not None:
+            try:
+                reborn.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                reborn.kill()
+            reborn_pump.join(timeout=10)
+            node_exits[f"{victim}-reborn"] = reborn.returncode
+            for ln in reborn_lines:
+                m = re.search(r"dup_flushes=(\d+)", ln)
+                if m:
+                    dup_flushes[victim] = int(m.group(1))
+    finally:
+        for proc in [leader, standby, *nodes, *([reborn] if reborn else [])]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # assertions over the collected evidence
+    if takeover is None:
+        failures.append("standby never took over")
+    elif takeover.get("epoch", 0) < 2:
+        failures.append(f"takeover did not bump the epoch: {takeover}")
+    if restore is None:
+        failures.append("respawned node never reported a restore")
+    else:
+        if restore.get("source") != "peer":
+            failures.append(
+                f"post-failover restore source {restore.get('source')!r} "
+                "!= 'peer' (replicated registry not consulted?)"
+            )
+        if not restore.get("complete"):
+            failures.append("post-failover peer restore incomplete")
+    if not standby_done:
+        failures.append("promoted standby did not finish cleanly")
+    for k, dups in sorted(dup_flushes.items()):
+        if dups:
+            failures.append(
+                f"node {k} applied {dups} round(s) twice across the "
+                "failover (cross-epoch dedup broken)"
+            )
+    if len(dup_flushes) < args.nodes:
+        failures.append(
+            f"dup-flush evidence from only {sorted(dup_flushes)} of "
+            f"{args.nodes} node(s)"
+        )
+    for k, rc in sorted(node_exits.items(), key=str):
+        if k == victim:  # SIGKILLed by the drill itself
+            continue
+        if rc not in (0, None):
+            failures.append(f"node {k} exited {rc}")
+
+    summary = {
+        "seed": args.seed,
+        "spec": spec,
+        "crash_exit": crash_exit,
+        "full_rounds_at_crash": rounds_at_crash,
+        "takeover": takeover,
+        "rounds_under_standby": full_rounds(standby_metrics),
+        "restore": restore,
+        "dup_flushes": dup_flushes,
+        "node_exits": {str(k): v for k, v in sorted(node_exits.items(), key=lambda kv: str(kv[0]))},
+        "standby_done": standby_done,
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def _cmd_obs(argv: list[str]) -> int:
     """Observability toolbox: run the 2-process trace demo, inspect flight
     dumps, merge per-process Perfetto traces (OBSERVABILITY.md)."""
@@ -2819,7 +3266,6 @@ def _cmd_obs(argv: list[str]) -> int:
 def _run_obs_demo(args) -> int:
     import json
     import os
-    import subprocess
 
     os.makedirs(args.out_dir, exist_ok=True)
     traces = [os.path.join(args.out_dir, "trace-master.json")]
@@ -2828,13 +3274,7 @@ def _run_obs_demo(args) -> int:
         if os.path.exists(f):
             os.remove(f)
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-
-    def spawn(*cli):
-        return subprocess.Popen(
-            [sys.executable, "-m", "akka_allreduce_tpu", *cli],
-            env=env, stdout=subprocess.PIPE, text=True,
-        )
-
+    spawn = _drill_spawn(env)
     master = spawn(
         "cluster-master", "--port", "0", "--nodes", str(args.nodes),
         "--rounds", str(args.rounds), "--size", str(args.size),
@@ -2904,6 +3344,7 @@ COMMANDS = {
     "local-demo": _cmd_local_demo,
     "cluster-master": _cmd_cluster_master,
     "cluster-node": _cmd_cluster_node,
+    "cluster-standby": _cmd_cluster_standby,
     "train-cluster-master": _cmd_train_cluster_master,
     "train-cluster-node": _cmd_train_cluster_node,
     "bench": _cmd_bench,
@@ -2923,6 +3364,7 @@ COMMANDS = {
     "obs": _cmd_obs,
     "chaos": _cmd_chaos,
     "chaos-recover": _cmd_chaos_recover,
+    "chaos-failover": _cmd_chaos_failover,
 }
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import json
 import logging
 import os
 import time
@@ -35,6 +36,7 @@ from akka_allreduce_tpu.control import cluster as cl
 from akka_allreduce_tpu.control.envelope import Envelope
 from akka_allreduce_tpu.control.failure import (
     HeartbeatMonitor,
+    LeaderLease,
     MemberState,
     PhiAccrualFailureDetector,
 )
@@ -47,12 +49,38 @@ from akka_allreduce_tpu.control.remote import (
 )
 from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.control.worker import DataSink, DataSource
+from akka_allreduce_tpu.obs import flight as _flight
+from akka_allreduce_tpu.obs import metrics as _metrics
 
 log = logging.getLogger(__name__)
 
+# master-HA observability (OBSERVABILITY.md): the current leadership epoch,
+# takeover/fence/walk counters, and the digest stream volume — the signals a
+# failover post-mortem reads next to the chaos event log
+_EPOCH_GAUGE = _metrics.gauge("master.epoch")
+_TAKEOVERS = _metrics.counter("failover.takeovers")
+_DIGESTS_SENT = _metrics.counter("failover.digests_sent")
+_DIGESTS_RECEIVED = _metrics.counter("failover.digests_received")
+_FENCED = _metrics.counter("failover.fenced")
+_WALKS = _metrics.counter("failover.walks")
+_SOLICITS = _metrics.counter("failover.advert_solicits")
+
 
 class MasterProcess:
-    """Seed-node role: membership, line organization, round scheduling."""
+    """Seed-node role: membership, line organization, round scheduling.
+
+    Master high availability (RESILIENCE.md "Tier 4 — control-plane
+    failover"): every master runs with a monotonically-bumped leadership
+    ``epoch`` stamped onto all master->node control messages (nodes fence
+    stale-epoch senders, so a zombie deposed leader can never split-brain
+    a healed partition). With ``standby_of`` set, this process is a WARM
+    STANDBY instead: it registers with the leader, absorbs the replicated
+    :class:`cl.StateDigest` stream (membership + incarnations, round
+    counters, the peer-checkpoint holder registry, the full config), and
+    takes over — bumping the epoch — when its :class:`LeaderLease` expires
+    on digest silence. Nodes then walk the standby list distributed via
+    ``Welcome``/``AddressBook`` and re-join the new leader.
+    """
 
     def __init__(
         self,
@@ -63,10 +91,42 @@ class MasterProcess:
         clock: Callable[[], float] = time.monotonic,
         phi_threshold: float = 8.0,
         metrics=None,  # utils.metrics.MetricsLogger | None
+        epoch: int = 1,
+        standby_of: cl.Endpoint | None = None,
+        allow_crash: bool = False,
+        chaos_log: str | None = None,
     ) -> None:
         self.config = config
         self.clock = clock
         self.metrics = metrics
+        self.epoch = epoch
+        self.standby_of = standby_of
+        self.allow_crash = allow_crash
+        self.chaos_log = chaos_log
+        self._took_over = False
+        self._fenced_out = False
+        self.shutdown_reason: str | None = None
+        # standby endpoints registered with THIS leader, in registration
+        # order (the walk order nodes follow on leader loss)
+        self.standby_eps: list[cl.Endpoint] = []
+        self._digest_seq = 0
+        # the digest's slow-moving half (config, membership, the ckpt
+        # registry) is cached between state changes AS SERIALIZED JSON:
+        # the per-tick lease heartbeat pays for the tiny round-counter
+        # object and a string splice, not a full re-serialization of the
+        # config and every retained checkpoint manifest
+        self._digest_static: str | None = None
+        # standby-side lease on the leader, renewed per received digest
+        self._lease = LeaderLease(
+            threshold=phi_threshold,
+            first_heartbeat_estimate=config.master.heartbeat_interval_s,
+        )
+        self._last_digest: cl.StateDigest | None = None
+        self._register_countdown = 0
+        self._standby_task: asyncio.Task | None = None
+        # observers the CLI can hook (the chaos-failover drill watches the
+        # TAKEOVER line this callback prints)
+        self.on_takeover: Callable[["MasterProcess"], None] | None = None
         self.watchdog = None
         if config.master.round_deadline_s > 0:
             from akka_allreduce_tpu.obs.watchdog import RoundWatchdog
@@ -74,20 +134,7 @@ class MasterProcess:
             self.watchdog = RoundWatchdog(
                 config.master.round_deadline_s, clock=clock
             )
-        self.grid = GridMaster(
-            config.threshold,
-            config.master,
-            config.line_master,
-            on_round_complete=(
-                self._on_round_complete if (metrics or self.watchdog) else None
-            ),
-            on_round_start=(
-                self.watchdog.round_started if self.watchdog else None
-            ),
-            # a re-mesh abandons the replaced lines' rounds by design —
-            # their deadlines must retire with them, not fire as stalls
-            on_reorganize=(self.watchdog.reset if self.watchdog else None),
-        )
+        self.grid = self._build_grid()
         self.monitor = HeartbeatMonitor(
             PhiAccrualFailureDetector(
                 threshold=phi_threshold,
@@ -105,29 +152,74 @@ class MasterProcess:
         self.transport.wire_f16 = config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = config.master.retry
         if config.chaos.enabled:
-            from akka_allreduce_tpu.control.chaos import (
-                MASTER_ROLE,
-                ChaosInjector,
-            )
-
-            self.transport.chaos = ChaosInjector(
-                config.chaos.seed,
-                config.chaos.spec,
-                role=MASTER_ROLE,
-                dims=config.master.dimensions,
-            )
+            self._arm_chaos()
         # peer checkpoint registry (statetransfer, RESILIENCE.md "Recovery"):
         # origin node id -> newest advertised manifest + which nodes hold it.
         # The master never touches chunk BYTES — it is the directory a
         # rejoiner consults for "what was my newest state, who has it".
         self._ckpt: dict[int, dict] = {}
         self.transport.register("master", self._on_cluster_msg)
-        self.transport.register_prefix("line_master", self.grid.handle_for_line)
+        # forwarding lambda, NOT the bound method: a standby takeover
+        # replaces self.grid wholesale, and the registration must follow it
+        self.transport.register_prefix(
+            "line_master", lambda lid, m: self.grid.handle_for_line(lid, m)
+        )
         self.transport.set_prefix_route("worker", self._worker_endpoint)
-        self.transport.set_prefix_route("node", self.book.get)
+        # method, not self.book.get: a standby takeover replaces the book
+        self.transport.set_prefix_route("node", self._node_book_endpoint)
         self.transport.set_prefix_route("ckpt", self._node_endpoint)
         self._poll_task: asyncio.Task | None = None
         self._done = asyncio.Event()
+
+    def _build_grid(self) -> GridMaster:
+        """One definition of the grid wiring — the ctor and a standby
+        takeover (which replaces the grid under the adopted config) must
+        never drift apart."""
+        return GridMaster(
+            self.config.threshold,
+            self.config.master,
+            self.config.line_master,
+            on_round_complete=(
+                self._on_round_complete
+                if (self.metrics or self.watchdog)
+                else None
+            ),
+            on_round_start=(
+                self.watchdog.round_started if self.watchdog else None
+            ),
+            # a re-mesh abandons the replaced lines' rounds by design —
+            # their deadlines must retire with them, not fire as stalls
+            on_reorganize=(self.watchdog.reset if self.watchdog else None),
+            epoch=self.epoch,
+        )
+
+    def _arm_chaos(self) -> None:
+        from akka_allreduce_tpu.control.chaos import (
+            MASTER_ROLE,
+            ChaosInjector,
+        )
+
+        self.transport.chaos = ChaosInjector(
+            self.config.chaos.seed,
+            self.config.chaos.spec,
+            role=MASTER_ROLE,
+            dims=self.config.master.dimensions,
+            # crash:node=m fires for real only in a real OS process (the
+            # CLI roles arm this) — in-process masters record a
+            # suppressed crash, exactly like nodes
+            allow_crash=self.allow_crash,
+            log_path=self.chaos_log,
+        )
+
+    @property
+    def active(self) -> bool:
+        """Leading right now: a plain master or a standby post-takeover —
+        unless a newer epoch fenced us out (a deposed leader must stop
+        ANSWERING the cluster protocol too, or it would keep Welcoming
+        walking nodes into a dead end while its scheduler is silenced)."""
+        return (
+            self.standby_of is None or self._took_over
+        ) and not self._fenced_out
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -139,19 +231,33 @@ class MasterProcess:
         )
         if self.watchdog is not None:
             self.watchdog.start()  # its own observed_task poll loop
-        log.info("master listening on %s", ep)
+        if self.standby_of is not None:
+            # standby replication lease loop: (re-)register with the leader
+            # and take over when the digest stream goes silent
+            self._standby_task = observed_task(
+                run_periodic(interval, self._standby_poll),
+                name="standby-lease",
+            )
+            log.info(
+                "standby listening on %s (leader %s)", ep, self.standby_of
+            )
+        else:
+            _EPOCH_GAUGE.set(self.epoch)
+            log.info("master listening on %s (epoch %d)", ep, self.epoch)
         return ep
 
     async def stop(self) -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
-        if self._poll_task is not None:
-            self._poll_task.cancel()
-            try:
-                await self._poll_task
-            except asyncio.CancelledError:
-                pass
-            self._poll_task = None
+        for attr in ("_poll_task", "_standby_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
         await self.transport.stop()
 
     async def run_until_done(self, timeout: float | None = None) -> None:
@@ -164,9 +270,21 @@ class MasterProcess:
         """End an open-ended run from the outside (SIGTERM in the CLI, the
         chaos runner's --duration mode): broadcast ``Shutdown`` so nodes
         exit cleanly — flushing metrics and chaos logs — then release
-        ``run_until_done``."""
-        await self.transport.send_all(self._broadcast(cl.Shutdown(reason)))
+        ``run_until_done``. Registered standbys are released too (a
+        finished run must not read as a dead leader and trigger a
+        takeover)."""
+        await self.transport.send_all(
+            self._broadcast(cl.Shutdown(reason, self.epoch))
+            + self._standby_shutdowns(reason)
+        )
+        self.shutdown_reason = reason
         self._done.set()
+
+    def _standby_shutdowns(self, reason: str) -> list[Envelope]:
+        return [
+            Envelope("master", cl.Shutdown(reason, self.epoch), via=ep)
+            for ep in self.standby_eps
+        ]
 
     # -- routing helpers -------------------------------------------------------
 
@@ -176,6 +294,9 @@ class MasterProcess:
 
     def _node_endpoint(self, node_id: int) -> cl.Endpoint | None:
         return None if node_id in self.unreachable else self.book.get(node_id)
+
+    def _node_book_endpoint(self, node_id: int) -> cl.Endpoint | None:
+        return self.book.get(node_id)
 
     def _broadcast(self, msg: Any) -> list[Envelope]:
         return [
@@ -188,11 +309,30 @@ class MasterProcess:
 
     def _on_cluster_msg(self, msg: Any) -> list[Envelope]:
         now = self.clock()
+        # failover protocol first: these arms exist in BOTH roles
+        if isinstance(msg, cl.StateDigest):
+            return self._on_state_digest(msg, now)
+        if isinstance(msg, cl.StandbyRegister):
+            return self._on_standby_register(msg)
+        if isinstance(msg, cl.Shutdown):
+            return self._on_peer_shutdown(msg)
+        if not self.active:
+            # a PASSIVE standby must not answer the cluster protocol:
+            # welcoming a join (or feeding the detector) before the lease
+            # expires would split the membership between two masters —
+            # nodes that walked here early just keep retrying until the
+            # takeover makes this process answerable
+            return []
         if isinstance(msg, cl.JoinCluster):
-            return self._on_join(msg, now)
+            return self._on_join(msg, now) + self._digest_envelopes()
         if isinstance(msg, cl.Heartbeat):
             return self._on_heartbeat(msg, now)
         if isinstance(msg, st.CheckpointAdvert):
+            # NO digest piggyback here: adverts arrive in bursts (every
+            # holding of every member after one solicit), and the per-tick
+            # lease digest already replicates the registry within one
+            # heartbeat — per-advert full-state sends would be O(members x
+            # holdings) redundant serializations in a single tick
             return self._on_ckpt_advert(msg)
         if isinstance(msg, st.ManifestRequest):
             return self._on_manifest_request(msg)
@@ -203,11 +343,371 @@ class MasterProcess:
             self.unreachable.discard(msg.node_id)
             self._incarnations.pop(msg.node_id, None)
             self._superseded.pop(msg.node_id, None)
+            self._digest_static = None  # membership changed
             # a departed process can no longer serve chunks; its manifests
             # stay known (replicas may still hold the bytes)
             self._drop_ckpt_holder(msg.node_id)
-            return out + self._broadcast(self._address_book())
+            return (
+                out
+                + self._broadcast(self._address_book())
+                + self._digest_envelopes()
+            )
         raise TypeError(f"master cannot handle {type(msg).__name__}")
+
+    # -- master HA: digests, standby registration, takeover --------------------
+
+    def _on_standby_register(self, msg: cl.StandbyRegister) -> list[Envelope]:
+        if not self.active:
+            return []  # standbys do not chain
+        ep = cl.Endpoint(msg.host, msg.port)
+        out: list[Envelope] = []
+        if ep not in self.standby_eps:
+            self.standby_eps.append(ep)
+            self._digest_static = None  # standby list changed
+            log.info("master: standby registered at %s", ep)
+            _flight.note(
+                "failover", event="standby_register", endpoint=str(ep)
+            )
+            # nodes already in the cluster learn the standby list via the
+            # address-book broadcast (Welcome only covers future joiners)
+            out.extend(self._broadcast(self._address_book()))
+        # ack with a full digest either way: registration is idempotent,
+        # periodically re-sent, and the digest warms a fresh standby NOW
+        # instead of at the next state change
+        out.extend(self._digest_envelopes(only=ep))
+        return out
+
+    def _on_state_digest(
+        self, msg: cl.StateDigest, now: float
+    ) -> list[Envelope]:
+        if self.active:
+            if msg.epoch == self.epoch and not (
+                msg.host == self.transport.endpoint.host
+                and msg.port == self.transport.endpoint.port
+            ):
+                # two ACTIVE claimants of the SAME epoch (co-promoted from
+                # disjoint histories): neither outranks the other, so break
+                # the tie deterministically by endpoint — the greater
+                # (host, port) yields, the lesser deposes it. Both sides
+                # apply the same rule, so exactly one survives.
+                me = (self.transport.endpoint.host, self.transport.endpoint.port)
+                if me > (msg.host, msg.port):
+                    self._stand_down(f"equal-epoch tiebreak vs {msg.host}:{msg.port}")
+                    return []
+                log.warning(
+                    "master epoch %d: deposing equal-epoch co-claimant at "
+                    "%s:%d (endpoint tiebreak)",
+                    self.epoch, msg.host, msg.port,
+                )
+                return [
+                    Envelope(
+                        "master",
+                        cl.Shutdown("superseded-epoch", self.epoch),
+                        via=cl.Endpoint(msg.host, msg.port),
+                    )
+                ]
+            if msg.epoch < self.epoch:
+                # a fenced zombie leader is still replicating to us: tell
+                # it to stand down — this closes the split-brain loop (the
+                # zombie's own digest stream is what delivers its fencing)
+                log.warning(
+                    "master epoch %d: fencing zombie leader at %s:%d "
+                    "(epoch %d)",
+                    self.epoch, msg.host, msg.port, msg.epoch,
+                )
+                return [
+                    Envelope(
+                        "master",
+                        cl.Shutdown("superseded-epoch", self.epoch),
+                        via=cl.Endpoint(msg.host, msg.port),
+                    )
+                ]
+            if msg.epoch > self.epoch:
+                # someone with a NEWER epoch is leading: WE are the zombie
+                self._stand_down(f"superseded by epoch {msg.epoch}")
+            return []
+        _DIGESTS_RECEIVED.inc()
+        prev = self._last_digest
+        if prev is not None and msg.epoch < prev.epoch:
+            # an epoch-REGRESSING digest is a not-yet-fenced zombie still
+            # replicating: its pre-failover state must not shadow the
+            # successor's (a takeover from it would resurrect dead
+            # membership and collide with the successor's epoch history)
+            return []
+        if prev is not None and msg.epoch == prev.epoch and msg.seq <= prev.seq:
+            return []  # reordered/duplicate digest: keep the newer state
+        if prev is not None and msg.epoch > prev.epoch:
+            # a NEW leader identity: its digest cadence must not inherit
+            # the dead leader's inter-arrival model
+            self._lease.reset()
+        self._last_digest = msg
+        self._lease.renew(now)
+        # follow the leadership: periodic re-registration must go to
+        # whoever is digesting us NOW — after a failover the promoted
+        # master is the one to re-register with, not the dead seed
+        leader = cl.Endpoint(msg.host, msg.port)
+        if leader != self.standby_of:
+            log.info(
+                "standby: following new leader %s (epoch %d)",
+                leader, msg.epoch,
+            )
+            self.standby_of = leader
+        return []
+
+    def _on_peer_shutdown(self, msg: cl.Shutdown) -> list[Envelope]:
+        if not self.active:
+            # the leader ended the run gracefully: release this standby
+            # (a finished run must not read as a dead leader)
+            log.info("standby released: %s", msg.reason)
+            self.shutdown_reason = msg.reason
+            self._done.set()
+            return []
+        if msg.epoch > self.epoch or msg.reason == "superseded-epoch":
+            self._stand_down(msg.reason)
+        return []
+
+    def _stand_down(self, reason: str) -> None:
+        """Fenced out by a newer leadership epoch: stop acting as master.
+
+        The poll loop goes quiet (no more expulsions, re-prepares, round
+        restarts or broadcasts) and ``run_until_done`` returns so the CLI
+        can exit — a deposed leader must drain, not fight the fence."""
+        if self._fenced_out:
+            return
+        self._fenced_out = True
+        self.shutdown_reason = reason
+        log.warning("master epoch %d fenced out: %s", self.epoch, reason)
+        _flight.note(
+            "failover", event="stand_down", epoch=self.epoch, reason=reason
+        )
+        self._done.set()
+
+    def _digest_state(self) -> str:
+        """The compact replicated state a warm standby needs to take over:
+        enough to keep scheduling (round counters, config), keep membership
+        (book + incarnations), and keep answering ``ManifestRequest`` (the
+        peer-checkpoint holder registry). The slow-moving half is rebuilt
+        only when a state change invalidated it (``_digest_static``) — the
+        per-tick lease heartbeat pays for the round counters and one dump,
+        not a config reparse plus the whole manifest registry."""
+        if self._digest_static is None:
+            static = {
+                "config": json.loads(self.config.to_json()),
+                "book": [
+                    [nid, ep.host, ep.port]
+                    for nid, ep in sorted(self.book.items())
+                ],
+                "incarnations": {
+                    str(n): i for n, i in self._incarnations.items()
+                },
+                "unreachable": sorted(self.unreachable),
+                "ckpt": {
+                    str(origin): {
+                        "manifests": {
+                            str(s): m for s, m in rec["manifests"].items()
+                        },
+                        "holders": {
+                            str(n): s for n, s in rec["holders"].items()
+                        },
+                    }
+                    for origin, rec in self._ckpt.items()
+                },
+                "standbys": [
+                    [ep.host, ep.port] for ep in self.standby_eps
+                ],
+            }
+            # serialized once per state change, held OPEN (trailing `}`
+            # stripped) so the per-tick round counters splice in cheaply
+            self._digest_static = json.dumps(static)[:-1]
+        round_state = {
+            "next": max(
+                (lm.next_round for lm in self.grid.line_masters.values()),
+                default=self.grid.resume_round,
+            ),
+            "completed": self.grid.total_completed,
+            "config_id": self.grid.config_id,
+        }
+        return (
+            self._digest_static + ', "round": ' + json.dumps(round_state) + "}"
+        )
+
+    def _digest_envelopes(
+        self, only: cl.Endpoint | None = None
+    ) -> list[Envelope]:
+        """StateDigest envelopes for the registered standbys — piggybacked
+        after every state-changing event AND once per detector poll (the
+        lease heartbeat)."""
+        targets = [only] if only is not None else list(self.standby_eps)
+        if not self.active or self._fenced_out or not targets:
+            return []
+        self._digest_seq += 1
+        me = self.transport.endpoint
+        msg = cl.StateDigest(
+            self.epoch, self._digest_seq, me.host, me.port,
+            self._digest_state(),
+        )
+        _DIGESTS_SENT.inc(len(targets))
+        return [Envelope("master", msg, via=ep) for ep in targets]
+
+    async def _standby_poll(self) -> None:
+        """The standby's lease loop (one tick per heartbeat interval)."""
+        if self.active or self._done.is_set():
+            return
+        now = self.clock()
+        if self._last_digest is None or self._register_countdown <= 0:
+            # (re-)register: idempotent at the leader, and a RESTARTED
+            # leader (fresh process, empty standby list) re-learns us
+            self._register_countdown = 5
+            me = self.transport.endpoint
+            await self.transport.send(
+                Envelope(
+                    "master",
+                    cl.StandbyRegister(me.host, me.port),
+                    via=self.standby_of,
+                )
+            )
+        else:
+            self._register_countdown -= 1
+        if self._lease.expired(now):
+            self._takeover(now)
+
+    def _takeover(self, now: float) -> None:
+        """The lease expired: become the leader under a bumped epoch.
+
+        Restores the digest's membership, round counters and checkpoint
+        registry, adopts the dead leader's config (chaos + retry knobs
+        included), and waits for nodes to walk the standby list and
+        re-join — each re-join of a known member forces a reorganization,
+        so rounds resume once the quorum is back, numbered PAST everything
+        the old epoch started. A digest that lagged the leader's death by
+        a round is absorbed by the workers' cross-epoch flush floor (a
+        re-issued round id is re-asserted, never re-applied)."""
+        digest = self._last_digest
+        assert digest is not None
+        state = json.loads(digest.state_json)
+        self.config = AllreduceConfig.from_json(json.dumps(state["config"]))
+        # epoch bump, tie-broken by standby RANK in the replicated list:
+        # two standbys whose leases expire on the same silence must not
+        # both claim the same epoch (an equal-epoch pair could never fence
+        # each other). Rank 0 takes +1, rank 1 takes +2, ... — distinct by
+        # construction, and the higher-ranked (later-registered) standby's
+        # digests depose a lower-ranked co-claimant within one exchange;
+        # the equal-epoch arm in _on_state_digest is the defense in depth
+        # for claimants from disjoint histories.
+        me = self.transport.endpoint
+        rank = next(
+            (
+                i
+                for i, (h, p) in enumerate(state["standbys"])
+                if cl.Endpoint(h, int(p)) == me
+            ),
+            0,
+        )
+        self.epoch = max(self.epoch, digest.epoch) + 1 + rank
+        self._took_over = True
+        # speak the dead leader's wire dialect: nodes were welcomed with
+        # these knobs
+        self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
+        self.transport.retry_policy = self.config.master.retry
+        if self.config.chaos.enabled and self.transport.chaos is None:
+            self._arm_chaos()
+            from akka_allreduce_tpu.control.chaos import MASTER_ROLE
+
+            for f in self.transport.chaos.faults:
+                if f.name == "crash" and f.node == MASTER_ROLE:
+                    # the leader-kill fault consumed its one shot on the
+                    # epoch that died of it: a digest that lagged the death
+                    # (round counters below the trigger) must not let the
+                    # PROMOTED master arm the same fault and kill itself
+                    # mid-failover
+                    f.done = True
+        fresh_watchdog = None
+        if self.watchdog is None and self.config.master.round_deadline_s > 0:
+            # the leader ran a round-stall watchdog: the promoted master
+            # must keep watching (the standby's placeholder config has no
+            # deadline, so none was built at construction)
+            from akka_allreduce_tpu.obs.watchdog import RoundWatchdog
+
+            fresh_watchdog = self.watchdog = RoundWatchdog(
+                self.config.master.round_deadline_s, clock=self.clock
+            )
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pass  # driven synchronously (sims/tests): caller owns pacing
+        else:
+            if fresh_watchdog is not None:
+                fresh_watchdog.start()
+            # re-pace the detector/reprepare/restart/digest loop at the
+            # ADOPTED heartbeat interval — the standby's placeholder
+            # cadence may differ from the cluster's
+            if self._poll_task is not None:
+                self._poll_task.cancel()
+            self._poll_task = observed_task(
+                run_periodic(
+                    self.config.master.heartbeat_interval_s,
+                    self._poll_detector,
+                ),
+                name="master-detector",
+            )
+        self.book = {
+            int(nid): cl.Endpoint(h, int(p)) for nid, h, p in state["book"]
+        }
+        self._incarnations = {
+            int(n): int(i) for n, i in state["incarnations"].items()
+        }
+        self.unreachable = {int(n) for n in state["unreachable"]}
+        self._superseded.clear()
+        self._ckpt = {
+            int(origin): {
+                "manifests": {
+                    int(s): m for s, m in rec["manifests"].items()
+                },
+                "holders": {int(n): int(s) for n, s in rec["holders"].items()},
+            }
+            for origin, rec in state["ckpt"].items()
+        }
+        me = self.transport.endpoint
+        self.standby_eps = [
+            cl.Endpoint(h, int(p))
+            for h, p in state["standbys"]
+            if cl.Endpoint(h, int(p)) != me
+        ]
+        self._digest_static = None  # everything above changed
+        # the grid continues the dead leader's numbering under the adopted
+        # config: organized with the known-live member set, so the first
+        # re-join (a "restart" of a known member) drives the reorganize
+        # that re-prepares everyone under the new epoch
+        rnd = state["round"]
+        self.grid = self._build_grid()  # stamps the bumped epoch
+        live = set(self.book) - self.unreachable
+        self.grid.nodes = set(live)
+        self.grid.organized = bool(live)
+        self.grid.resume_round = int(rnd["next"])
+        self.grid.config_id = int(rnd["config_id"])
+        self.grid._completed_before_reorg = int(rnd["completed"])
+        # seed the detector with the members we expect back: one that
+        # never re-joins is expelled by the normal poll path
+        for nid in sorted(live):
+            self.monitor.heartbeat(nid, now)
+        _EPOCH_GAUGE.set(self.epoch)
+        _TAKEOVERS.inc()
+        _flight.note(
+            "failover",
+            event="takeover",
+            epoch=self.epoch,
+            members=sorted(live),
+            resume_round=self.grid.resume_round,
+            completed=self.grid._completed_before_reorg,
+        )
+        log.warning(
+            "standby TAKEOVER: epoch %d, %d member(s), resume round %d, "
+            "%d completed round(s) carried, %d checkpoint origin(s)",
+            self.epoch, len(live), self.grid.resume_round,
+            self.grid._completed_before_reorg, len(self._ckpt),
+        )
+        if self.on_takeover is not None:
+            self.on_takeover(self)
 
     # -- peer checkpoint registry ----------------------------------------------
 
@@ -224,6 +724,7 @@ class MasterProcess:
                 manifests.pop(old)
         holders = rec["holders"]
         holders[msg.node_id] = max(holders.get(msg.node_id, -1), msg.step)
+        self._digest_static = None  # holder registry changed
         log.info(
             "master: node %d holds checkpoint of node %d at step %d",
             msg.node_id, msg.origin, msg.step,
@@ -282,7 +783,26 @@ class MasterProcess:
                     reply = st.ManifestReply(
                         oldest, rec["manifests"][oldest], candidates
                     )
-        return [Envelope(st.ChunkService.addr(msg.node_id), reply)]
+        out = [Envelope(st.ChunkService.addr(msg.node_id), reply)]
+        if reply.step < 0 or not reply.holders:
+            # a dead-end answer from a (possibly replacement) master whose
+            # holder registry is empty or stale: solicit adverts from every
+            # live member so the requester's retry rounds find the state
+            # that actually survived (regression-pinned: a restore issued
+            # immediately after a master restart must still converge)
+            solicit = [
+                Envelope(f"node:{nid}", st.AdvertSolicit("manifest-miss"))
+                for nid in sorted(self.book)
+                if nid != msg.node_id and nid not in self.unreachable
+            ]
+            if solicit:
+                _SOLICITS.inc(len(solicit))
+                log.info(
+                    "master: no holders for node %d; soliciting adverts "
+                    "from %d member(s)", msg.node_id, len(solicit),
+                )
+            out.extend(solicit)
+        return out
 
     def _drop_ckpt_holder(self, node_id: int) -> None:
         """``node_id``'s process is gone (leave, or restart with a new
@@ -291,6 +811,7 @@ class MasterProcess:
         next adverts rebuild the truth from what actually survived."""
         for rec in self._ckpt.values():
             rec["holders"].pop(node_id, None)
+        self._digest_static = None  # holder registry changed
 
     def _on_join(self, msg: cl.JoinCluster, now: float) -> list[Envelope]:
         nid = msg.preferred_node_id
@@ -327,7 +848,11 @@ class MasterProcess:
         # Welcome goes straight to the joiner's endpoint (``via``): it doesn't
         # know its node id yet, so it can't be in any route table.
         welcome = Envelope(
-            "client", cl.Welcome(nid, self.config.to_json()), via=ep
+            "client",
+            cl.Welcome(
+                nid, self.config.to_json(), self.epoch, self._standby_tuple()
+            ),
+            via=ep,
         )
         if (
             self._incarnations.get(nid) == msg.incarnation
@@ -351,6 +876,7 @@ class MasterProcess:
         self.book[nid] = ep
         self._incarnations[nid] = msg.incarnation
         self.unreachable.discard(nid)
+        self._digest_static = None  # membership changed
         # a new incarnation is a new process: its predecessor's inter-arrival
         # history (and the death gap since) must not poison the detector —
         # this covers the fast same-endpoint restart where the monitor state
@@ -379,14 +905,25 @@ class MasterProcess:
             # book) and the sender is a healthy member of its predecessor.
             # Its sends all succeed, so the node's failure counter never
             # trips; without a reply it heartbeats into the void forever.
-            # Tell it to re-run the join handshake at its advertised endpoint.
+            # Tell it to re-run the join handshake at its advertised
+            # endpoint — and solicit its checkpoint adverts NOW, so a
+            # replacement master's empty holder registry repopulates
+            # before the first restore asks for it (not only after the
+            # full rejoin lands).
             if msg.port > 0:
+                via = cl.Endpoint(msg.host, msg.port)
+                _SOLICITS.inc()
                 return [
                     Envelope(
                         f"node:{node_id}",
-                        cl.Rejoin("unknown-node"),
-                        via=cl.Endpoint(msg.host, msg.port),
-                    )
+                        cl.Rejoin("unknown-node", self.epoch),
+                        via=via,
+                    ),
+                    Envelope(
+                        f"node:{node_id}",
+                        st.AdvertSolicit("unknown-node"),
+                        via=via,
+                    ),
                 ]
             return []
         if self._incarnations.get(node_id) != incarnation:
@@ -399,7 +936,7 @@ class MasterProcess:
                 return [
                     Envelope(
                         f"node:{node_id}",
-                        cl.Shutdown("superseded"),
+                        cl.Shutdown("superseded", self.epoch),
                         via=sup[1],
                     )
                 ]
@@ -409,8 +946,11 @@ class MasterProcess:
             # silence marked it unreachable but the process lives: rejoin it
             log.info("master: node %d heartbeat resumed -> rejoin", node_id)
             self.unreachable.discard(node_id)
-            return self._broadcast(self._address_book()) + self.grid.member_up(
-                node_id
+            self._digest_static = None  # membership changed
+            return (
+                self._broadcast(self._address_book())
+                + self.grid.member_up(node_id)
+                + self._digest_envelopes()
             )
         return []
 
@@ -434,16 +974,23 @@ class MasterProcess:
                 data_bytes=self.config.metadata.data_size * 4,
             )
 
+    def _standby_tuple(self) -> tuple[tuple[str, int], ...]:
+        return tuple((ep.host, ep.port) for ep in self.standby_eps)
+
     def _address_book(self) -> cl.AddressBook:
         return cl.AddressBook(
             tuple(
                 (nid, ep.host, ep.port)
                 for nid, ep in sorted(self.book.items())
                 if nid not in self.unreachable
-            )
+            ),
+            self.epoch,
+            self._standby_tuple(),
         )
 
     async def _poll_detector(self) -> None:
+        if not self.active or self._fenced_out:
+            return  # passive standby / deposed leader: no scheduling
         now = self.clock()
         out: list[Envelope] = []
         expelled = False
@@ -460,6 +1007,7 @@ class MasterProcess:
                 # heartbeats resume, _on_heartbeat re-lines it without a new
                 # JoinCluster; a genuine restart re-joins explicitly.
                 self.unreachable.add(event.node_id)
+                self._digest_static = None  # membership changed
                 expelled = True
         if expelled:
             out.extend(self._broadcast(self._address_book()))
@@ -473,11 +1021,18 @@ class MasterProcess:
         for lm in self.grid.line_masters.values():
             out.extend(lm.reprepare_pending(2.0 * interval))
             out.extend(lm.restart_stalled(5.0 * interval))
+        # the digest doubles as the leader's lease heartbeat: one per poll
+        # tick keeps the standby's phi detector renewed even when no state
+        # changed
+        out.extend(self._digest_envelopes())
         if out:
             await self.transport.send_all(out)
         if self.grid.is_done and not self._done.is_set():
             self._done.set()
-            await self.transport.send_all(self._broadcast(cl.Shutdown("done")))
+            await self.transport.send_all(
+                self._broadcast(cl.Shutdown("done", self.epoch))
+                + self._standby_shutdowns("done")
+            )
 
     @property
     def rounds_completed(self) -> int:
@@ -560,12 +1115,22 @@ class NodeProcess:
         # master-loss detection: consecutive failed sends to the master seed.
         # The reference restarts its seed JVM and workers re-join via Akka
         # Cluster; here the node notices its heartbeats bouncing and re-runs
-        # the join handshake against whatever master now owns the endpoint.
+        # the join handshake against whatever master now owns the endpoint —
+        # or, with a standby list distributed via Welcome/AddressBook, WALKS
+        # that list and re-joins the promoted leader (master HA,
+        # RESILIENCE.md "Tier 4").
         self._master_send_failures = 0
         self._rejoining = False
         self._left = False  # graceful leave announced; never rejoin after
         self._rejoin_task: asyncio.Task | None = None
         self.rejoin_after_failures = 3
+        # leadership-epoch fencing watermark: set by the Welcome that
+        # admitted us; anything a master of an OLDER epoch sends afterwards
+        # is dropped (split-brain prevention)
+        self.master_epoch = -1
+        self.standbys: list[cl.Endpoint] = []
+        # joins sent per candidate endpoint before walking to the next
+        self.failover_walk_attempts = 3
         self.transport.on_send_error = self._on_send_error
         self.transport.on_send_ok = self._on_send_ok
 
@@ -673,9 +1238,23 @@ class NodeProcess:
                 self._rejoin_master(), name="node-rejoin"
             )
 
+    def _point_master(self, ep: cl.Endpoint) -> None:
+        """Route all master-bound traffic (joins, heartbeats, line-master
+        confirms/completions, manifest requests) at ``ep`` — the whole
+        control-plane conversation follows the leader we believe in."""
+        self.seed = ep
+        self.transport.set_route("master", ep)
+        self.transport.set_prefix_route(
+            "line_master", lambda _lid, _ep=ep: _ep
+        )
+
     async def _rejoin_master(self) -> None:
         """The master endpoint stopped answering: run the join handshake
-        again (keeping our preferred id) against whatever owns the endpoint.
+        again (keeping our preferred id) against whatever owns the endpoint
+        — and when THAT keeps going unanswered, walk the standby list the
+        leader distributed via Welcome/AddressBook (master-HA failover:
+        the promoted standby answers once its lease on the dead leader
+        expires; until then it ignores joins, so the walk just cycles).
 
         A rejoin wipes this node's worker state, so it presents a NEW
         incarnation: a replacement master welcomes it normally, and a master
@@ -705,23 +1284,85 @@ class NodeProcess:
                 self.node_id if self.node_id is not None else -1,
                 self.incarnation,
             )
+            candidates = [self.seed] + [
+                s for s in self.standbys if s != self.seed
+            ]
+            lap = 0
             while not self._welcomed.is_set() and not self._shutdown.is_set():
-                await self.transport.send(Envelope("master", join))
-                await asyncio.sleep(self.join_retry_s)
+                target = candidates[lap % len(candidates)]
+                if lap > 0 and len(candidates) > 1:
+                    _WALKS.inc()
+                    _flight.note(
+                        "failover", event="walk", node=self.node_id,
+                        endpoint=str(target),
+                    )
+                    log.info(
+                        "node %s: walking to candidate master %s",
+                        self.node_id, target,
+                    )
+                self._point_master(target)
+                for _ in range(max(1, self.failover_walk_attempts)):
+                    if self._welcomed.is_set() or self._shutdown.is_set():
+                        break
+                    await self.transport.send(Envelope("master", join))
+                    await asyncio.sleep(self.join_retry_s)
+                lap += 1
         finally:
             self._rejoining = False
             self._master_send_failures = 0
 
+    def _fenced(self, msg: Any) -> bool:
+        """True when ``msg`` carries a leadership epoch OLDER than the one
+        that welcomed us — a zombie deposed master still sending after a
+        failover. The fence is the split-brain guarantee: whatever the old
+        leader still believes, its round triggers, address books and
+        shutdowns no longer move this node (RESILIENCE.md "Tier 4")."""
+        epoch = getattr(msg, "epoch", None)
+        if isinstance(epoch, int) and 0 <= epoch < self.master_epoch:
+            _FENCED.inc()
+            _flight.note(
+                "failover", event="fenced", node=self.node_id,
+                msg=type(msg).__name__, epoch=epoch,
+                current=self.master_epoch,
+            )
+            log.info(
+                "node %s: fenced stale-epoch %d %s (current epoch %d)",
+                self.node_id, epoch, type(msg).__name__, self.master_epoch,
+            )
+            return True
+        return False
+
     def _on_cluster_msg(self, msg: Any) -> list[Envelope]:
-        self._master_send_failures = 0  # the master is talking to us
+        # Welcome is EXEMPT from the fence: a node actively (re)joining has
+        # abandoned its cluster state and follows WHOEVER admits it — an
+        # operator-restarted replacement master legitimately starts at
+        # epoch 1 again, and strict ratcheting would fence it out forever
+        # once any failover had happened. A zombie that admits a walking
+        # node is only a transient capture: it is stood down through its
+        # own digest stream, the node's sends fail again, and the next walk
+        # lands at the live leader. A node that is already settled ignores
+        # stray Welcomes via the _welcomed guard below.
         if isinstance(msg, cl.Welcome):
+            self._master_send_failures = 0
             return self._on_welcome(msg)
+        if self._fenced(msg):
+            return []  # a zombie master talking must not reset anything
+        self._master_send_failures = 0  # the master is talking to us
         if isinstance(msg, cl.AddressBook):
             self.book = msg
             self._endpoints = {
                 nid: cl.Endpoint(host, port) for nid, host, port in msg.entries
             }
+            # a standby registering mid-run reaches us here (Welcome only
+            # covers the join); the walk order follows the leader's list
+            self.standbys = [
+                cl.Endpoint(h, p) for h, p in msg.standbys
+            ]
             return []
+        if isinstance(msg, st.AdvertSolicit):
+            # a (replacement) master wants to know what this disk holds —
+            # re-advertise everything without waiting for a full rejoin
+            return self._advert_envelopes()
         if isinstance(msg, cl.Shutdown):
             self.shutdown_reason = msg.reason
             self._shutdown.set()
@@ -749,6 +1390,13 @@ class NodeProcess:
         if self._heartbeat_task is not None:  # re-welcome after master loss
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
+        # the fencing watermark tracks the CURRENT leader (not a max over
+        # history): fencing protects a SETTLED node from masters older
+        # than the one it follows — a fresh admission re-bases it, so an
+        # epoch-1 replacement after a crashed epoch-2 leader still works
+        prev_epoch = self.master_epoch
+        self.master_epoch = msg.epoch
+        self.standbys = [cl.Endpoint(h, p) for h, p in msg.standbys]
         self.config = AllreduceConfig.from_json(msg.config_json)
         # the wire-compression knob arrives with the config, like every
         # other knob: payloads we send from now on ride at the configured
@@ -798,12 +1446,33 @@ class NodeProcess:
             self.config.metadata,
             self.config.threshold,
             self.config.worker,
+            # cross-epoch round dedup: the rounds the PREVIOUS instance's
+            # workers already flushed stay flushed — a SUCCESSOR epoch
+            # re-issuing one of those round ids (stale digest) gets a
+            # CompleteAllreduce re-assert, never a second application.
+            # Carried ONLY when the welcoming epoch is strictly newer: a
+            # promoted standby continues the dead leader's numbering (the
+            # overlap is real), but a from-scratch replacement master
+            # (equal or lower epoch) legitimately RE-NUMBERS from 0 — a
+            # carried floor there would turn this node into a silent
+            # yes-asserter for thousands of rounds it never ran. Within
+            # one live master's lineage round numbers never regress, so
+            # dropping the floor on an equal-epoch re-welcome is safe.
+            flush_floors=(
+                self.node.flush_floors()
+                if self.node is not None and msg.epoch > prev_epoch
+                else None
+            ),
         )
         for dim in range(dims):
             wid = msg.node_id * dims + dim
             self.transport.register(
                 f"worker:{wid}",
-                lambda m, _wid=wid: self.node.handle(_wid, m),
+                # worker traffic is fenced too: a deposed master's
+                # Prepare/StartAllreduce must not reconfigure or trigger us
+                lambda m, _wid=wid: (
+                    [] if self._fenced(m) else self.node.handle(_wid, m)
+                ),
             )
         self.transport.register_prefix(
             "node", lambda _nid, m: self._on_cluster_msg(m)
@@ -827,27 +1496,7 @@ class NodeProcess:
             # it holds — our OWN state and any replica holdings — so the
             # master's holder map (wiped of our old incarnation's entries)
             # re-learns what actually survived on this disk
-            latest = self._chunk_store.latest()
-            if latest is not None:
-                out.append(
-                    Envelope(
-                        "master",
-                        st.CheckpointAdvert(
-                            msg.node_id, msg.node_id, latest[0], latest[1]
-                        ),
-                    )
-                )
-            for origin in sorted(self._chunk_store.replica_origins()):
-                held = self._chunk_store.latest(origin)
-                if held is not None:
-                    out.append(
-                        Envelope(
-                            "master",
-                            st.CheckpointAdvert(
-                                msg.node_id, origin, held[0], held[1]
-                            ),
-                        )
-                    )
+            out.extend(self._advert_envelopes())
         interval = self.config.master.heartbeat_interval_s
         self._heartbeat_task = observed_task(
             run_periodic(interval, self._send_heartbeat),
@@ -858,6 +1507,35 @@ class NodeProcess:
         return out
 
     # -- peer state transfer ---------------------------------------------------
+
+    def _advert_envelopes(self) -> list[Envelope]:
+        """CheckpointAdverts for everything this node's disk holds — its
+        OWN state and any replica holdings. Rides every Welcome, and is
+        re-sent on demand when a (replacement) master solicits
+        (``st.AdvertSolicit``) so an empty holder registry repopulates
+        without waiting for rejoin churn."""
+        if self.state is None or self._chunk_store is None:
+            return []
+        out: list[Envelope] = []
+        nid = self.state.node_id
+        latest = self._chunk_store.latest()
+        if latest is not None:
+            out.append(
+                Envelope(
+                    "master",
+                    st.CheckpointAdvert(nid, nid, latest[0], latest[1]),
+                )
+            )
+        for origin in sorted(self._chunk_store.replica_origins()):
+            held = self._chunk_store.latest(origin)
+            if held is not None:
+                out.append(
+                    Envelope(
+                        "master",
+                        st.CheckpointAdvert(nid, origin, held[0], held[1]),
+                    )
+                )
+        return out
 
     @staticmethod
     def _manifest_leaves(manifest_json: str) -> dict:
@@ -927,6 +1605,42 @@ class NodeProcess:
         t0 = time.perf_counter()
         reply = await self.state.request_manifest()
         latest = self._chunk_store.latest()
+        if latest is None and (reply is None or reply.step < 0):
+            # nothing local AND the master knows nothing: a REPLACEMENT
+            # master's holder registry starts empty, and our request just
+            # made it solicit adverts from every live member — patience
+            # (one heartbeat interval per round) converges on the
+            # re-advertised holders instead of abandoning live peer state.
+            # But patience is bounded by EVIDENCE, not just rounds: on a
+            # genuinely fresh cluster the master keeps ANSWERING "nothing
+            # known" — after a few explicit misses (each of which already
+            # triggered a solicit round-trip) we stop stalling the caller
+            # (the cluster-node role gates its first SAVE on this decision,
+            # and a long blind wait can push the first checkpoint past an
+            # early failure). Silence (no answer at all) keeps the full
+            # retry budget: that is a master still coming up.
+            interval = (
+                self.config.master.heartbeat_interval_s
+                if self.config is not None
+                else 0.5
+            )
+            explicit_misses = 1 if reply is not None else 0
+            members_seen = len(self._endpoints)
+            for _ in range(max(1, rounds)):
+                if explicit_misses >= 3:
+                    break
+                await asyncio.sleep(interval)
+                if len(self._endpoints) != members_seen:
+                    # membership is still converging on the (replacement)
+                    # master — every rejoin may bring a holder's adverts,
+                    # so visible progress resets the miss budget
+                    members_seen = len(self._endpoints)
+                    explicit_misses = 0
+                reply = await self.state.request_manifest()
+                if reply is not None and reply.step >= 0:
+                    break
+                if reply is not None:
+                    explicit_misses += 1
         known_step = reply.step if reply is not None else -1
         if latest is not None and latest[0] >= known_step:
             stats = {

@@ -66,6 +66,7 @@ __all__ = [
     "FaultSpec",
     "parse_spec",
     "membership_schedule",
+    "leader_kill_step",
 ]
 
 #: role value of the master process (nodes use their node id >= 0)
@@ -209,12 +210,11 @@ def parse_spec(spec: str) -> list[FaultSpec]:
             raise ValueError("partition requires groups=")
         if name in ("stall", "crash") and f.node is None:
             raise ValueError(f"{name} requires node=")
-        if name == "crash" and f.node == MASTER_ROLE:
-            # the master never arms allow_crash (killing the scheduler is
-            # the replacement-master protocol's territory, tested via
-            # test_master_restart_recovery) — accepting node=m here would
-            # log crash events that can never happen
-            raise ValueError("crash:node=m is not supported (nodes only)")
+        # crash:node=m is allowed since the master-HA PR: a real
+        # cluster-master process arms allow_crash, and the warm-standby
+        # failover protocol is exactly what absorbs the kill (the
+        # chaos-failover drill). In-process masters keep allow_crash off
+        # and record a suppressed crash, like nodes always did.
         if name == "crash" and f.at == ("round", 0.0):
             # round triggers arm only after a round BELOW the trigger is
             # observed (so a rejoined process cannot re-fire a past crash);
@@ -539,3 +539,17 @@ def membership_schedule(
             else:
                 step += 1
     return {s: frozenset(v) for s, v in silent.items()}
+
+
+def leader_kill_step(seed: int, steps: int) -> int | None:
+    """Seeded step at which the soak's simulated control-plane leader dies
+    (the leader-kill entry of ``soak --chaos SEED``'s schedule).
+
+    A pure function of its arguments — the same seed replays the same
+    kill. Lands in the middle 40-60% of the run so checkpoint and
+    membership churn exist on both sides of the failover; ``None`` for
+    runs too short to fit a leaderless window plus recovery."""
+    if steps < 20:
+        return None
+    rng = random.Random(_derive_seed(seed, MASTER_ROLE, 1, "leader_kill"))
+    return int(steps * (0.4 + 0.2 * rng.random()))

@@ -64,10 +64,22 @@ class Welcome:
     (``AllreduceConfig.to_json``) so every node runs identical geometry and
     thresholds — the reference distributes the same knobs via
     ``application.conf`` on each JVM.
+
+    ``epoch`` is the welcoming master's leadership epoch: the node records
+    it as its fencing watermark (messages from older epochs are dropped —
+    RESILIENCE.md "Tier 4"). ``standbys`` is the warm-standby endpoint list
+    the node walks when the leader stops answering.
     """
 
     node_id: int
     config_json: str
+    epoch: int = 0
+    standbys: tuple[tuple[str, int], ...] = ()
+
+    def __post_init__(self) -> None:
+        object.__setattr__(
+            self, "standbys", tuple((h, int(p)) for h, p in self.standbys)
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,6 +108,7 @@ class Rejoin:
     receives heartbeats from nodes of its predecessor."""
 
     reason: str = "unknown-node"
+    epoch: int = -1  # sender's leadership epoch (-1 = unfenced)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,13 +121,24 @@ class LeaveCluster:
 @dataclasses.dataclass(frozen=True)
 class AddressBook:
     """Master -> all nodes: node id -> endpoint map after every membership
-    change, so workers can dial their current peers."""
+    change, so workers can dial their current peers.
+
+    Carries the sender's leadership ``epoch`` (fencing: a zombie master's
+    stale book must not overwrite the new leader's) and the current
+    ``standbys`` list, so nodes that joined before a standby registered
+    still learn where to walk on leader loss.
+    """
 
     entries: tuple[tuple[int, str, int], ...]  # (node_id, host, port)
+    epoch: int = -1
+    standbys: tuple[tuple[str, int], ...] = ()
 
     def __post_init__(self) -> None:
         object.__setattr__(
             self, "entries", tuple(tuple(e) for e in self.entries)
+        )
+        object.__setattr__(
+            self, "standbys", tuple((h, int(p)) for h, p in self.standbys)
         )
 
     def endpoint_of(self, node_id: int) -> Endpoint | None:
@@ -126,6 +150,49 @@ class AddressBook:
 
 @dataclasses.dataclass(frozen=True)
 class Shutdown:
-    """Master -> all nodes: the run is over (max_rounds reached); exit."""
+    """Master -> all nodes: the run is over (max_rounds reached); exit.
+
+    Also master -> master: a promoted standby answers a fenced zombie
+    leader's digests with ``Shutdown("superseded-epoch")`` so the zombie
+    stands down instead of scheduling into the void forever.
+    """
 
     reason: str = "done"
+    epoch: int = -1  # sender's leadership epoch (-1 = unfenced)
+
+
+@dataclasses.dataclass(frozen=True)
+class StandbyRegister:
+    """Standby master -> leader: replicate your control-plane state to me.
+
+    ``host``/``port`` is the standby's own server endpoint — the leader
+    records it, distributes it to nodes (``Welcome``/``AddressBook``
+    ``standbys``), and starts piggybacking :class:`StateDigest` after every
+    state-changing event. Registration is idempotent and periodically
+    re-sent, so a restarted leader re-learns its standbys.
+    """
+
+    host: str
+    port: int
+
+
+@dataclasses.dataclass(frozen=True)
+class StateDigest:
+    """Leader -> standby: the compact replicated control-plane state.
+
+    Everything a warm standby needs to take over as master: membership
+    (address book + incarnations + unreachable set), the round counters
+    (next round / completed budget / config id), the peer-checkpoint
+    holder registry, and the full cluster config (so chaos + retry knobs
+    survive failover). Doubles as the leader's lease heartbeat: the
+    standby's phi detector expires on digest silence and the standby takes
+    over by bumping ``epoch``. ``host``/``port`` is the leader's endpoint,
+    so a promoted standby can fence a still-digesting zombie leader with
+    ``Shutdown("superseded-epoch")``.
+    """
+
+    epoch: int
+    seq: int
+    host: str
+    port: int
+    state_json: str

@@ -104,6 +104,51 @@ class PhiAccrualFailureDetector:
         return self.phi(node_id, now) < self.threshold
 
 
+class LeaderLease:
+    """Standby-side lease on the leader, fed by ``StateDigest`` arrivals.
+
+    The phi detector with a single pseudo-member (the leader): ``renew``
+    on every digest, and ``expired`` once suspicion crosses the threshold
+    — the takeover trigger of the master-HA protocol (RESILIENCE.md
+    "Tier 4"). A standby that never received a digest can NOT expire the
+    lease: it cannot distinguish "leader dead" from "my registration never
+    landed", so it keeps re-registering instead of seizing an epoch whose
+    state it does not hold.
+    """
+
+    _LEADER = -1  # MASTER_ROLE: the only member this detector tracks
+
+    def __init__(
+        self,
+        *,
+        threshold: float = 8.0,
+        first_heartbeat_estimate: float = 1.0,
+    ) -> None:
+        self.detector = PhiAccrualFailureDetector(
+            threshold=threshold,
+            first_heartbeat_estimate=first_heartbeat_estimate,
+        )
+        self.renewals = 0
+
+    def renew(self, now: float) -> None:
+        self.detector.heartbeat(self._LEADER, now)
+        self.renewals += 1
+
+    def phi(self, now: float) -> float:
+        return self.detector.phi(self._LEADER, now)
+
+    def expired(self, now: float) -> bool:
+        return self.renewals > 0 and not self.detector.is_available(
+            self._LEADER, now
+        )
+
+    def reset(self) -> None:
+        """Forget the lease history (a fresh leader identity: its digest
+        cadence must not inherit the dead leader's inter-arrival model)."""
+        self.detector.remove(self._LEADER)
+        self.renewals = 0
+
+
 class HeartbeatMonitor:
     """Edge-triggered membership tracking on top of the phi detector.
 

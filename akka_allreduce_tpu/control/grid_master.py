@@ -50,6 +50,7 @@ class GridMaster:
         on_round_complete=None,  # LineMaster RoundObserver, fanned to all lines
         on_round_start=None,  # LineMaster RoundStartObserver, same fan-out
         on_reorganize=None,  # called when a reorganization replaces the lines
+        epoch: int = -1,  # leadership epoch stamped onto Prepare/Start
     ) -> None:
         self.threshold = threshold
         self.config = config
@@ -57,6 +58,7 @@ class GridMaster:
         self.on_round_complete = on_round_complete
         self.on_round_start = on_round_start
         self.on_reorganize = on_reorganize
+        self.epoch = epoch
         self.nodes: set[int] = set()
         self.config_id = 0
         self.organized = False
@@ -176,6 +178,7 @@ class GridMaster:
                 line_id=line_id,
                 on_round_complete=self.on_round_complete,
                 on_round_start=self.on_round_start,
+                epoch=self.epoch,
             )
             self.line_masters[line_id] = lm
             for w in worker_ids:

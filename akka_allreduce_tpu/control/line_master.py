@@ -50,10 +50,14 @@ class LineMaster:
         clock: Callable[[], float] = time.monotonic,
         on_round_complete: RoundObserver | None = None,
         on_round_start: RoundStartObserver | None = None,
+        epoch: int = -1,
     ) -> None:
         self.threshold = threshold
         self.config = config
         self.line_id = line_id
+        # stamped onto every Prepare/Start so nodes can fence a zombie
+        # master's round triggers after a failover (-1 = unfenced)
+        self.epoch = epoch
         self.clock = clock
         self.on_round_complete = on_round_complete
         self.on_round_start = on_round_start
@@ -124,7 +128,7 @@ class LineMaster:
                 peer_addr(w),
                 PrepareAllreduce(
                     self.config_id, self.worker_ids, w, self.next_round,
-                    self.line_id,
+                    self.line_id, self.epoch,
                 ),
             )
             for w in workers
@@ -170,7 +174,7 @@ class LineMaster:
             span = self._round_spans.get(r)
             ctx = span.context if span is not None else None
             out.extend(
-                Envelope(peer_addr(w), StartAllreduce(r), trace=ctx)
+                Envelope(peer_addr(w), StartAllreduce(r, self.epoch), trace=ctx)
                 for w in pending
             )
         return out
@@ -344,7 +348,11 @@ class LineMaster:
             if self.on_round_start is not None:
                 self.on_round_start(self.line_id, r)
             out.extend(
-                Envelope(peer_addr(w), StartAllreduce(r), trace=span.context)
+                Envelope(
+                    peer_addr(w),
+                    StartAllreduce(r, self.epoch),
+                    trace=span.context,
+                )
                 for w in self.worker_ids
             )
         return out

@@ -51,6 +51,7 @@ class AllreduceNode:
         threshold: ThresholdConfig,
         worker_config: WorkerConfig = WorkerConfig(),
         stash_window: int = 8,
+        flush_floors: dict[int, int] | None = None,
     ) -> None:
         if dims not in (1, 2):
             raise ValueError(f"dims must be 1 or 2, got {dims}")
@@ -81,6 +82,18 @@ class AllreduceNode:
             w1.configure(chain_meta, threshold)
             self.workers[0] = w0
             self.workers[1] = w1
+        # the cross-epoch dedup floor survives node rebuilds: a rejoin (or
+        # master failover) constructs a fresh AllreduceNode, but the rounds
+        # the OLD instance's workers already flushed must stay flushed —
+        # pass flush_floors() of the instance being replaced
+        for dim, floor in (flush_floors or {}).items():
+            if dim in self.workers:
+                self.workers[dim].flushed_up_to = floor
+
+    def flush_floors(self) -> dict[int, int]:
+        """Per-dimension highest flushed round — hand to the replacement
+        AllreduceNode so re-issued round ids dedup across rebuilds."""
+        return {dim: w.flushed_up_to for dim, w in self.workers.items()}
 
     # -- chain plumbing (dims == 2) -----------------------------------------
 

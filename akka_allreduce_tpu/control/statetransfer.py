@@ -187,6 +187,21 @@ class ReplicaManifest:
     origin: int
 
 
+@dataclasses.dataclass(frozen=True)
+class AdvertSolicit:
+    """Master -> node: re-send your :class:`CheckpointAdvert`\\ s now.
+
+    A replacement master binds the seed endpoint with an EMPTY holder
+    registry; until nodes happen to re-advertise (which normally rides the
+    rejoin Welcome) it would answer ``ManifestRequest`` with a dead end.
+    The master therefore solicits adverts on first contact with an unknown
+    node and whenever a manifest request finds no live holder — so a
+    restore issued immediately after a master restart still converges on
+    the surviving replicas (RESILIENCE.md "Tier 4")."""
+
+    reason: str = ""
+
+
 # -- content hashing (ONE definition; train/checkpoint.py imports these) -------
 
 

@@ -61,6 +61,13 @@ _TAGS: dict[type, int] = {
     st.ChunkData: 18,
     st.ChunkMissing: 19,
     st.ReplicaManifest: 20,
+    # master high availability (RESILIENCE.md "Tier 4 — control-plane
+    # failover"): standby registration, the leader's replicated state
+    # digest (doubles as its lease heartbeat), and the replacement
+    # master's checkpoint-advert solicitation
+    cl.StandbyRegister: 21,
+    cl.StateDigest: 22,
+    st.AdvertSolicit: 23,
 }
 
 _U16 = struct.Struct("<H")
@@ -89,6 +96,19 @@ def _unpack_str32(buf: memoryview, off: int) -> tuple[str, int]:
     (n,) = _U32.unpack_from(buf, off)
     off += 4
     return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+
+def _unpack_endpoints(
+    buf: memoryview, off: int, n: int
+) -> tuple[tuple[tuple[str, int], ...], int]:
+    """``n`` consecutive ``[str host][u16 port]`` pairs (standby lists)."""
+    out = []
+    for _ in range(n):
+        host, off = _unpack_str(buf, off)
+        (port,) = _U16.unpack_from(buf, off)
+        off += 2
+        out.append((host, port))
+    return tuple(out), off
 
 
 def _chunk_payload_view(payload) -> memoryview:
@@ -187,7 +207,7 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
         raise TypeError(f"no wire tag for {type(msg).__name__}")
     head = bytes([tag])
     if tag == 1:
-        return [head, struct.pack("<q", msg.round_num)]
+        return [head, struct.pack("<qq", msg.round_num, msg.epoch)]
     if tag == 2:
         payload, count_word = _pack_floats(msg.value, f16)
         head = native.pack_block_header(
@@ -206,16 +226,19 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
         return [head, struct.pack("<iq", msg.src_id, msg.round_num)]
     if tag == 5:
         peers = msg.peer_ids
+        # epoch rides AFTER the peer list so the variable-length tail stays
+        # where every decoder expects it
         return [
             head,
             struct.pack(
-                f"<qiqiH{len(peers)}i",
+                f"<qiqiH{len(peers)}iq",
                 msg.config_id,
                 msg.worker_id,
                 msg.round_num,
                 msg.line_id,
                 len(peers),
                 *peers,
+                msg.epoch,
             ),
         ]
     if tag == 6:
@@ -227,7 +250,15 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
             struct.pack("<Hiq", msg.port, msg.preferred_node_id, msg.incarnation),
         ]
     if tag == 8:
-        return [head, struct.pack("<i", msg.node_id), _pack_str(msg.config_json)]
+        parts = [
+            head,
+            struct.pack("<i", msg.node_id),
+            _pack_str(msg.config_json),
+            struct.pack("<qH", msg.epoch, len(msg.standbys)),
+        ]
+        for h, p in msg.standbys:
+            parts.append(_pack_str(h) + _U16.pack(p))
+        return parts
     if tag == 9:
         return [
             head,
@@ -241,11 +272,14 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
         parts = [head, _U16.pack(len(msg.entries))]
         for nid, host, port in msg.entries:
             parts.append(struct.pack("<i", nid) + _pack_str(host) + _U16.pack(port))
+        parts.append(struct.pack("<qH", msg.epoch, len(msg.standbys)))
+        for h, p in msg.standbys:
+            parts.append(_pack_str(h) + _U16.pack(p))
         return parts
     if tag == 12:
-        return [head, _pack_str(msg.reason)]
+        return [head, _pack_str(msg.reason), struct.pack("<q", msg.epoch)]
     if tag == 13:
-        return [head, _pack_str(msg.reason)]
+        return [head, _pack_str(msg.reason), struct.pack("<q", msg.epoch)]
     if tag == 14:
         return [
             head,
@@ -285,6 +319,20 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
             struct.pack("<qi", msg.step, msg.origin),
             _pack_str32(msg.manifest_json),
         ]
+    if tag == 21:
+        return [head, _pack_str(msg.host), _U16.pack(msg.port)]
+    if tag == 22:
+        return [
+            head,
+            struct.pack("<qq", msg.epoch, msg.seq),
+            _pack_str(msg.host),
+            _U16.pack(msg.port),
+            # the digest body routinely exceeds the u16 string bound (it
+            # embeds the full config plus the ckpt manifest registry)
+            _pack_str32(msg.state_json),
+        ]
+    if tag == 23:
+        return [head, _pack_str(msg.reason)]
     raise AssertionError(f"unhandled tag {tag}")
 
 
@@ -294,7 +342,7 @@ def decode(data: bytes | memoryview) -> Any:
     tag = buf[0]
     off = 1
     if tag == 1:
-        return StartAllreduce(*struct.unpack_from("<q", buf, off))
+        return StartAllreduce(*struct.unpack_from("<qq", buf, off))
     if tag == 2:
         value, src, dest, chunk, rnd, _ = _decode_block(buf)
         return ScatterBlock(value, src, dest, chunk, rnd)
@@ -308,7 +356,10 @@ def decode(data: bytes | memoryview) -> Any:
             "<qiqiH", buf, off
         )
         peers = struct.unpack_from(f"<{n}i", buf, off + 26)
-        return PrepareAllreduce(config_id, peers, worker_id, round_num, line_id)
+        (epoch,) = struct.unpack_from("<q", buf, off + 26 + 4 * n)
+        return PrepareAllreduce(
+            config_id, peers, worker_id, round_num, line_id, epoch
+        )
     if tag == 6:
         return ConfirmPreparation(*struct.unpack_from("<qi", buf, off))
     if tag == 7:
@@ -317,8 +368,10 @@ def decode(data: bytes | memoryview) -> Any:
         return cl.JoinCluster(host, port, preferred, incarnation)
     if tag == 8:
         (node_id,) = struct.unpack_from("<i", buf, off)
-        config_json, _ = _unpack_str(buf, off + 4)
-        return cl.Welcome(node_id, config_json)
+        config_json, off = _unpack_str(buf, off + 4)
+        epoch, n = struct.unpack_from("<qH", buf, off)
+        standbys, off = _unpack_endpoints(buf, off + 10, n)
+        return cl.Welcome(node_id, config_json, epoch, standbys)
     if tag == 9:
         node_id, incarnation = struct.unpack_from("<iq", buf, off)
         host, off = _unpack_str(buf, off + 12)
@@ -336,13 +389,15 @@ def decode(data: bytes | memoryview) -> Any:
             (port,) = _U16.unpack_from(buf, off)
             off += 2
             entries.append((nid, host, port))
-        return cl.AddressBook(tuple(entries))
+        epoch, n_standby = struct.unpack_from("<qH", buf, off)
+        standbys, off = _unpack_endpoints(buf, off + 10, n_standby)
+        return cl.AddressBook(tuple(entries), epoch, standbys)
     if tag == 12:
-        reason, _ = _unpack_str(buf, off)
-        return cl.Shutdown(reason)
+        reason, off = _unpack_str(buf, off)
+        return cl.Shutdown(reason, *struct.unpack_from("<q", buf, off))
     if tag == 13:
-        reason, _ = _unpack_str(buf, off)
-        return cl.Rejoin(reason)
+        reason, off = _unpack_str(buf, off)
+        return cl.Rejoin(reason, *struct.unpack_from("<q", buf, off))
     if tag == 14:
         node_id, origin, step = struct.unpack_from("<iiq", buf, off)
         manifest, _ = _unpack_str32(buf, off + 16)
@@ -381,6 +436,18 @@ def decode(data: bytes | memoryview) -> Any:
         step, origin = struct.unpack_from("<qi", buf, off)
         manifest, _ = _unpack_str32(buf, off + 12)
         return st.ReplicaManifest(step, manifest, origin)
+    if tag == 21:
+        host, off = _unpack_str(buf, off)
+        return cl.StandbyRegister(host, *_U16.unpack_from(buf, off))
+    if tag == 22:
+        epoch, seq = struct.unpack_from("<qq", buf, off)
+        host, off = _unpack_str(buf, off + 16)
+        (port,) = _U16.unpack_from(buf, off)
+        state_json, _ = _unpack_str32(buf, off + 2)
+        return cl.StateDigest(epoch, seq, host, port, state_json)
+    if tag == 23:
+        reason, _ = _unpack_str(buf, off)
+        return st.AdvertSolicit(reason)
     raise ValueError(f"unknown wire tag {tag}")
 
 

@@ -81,6 +81,14 @@ class AllreduceWorker:
         self.rounds: RoundBuffers | None = None
         self.completed_rounds = 0
         self.dropped_messages = 0
+        # highest round this worker ever FLUSHED to its sink — the
+        # cross-epoch dedup floor (RESILIENCE.md "Tier 4"): a replacement
+        # master restoring from a slightly stale digest may re-issue a
+        # round id this worker already applied; the floor turns that
+        # re-Start into a CompleteAllreduce re-assert instead of a second
+        # flush of the same round. Callers that rebuild the worker (a node
+        # rejoin) carry the value across instances via AllreduceNode.
+        self.flushed_up_to = -1
 
     # -- configuration -------------------------------------------------------
 
@@ -144,8 +152,11 @@ class AllreduceWorker:
             peer_size=len(msg.peer_ids),
             window=self.config.round_window,
         )
-        # resume numbering where the master says (late joiner / re-mesh)
-        self.rounds.completed_up_to = msg.round_num - 1
+        # resume numbering where the master says (late joiner / re-mesh) —
+        # floored at the rounds this worker already flushed, so a new
+        # master epoch resuming from a stale digest can never make us
+        # apply a round twice (its re-Start gets a re-assert instead)
+        self.rounds.completed_up_to = max(msg.round_num - 1, self.flushed_up_to)
         log.info(
             "worker %s prepared: config=%d peers=%s from round %d",
             self.worker_id,
@@ -290,6 +301,7 @@ class AllreduceWorker:
             data, counts = buf.get_with_counts(copy=False)
             rounds.complete(r)  # evicts this round AND abandons older ones
             self.completed_rounds += 1
+            self.flushed_up_to = max(self.flushed_up_to, r)
             self.data_sink(AllReduceOutput(data, counts, r))
         _ROUNDS_COMPLETED.inc()
         obs_flight.set_state("worker.last_completed_round", r)

@@ -30,9 +30,15 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class StartAllreduce:
-    """LineMaster -> worker: begin round ``round_num``."""
+    """LineMaster -> worker: begin round ``round_num``.
+
+    ``epoch`` is the issuing master's leadership epoch (RESILIENCE.md
+    "Tier 4"): after a failover, nodes reject round triggers from a fenced
+    zombie leader. ``-1`` = unfenced (in-process systems, tests).
+    """
 
     round_num: int
+    epoch: int = -1
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -95,6 +101,9 @@ class PrepareAllreduce:
     # where CompleteAllreduce/ConfirmPreparation go. The reference's workers
     # reply to the sending actor; explicit handlers need the address spelled out.
     line_id: int = 0
+    # issuing master's leadership epoch (-1 = unfenced); a node that has
+    # joined a newer master drops configuration attempts from the old one
+    epoch: int = -1
 
     def __post_init__(self) -> None:
         object.__setattr__(self, "peer_ids", tuple(self.peer_ids))

@@ -157,14 +157,24 @@ def run_soak(
         )
 
     silent_plan = None
+    leader_kill = None
+    # steps the simulated control plane is LEADERLESS after the kill (the
+    # lease window): the detector dies with the leader — no polls, no
+    # expulsions — then the standby's takeover re-meshes everyone
+    failover_steps = 3
     if chaos_seed is not None:
-        from akka_allreduce_tpu.control.chaos import membership_schedule
+        from akka_allreduce_tpu.control.chaos import (
+            leader_kill_step,
+            membership_schedule,
+        )
 
         silent_plan = membership_schedule(chaos_seed, nodes, steps)
+        leader_kill = leader_kill_step(chaos_seed, steps)
     elastic = ElasticTrainer(factory, assignment, clock=lambda: now["t"])
     churn = (
         f"chaos seed {chaos_seed} "
-        f"({sum(len(v) for v in silent_plan.values())} node-step silences)"
+        f"({sum(len(v) for v in silent_plan.values())} node-step silences, "
+        f"leader kill@{leader_kill})"
         if silent_plan is not None
         else f"drop@{drop_at} rejoin@{rejoin_at}"
     )
@@ -260,7 +270,23 @@ def run_soak(
         now["t"] += 1.0
         t0 = time.perf_counter()
         members_before = len(elastic.member_nodes)
-        remeshed = elastic.poll()
+        forced_kind = None
+        if (
+            leader_kill is not None
+            and leader_kill <= step < leader_kill + failover_steps
+        ):
+            # leaderless window: the failure detector died WITH the leader,
+            # so nobody polls and nobody is expelled (the warm standby
+            # carries the membership state — nothing is forgotten)
+            remeshed = False
+        elif leader_kill is not None and step == leader_kill + failover_steps:
+            # the standby's lease expired and it took over: every node
+            # re-joins the new leader -> one full re-mesh with unchanged
+            # membership (the in-process analog of the TCP failover walk)
+            remeshed = elastic.remesh("leader_failover")
+            forced_kind = "leader_failover"
+        else:
+            remeshed = elastic.poll()
         x, y = batch(step)
         m = elastic.train_step(x, y)
         dt = time.perf_counter() - t0
@@ -268,7 +294,7 @@ def run_soak(
             # kind from the authoritative membership delta, not the step
             # index (phi detection lags the induced silence by a few
             # heartbeats)
-            kind = (
+            kind = forced_kind or (
                 "drop"
                 if len(elastic.member_nodes) < members_before
                 else "rejoin"

@@ -150,6 +150,24 @@ class ElasticTrainer:
         snap.restore_into(self.trainer)
         return True
 
+    def remesh(self, reason: str = "forced") -> bool:
+        """Force a re-mesh with UNCHANGED membership: snapshot -> rebuild
+        -> restore, generation bump. The in-process analog of every node
+        re-joining a promoted standby master after a leader failover (the
+        soak's leader-kill schedule entry): membership SURVIVED — the warm
+        standby carried it in the state digest — but the whole cluster
+        still re-runs the Prepare handshake under the new leader's epoch,
+        which on the XLA side is a full re-jit."""
+        log.info(
+            "re-mesh (%s): members %s unchanged (generation %d -> %d)",
+            reason, self.member_nodes, self.generation, self.generation + 1,
+        )
+        snap = Snapshot.capture(self.trainer)
+        self.generation += 1
+        self.trainer = self._build_trainer()
+        snap.restore_into(self.trainer)
+        return True
+
     # -- training ------------------------------------------------------------
 
     @property

@@ -86,13 +86,25 @@ def test_parse_spec_full_grammar():
         "partition:groups=m+x|1",  # non-numeric member
         "drop:q=1",  # unknown param
         "crash:node=1,at=soon",  # unparseable trigger
-        "crash:node=m,at=1s",  # master crash is not injectable
         "crash:node=1,at=round0",  # round triggers arm from below; round0 can't
     ],
 )
 def test_parse_spec_rejects_malformed(bad):
     with pytest.raises(ValueError):
         parse_spec(bad)
+
+
+def test_parse_spec_accepts_master_crash():
+    """crash:node=m is injectable since the master-HA PR: the CLI master
+    role arms allow_crash and the warm-standby failover protocol absorbs
+    the kill (`make chaos-failover`). In-process masters still suppress."""
+    (f,) = parse_spec("crash:node=m,at=round8")
+    assert f.node == MASTER_ROLE and f.at == ("round", 8.0)
+    inj = ChaosInjector(
+        1, "crash:node=m,at=0s", role=MASTER_ROLE, clock=lambda: 1.0
+    )
+    inj.plan_send(Envelope("node:0", cl.Shutdown("x")))
+    assert inj.crashes_suppressed == 1  # allow_crash off: recorded, not run
 
 
 # --- determinism (tier-1 ratchet) ---------------------------------------------
@@ -191,12 +203,13 @@ def test_membership_schedule_is_deterministic_and_keeps_a_survivor():
 def test_chaos_introduces_no_new_wire_tags():
     """Design pin (and the WIRE001 satellite): chaos configuration rides
     Welcome's config JSON — chaos itself contributes ZERO wire tags. The
-    full surface is now 1-20 (14-20 are PR 6's peer state transfer,
-    control/statetransfer.py — every one round-tripped in
-    test_wire_roundtrip.py); a new chaos control message must update this
-    test, the codec arms, and a dispatch site together (WIRE001 enforces
-    the rest)."""
-    assert sorted(wire._TAGS.values()) == list(range(1, 21))
+    full surface is now 1-23 (14-20 are PR 6's peer state transfer; 21-23
+    are the master-HA failover tags — StandbyRegister/StateDigest in
+    control/cluster.py, AdvertSolicit in control/statetransfer.py — every
+    one round-tripped in test_wire_roundtrip.py); a new chaos control
+    message must update this test, the codec arms, and a dispatch site
+    together (WIRE001 enforces the rest)."""
+    assert sorted(wire._TAGS.values()) == list(range(1, 24))
     from akka_allreduce_tpu.control import chaos as chaos_mod
     from akka_allreduce_tpu.control import statetransfer as st_mod
 
@@ -204,7 +217,7 @@ def test_chaos_introduces_no_new_wire_tags():
         assert cls.__module__ != chaos_mod.__name__
     assert sum(
         1 for cls in wire._TAGS if cls.__module__ == st_mod.__name__
-    ) == 7
+    ) == 8
     cfg = AllreduceConfig(chaos=ChaosConfig(seed=9, spec="drop:p=0.5"))
     roundtrip = AllreduceConfig.from_json(cfg.to_json())
     assert roundtrip.chaos == ChaosConfig(seed=9, spec="drop:p=0.5")

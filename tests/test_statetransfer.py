@@ -319,9 +319,17 @@ def test_master_registry_and_manifest_reply():
         master.unreachable.add(0)
         (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
         assert reply_env.msg.holders == (1,)
-        # unknown origin: explicit "nothing known"
-        (none_env,) = master._on_cluster_msg(st.ManifestRequest(9))
+        # unknown origin: explicit "nothing known" — PLUS an advert
+        # solicitation to every live member (the replacement-master
+        # registry-repopulation path, master-HA PR): the requester's
+        # restore retry finds holders once the re-adverts land
+        none_env, *solicits = master._on_cluster_msg(st.ManifestRequest(9))
         assert none_env.msg.step == -1 and none_env.msg.holders == ()
+        assert all(isinstance(e.msg, st.AdvertSolicit) for e in solicits)
+        # every live member except the requester and the unreachable
+        assert sorted(e.dest for e in solicits) == [
+            "node:1", "node:2", "node:4",
+        ]
         # a new incarnation of node 1 drops node 1's stale holder entries;
         # with step 7 now unservable the master FALLS BACK to the newest
         # step that still has a live holder (the saved-but-never-replicated
@@ -336,7 +344,8 @@ def test_master_registry_and_manifest_reply():
         (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
         assert reply_env.msg.step == 3
         assert reply_env.msg.holders == (1, 4)  # live, minus unreachable 0
-        # nobody else alive at all: genuinely nothing to offer
+        # nobody else alive at all: genuinely nothing to offer (and nobody
+        # left to solicit)
         master.book = {2: master.book[2]}
         (reply_env,) = master._on_cluster_msg(st.ManifestRequest(2))
         assert reply_env.msg.step == -1 and reply_env.msg.holders == ()

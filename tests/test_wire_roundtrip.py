@@ -40,26 +40,35 @@ _CHUNK_BYTES = st.npy_bytes(_CHUNK_ARR)
 _CHUNK_SHA = st.leaf_sha(_CHUNK_ARR)
 _MANIFEST = '{"step": 5, "custom": false, "leaves": {"[\'a\']": "%s"}}' % _CHUNK_SHA
 
+# the failover tags (21-23) + the epoch/standby fields on older tags: every
+# value non-default so a dropped field cannot round-trip by luck
+_STANDBYS = (("10.0.0.3", 9001), ("10.0.0.4", 9002))
+_DIGEST_STATE = (
+    '{"book": [[0, "10.0.0.1", 7070]], "incarnations": {"0": 5},'
+    ' "round": {"next": 12, "completed": 9, "config_id": 3}}'
+)
+
 # one representative instance per wire type; every field non-default so a
 # dropped/reordered struct field cannot round-trip by luck
 _SAMPLES = {
-    StartAllreduce: StartAllreduce(round_num=41),
+    StartAllreduce: StartAllreduce(round_num=41, epoch=6),
     ScatterBlock: ScatterBlock(_PAYLOAD, 2, 1, 3, 17),
     ReduceBlock: ReduceBlock(_PAYLOAD * 2.0, 1, 0, 2, 18, 5),
     CompleteAllreduce: CompleteAllreduce(src_id=4, round_num=19),
     PrepareAllreduce: PrepareAllreduce(
-        config_id=7, peer_ids=(0, 1, 5), worker_id=5, round_num=20, line_id=2
+        config_id=7, peer_ids=(0, 1, 5), worker_id=5, round_num=20,
+        line_id=2, epoch=6,
     ),
     ConfirmPreparation: ConfirmPreparation(config_id=7, worker_id=3),
     cl.JoinCluster: cl.JoinCluster("10.0.0.9", 7171, 2, 12345),
-    cl.Welcome: cl.Welcome(3, '{"nodes": 4}'),
+    cl.Welcome: cl.Welcome(3, '{"nodes": 4}', 6, _STANDBYS),
     cl.Heartbeat: cl.Heartbeat(2, 99, "10.0.0.9", 7171),
     cl.LeaveCluster: cl.LeaveCluster(6),
     cl.AddressBook: cl.AddressBook(
-        ((0, "10.0.0.1", 7070), (1, "10.0.0.2", 7071))
+        ((0, "10.0.0.1", 7070), (1, "10.0.0.2", 7071)), 6, _STANDBYS
     ),
-    cl.Shutdown: cl.Shutdown("max-rounds"),
-    cl.Rejoin: cl.Rejoin("unknown-node"),
+    cl.Shutdown: cl.Shutdown("max-rounds", 6),
+    cl.Rejoin: cl.Rejoin("unknown-node", 6),
     # peer state transfer (tags 14-20): every field non-default, raw-buffer
     # payloads included, so a dropped struct field cannot round-trip by luck
     st.CheckpointAdvert: st.CheckpointAdvert(1, 2, 40, _MANIFEST),
@@ -69,6 +78,11 @@ _SAMPLES = {
     st.ChunkData: st.ChunkData(_CHUNK_SHA, _CHUNK_BYTES, 1, 40, True),
     st.ChunkMissing: st.ChunkMissing(_CHUNK_SHA, 4),
     st.ReplicaManifest: st.ReplicaManifest(40, _MANIFEST, 1),
+    # master HA (tags 21-23): standby registration, the leader's state
+    # digest (the warm-standby replication stream), advert solicitation
+    cl.StandbyRegister: cl.StandbyRegister("10.0.0.3", 9001),
+    cl.StateDigest: cl.StateDigest(6, 1234, "10.0.0.1", 7070, _DIGEST_STATE),
+    st.AdvertSolicit: st.AdvertSolicit("manifest-miss"),
 }
 
 
@@ -80,6 +94,8 @@ def _assert_equal(msg, back) -> None:
             assert bytes(memoryview(b)) == bytes(memoryview(a))
         elif isinstance(a, np.ndarray):
             np.testing.assert_array_equal(np.asarray(b, dtype=a.dtype), a)
+        elif field == "standbys":  # tuple-of-pairs (list/tuple agnostic)
+            assert tuple(map(tuple, b)) == tuple(map(tuple, a))
         elif field in ("peer_ids", "holders"):
             assert tuple(b) == tuple(a)
         else:
