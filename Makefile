@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo chaos chaos-recover chaos-failover
+.PHONY: lint lint-json baseline native test tier1 trace-demo chaos chaos-recover chaos-failover chaos-adapt
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -56,6 +56,16 @@ chaos-recover:
 chaos-failover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
 	  chaos-failover --seed 1234 --out-dir chaos_failover_run
+
+# fixed-seed adaptive-degradation drill (RESILIENCE.md "Tier 5"): a seeded
+# staged straggler (windowed targeted delay + a stall burst) slows one
+# node; the leader's AdaptiveController must degrade (lower th_reduce,
+# f16 -> int8 wire) within K rounds, hold without oscillation, restore to
+# full fidelity after the heal, and every node's reduced values (identical
+# payloads, --uniform-check) must stay within the EF error budget.
+chaos-adapt:
+	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
+	  chaos-adapt --seed 1234 --out-dir chaos_adapt_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -96,6 +96,57 @@ def _add_chaos_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_adapt_flags(p: argparse.ArgumentParser) -> None:
+    """Closed-loop adaptive degradation (control/adapt.py, RESILIENCE.md
+    'Tier 5'): the leader's per-round controller. Master-role flags only —
+    workers need no config, the policy rides every Prepare/Start."""
+    p.add_argument(
+        "--adapt", action="store_true",
+        help="enable the per-round adaptive controller: degrade th_reduce "
+        "and wire precision (f16 -> int8) when straggler evidence grows, "
+        "restore when the tail recovers",
+    )
+    p.add_argument(
+        "--adapt-floor", type=float, default=0.5,
+        help="th_reduce never degrades below this fraction",
+    )
+    p.add_argument(
+        "--adapt-window", type=int, default=8,
+        help="round completions per controller decision",
+    )
+    p.add_argument(
+        "--adapt-dwell", type=int, default=16,
+        help="minimum rounds at a level before the next transition "
+        "(the anti-flap hysteresis dwell)",
+    )
+    p.add_argument(
+        "--adapt-lag", type=int, default=12,
+        help="worker contribution lag (rounds) that triggers a degrade; "
+        "restore requires lag back under a third of this (min 1)",
+    )
+    p.add_argument(
+        "--adapt-log", default=None, metavar="FILE",
+        help="write the controller's decision log (JSONL, logical fields "
+        "only — same evidence replays the same bytes) here on exit",
+    )
+
+
+def _adapt_config_from(args):
+    from akka_allreduce_tpu.config import AdaptConfig
+
+    if not getattr(args, "adapt", False):
+        return AdaptConfig()
+    lag = max(2, args.adapt_lag)
+    return AdaptConfig(
+        enabled=True,
+        floor_th_reduce=args.adapt_floor,
+        window=args.adapt_window,
+        min_dwell=args.adapt_dwell,
+        lag_degrade=lag,
+        lag_restore=max(1, lag // 3),
+    )
+
+
 def _add_wire_dtype_flag(p: argparse.ArgumentParser) -> None:
     """TCP wire compression for the host data plane (cluster masters only —
     the knob is distributed to every node via Welcome)."""
@@ -963,6 +1014,7 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     )
     _add_wire_dtype_flag(p)
     _add_chaos_flags(p)
+    _add_adapt_flags(p)
     _add_obs_flags(p)
     args = p.parse_args(argv)
     from akka_allreduce_tpu.config import WorkerConfig
@@ -1030,6 +1082,7 @@ def _run_cluster_master(args) -> int:
         chaos=ChaosConfig(
             seed=getattr(args, "chaos_seed", 0), spec=chaos_spec
         ),
+        adapt=_adapt_config_from(args),
     )
     _install_obs(args)
 
@@ -1077,6 +1130,9 @@ def _run_cluster_master(args) -> int:
             if getattr(args, "chaos_log", None) and master.transport.chaos:
                 path = master.transport.chaos.write_log(args.chaos_log)
                 print(f"chaos event log: {path}", flush=True)
+            if getattr(args, "adapt_log", None) and master.adapt is not None:
+                path = master.adapt.write_log(args.adapt_log)
+                print(f"adapt decision log: {path}", flush=True)
             if metrics is not None:
                 from akka_allreduce_tpu.obs.metrics import REGISTRY
 
@@ -1127,6 +1183,14 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         "--replicas", type=int, default=2,
         help="how many peers each checkpoint is pushed to (K)",
     )
+    p.add_argument(
+        "--uniform-check", action="store_true",
+        help="assert-quality accounting for drills: with every node running "
+        "the SAME --data-seed, each round's reduced average must equal the "
+        "payload regardless of how many contributors made it — track the "
+        "max deviation (the wire-compression + EF error) and report it as "
+        "max_err= in the shutdown line (chaos-adapt's error-budget check)",
+    )
     _add_obs_flags(p)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -1144,7 +1208,7 @@ def _cmd_cluster_node(argv: list[str]) -> int:
 
     state = {"payload": None, "flushes": 0, "t0": None, "node": None,
              "save_task": None, "step_base": 0, "save_enabled": False,
-             "last_flush_round": -1, "dup_flushes": 0}
+             "last_flush_round": -1, "dup_flushes": 0, "max_err": 0.0}
 
     def source(req):
         if state["payload"] is None:
@@ -1162,6 +1226,19 @@ def _cmd_cluster_node(argv: list[str]) -> int:
             state["dup_flushes"] += 1
         else:
             state["last_flush_round"] = out.iteration
+        if args.uniform_check and state["payload"] is not None:
+            # identical payloads on every node => the true average IS the
+            # payload wherever at least one contribution landed; any
+            # deviation is wire-compression error (f16 rounding / int8
+            # quantization net of the EF carry) — the budget chaos-adapt
+            # asserts. O(size) numpy per flush, drill-scale only.
+            got = out.average()
+            mask = out.count > 0
+            if mask.any():
+                err = float(
+                    np.max(np.abs(got[mask] - state["payload"][mask]))
+                )
+                state["max_err"] = max(state["max_err"], err)
         node = state["node"]
         n = state["flushes"]
         if (
@@ -1260,9 +1337,13 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         from akka_allreduce_tpu import native as _native
 
         wire_path = "native" if _native.loaded() else "python"
+        err_note = (
+            f", max_err={state['max_err']:.6f}" if args.uniform_check else ""
+        )
         print(
             f"node {nid} shut down ({reason}): {state['flushes']} rounds, "
-            f"{mbs:.1f} MB/s reduced, dup_flushes={state['dup_flushes']}",
+            f"{mbs:.1f} MB/s reduced, dup_flushes={state['dup_flushes']}"
+            f"{err_note}",
             flush=True,
         )
         # wall decomposition (VERDICT r3 #9). Two views, different units:
@@ -3179,6 +3260,271 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
     return 0 if not failures else 1
 
 
+def _cmd_chaos_adapt(argv: list[str]) -> int:
+    """Adaptive-degradation drill (RESILIENCE.md "Tier 5", ISSUE 8
+    acceptance): a real master running the AdaptiveController + N nodes
+    with IDENTICAL payloads run an open-ended budget; a SEEDED staged
+    straggler (a windowed targeted ``delay`` + a ``stall`` burst inside
+    it) slows one node's sends. The controller must DEGRADE (lower
+    th_reduce, f16 -> int8 wire) within K rounds of the straggler's
+    onset, HOLD without oscillation (total mode transitions bounded),
+    RESTORE to full fidelity after the heal, and every node's reduced
+    values must stay within the EF error budget (identical payloads =>
+    the true average is the payload itself; ``--uniform-check`` measures
+    the deviation). ``make chaos-adapt`` runs the fixed-seed variant;
+    exit 0 iff every assertion holds."""
+    p = argparse.ArgumentParser(
+        "chaos-adapt",
+        description="seeded staged straggler; assert the adaptive "
+        "controller degrades, holds, restores, and stays inside the EF "
+        "error budget",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument(
+        "--straggle-at", type=int, default=30,
+        help="round at which the straggler's delay window opens",
+    )
+    p.add_argument(
+        "--heal-at", type=int, default=150,
+        help="round at which the straggler's delay window closes",
+    )
+    p.add_argument(
+        "--delay-ms", type=float, default=400.0,
+        help="the straggler's per-send hold inside the window",
+    )
+    p.add_argument(
+        "--stall-for", type=float, default=0.25,
+        help="layer a stall burst of this many seconds 20 rounds into the "
+        "straggle window (0 = delay only). The default stays under the "
+        "phi detector's expulsion point (~0.35s at heartbeat 0.1 with "
+        "min_std 0.05) — a slow-but-alive burst, which is the "
+        "controller's case; longer values exercise expulsion/rejoin "
+        "churn instead",
+    )
+    p.add_argument(
+        "--k-rounds", type=int, default=60,
+        help="the controller must first degrade within this many rounds "
+        "of the straggle round",
+    )
+    p.add_argument(
+        "--max-transitions", type=int, default=6,
+        help="total mode transitions allowed (no-oscillation bound: "
+        "2 degrades + 2 restores + slack)",
+    )
+    p.add_argument(
+        "--err-budget", type=float, default=0.15,
+        help="max |reduced average - payload| any node may observe "
+        "(int8 quantization step ~max|x|/127 with EF; see RESILIENCE.md)",
+    )
+    p.add_argument(
+        "--post-rounds", type=int, default=40,
+        help="full-membership rounds that must complete AFTER the restore",
+    )
+    p.add_argument("--phase-timeout", type=float, default=240.0)
+    p.add_argument("--size", type=int, default=65536)
+    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument("--adapt-window", type=int, default=6)
+    p.add_argument("--adapt-dwell", type=int, default=12)
+    p.add_argument("--adapt-lag", type=int, default=8)
+    p.add_argument("--out-dir", default="chaos_adapt_run")
+    args = p.parse_args(argv)
+
+    import json
+    import os
+    import signal as _signal
+    import re
+    import subprocess
+
+    from akka_allreduce_tpu.control.chaos import parse_spec
+
+    straggler = args.nodes - 1
+    spec = (
+        f"delay:node={straggler},ms={args.delay_ms:g},"
+        f"at=round{args.straggle_at},for=round{args.heal_at}"
+    )
+    if args.stall_for > 0:
+        spec += (
+            f";stall:node={straggler},at=round{args.straggle_at + 20},"
+            f"for={args.stall_for:g}s"
+        )
+    try:
+        parse_spec(spec)
+    except ValueError as e:
+        p.error(str(e))
+    os.makedirs(args.out_dir, exist_ok=True)
+    metrics_path = os.path.join(args.out_dir, "rounds.jsonl")
+    adapt_log = os.path.join(args.out_dir, "adapt-decisions.jsonl")
+    for f in (metrics_path, adapt_log):
+        if os.path.exists(f):
+            os.remove(f)  # MetricsLogger appends; one run per file
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    spawn = _drill_spawn(env)
+
+    failures: list[str] = []
+    await_phase = _drill_phase_waiter(args.phase_timeout, failures)
+
+    def adapt_events() -> list[dict]:
+        out = []
+        if not os.path.exists(metrics_path):
+            return out
+        with open(metrics_path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue  # torn last line of a live writer
+                if rec.get("kind") == "adapt":
+                    out.append(rec)
+        return out
+
+    def full_rounds() -> int:
+        return _drill_full_rounds(metrics_path, args.nodes)
+
+    master = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(args.nodes),
+        "--rounds", "-1", "--size", str(args.size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--chaos-seed", str(args.seed), "--chaos-spec", spec,
+        "--chaos-log", os.path.join(args.out_dir, "chaos-master.jsonl"),
+        "--metrics-out", metrics_path,
+        "--adapt", "--adapt-window", str(args.adapt_window),
+        "--adapt-dwell", str(args.adapt_dwell),
+        "--adapt-lag", str(args.adapt_lag),
+        "--adapt-log", adapt_log,
+    )
+    nodes = []
+    node_out: dict[int, str] = {}
+    master_done = False
+    try:
+        seed_ep = None
+        for line in master.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("master never reported its endpoint")
+        nodes = [
+            spawn(
+                "cluster-node", "--seed", seed_ep, "--node-id", str(k),
+                # IDENTICAL payloads on every node: the reduced average
+                # must equal the payload, so deviation == wire error
+                "--data-seed", "7", "--uniform-check",
+                "--chaos-log",
+                os.path.join(args.out_dir, f"chaos-node{k}.jsonl"),
+            )
+            for k in range(args.nodes)
+        ]
+        # phase 1: the straggler window opens and the controller degrades
+        await_phase(
+            lambda: any(e["to"] > e["from"] for e in adapt_events()),
+            "the controller's first degrade decision",
+        )
+        first_degrade = next(
+            (e for e in adapt_events() if e["to"] > e["from"]), None
+        )
+        if first_degrade is not None:
+            lateness = first_degrade["round"] - args.straggle_at
+            if lateness > args.k_rounds:
+                failures.append(
+                    f"controller degraded {lateness} rounds after the "
+                    f"straggle round (budget {args.k_rounds})"
+                )
+        # phase 2: after the heal the controller walks back to level 0
+        if not failures:
+            await_phase(
+                lambda: any(
+                    e["to"] == 0 and e["from"] == 1 for e in adapt_events()
+                ),
+                "the controller's restore to full fidelity",
+            )
+        # phase 3: the post-restore round budget completes at level 0
+        if not failures:
+            target = full_rounds() + args.post_rounds
+            await_phase(
+                lambda: full_rounds() >= target,
+                f"{args.post_rounds} full-membership rounds post-restore",
+            )
+        master.send_signal(_signal.SIGTERM)
+        try:
+            out, _ = master.communicate(timeout=60)
+            master_done = "master done" in out
+        except subprocess.TimeoutExpired:
+            failures.append("master did not shut down on SIGTERM")
+        for k, n in enumerate(nodes):
+            try:
+                node_out[k], _ = n.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                n.kill()
+                node_out[k] = ""
+    finally:
+        for proc in [master, *nodes]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    events = adapt_events()
+    degrades = sum(1 for e in events if e["to"] > e["from"])
+    restores = sum(1 for e in events if e["to"] < e["from"])
+    max_errs: dict[int, float] = {}
+    for k, out in node_out.items():
+        m = re.search(r"max_err=([0-9.eE+-]+)", out or "")
+        if m:
+            max_errs[k] = float(m.group(1))
+    # assertions over the collected evidence
+    if not events:
+        failures.append("controller never made a transition")
+    if degrades + restores > args.max_transitions:
+        failures.append(
+            f"{degrades + restores} mode transitions > bound "
+            f"{args.max_transitions} (oscillation)"
+        )
+    if events and events[-1]["to"] != 0:
+        failures.append(
+            f"controller ended at level {events[-1]['to']}, not restored"
+        )
+    if not any(e.get("policy", "").startswith("int8") for e in events):
+        failures.append("controller never reached the int8 wire mode")
+    if len(max_errs) < args.nodes:
+        failures.append(
+            f"max_err evidence from only {sorted(max_errs)} of "
+            f"{args.nodes} node(s)"
+        )
+    for k, err in sorted(max_errs.items()):
+        if err > args.err_budget:
+            failures.append(
+                f"node {k} reduced-value error {err:.4f} exceeds the EF "
+                f"budget {args.err_budget}"
+            )
+    if not master_done:
+        failures.append("master did not finish cleanly")
+    decision_log = None
+    if os.path.exists(adapt_log):
+        with open(adapt_log) as f:
+            decision_log = [json.loads(ln) for ln in f if ln.strip()]
+
+    summary = {
+        "seed": args.seed,
+        "spec": spec,
+        "rounds_completed": full_rounds(),
+        "adapt_events": events,
+        "decision_log": decision_log,
+        "degrades": degrades,
+        "restores": restores,
+        "max_err": max_errs,
+        "err_budget": args.err_budget,
+        "master_done": master_done,
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def _cmd_obs(argv: list[str]) -> int:
     """Observability toolbox: run the 2-process trace demo, inspect flight
     dumps, merge per-process Perfetto traces (OBSERVABILITY.md)."""
@@ -3365,6 +3711,7 @@ COMMANDS = {
     "chaos": _cmd_chaos,
     "chaos-recover": _cmd_chaos_recover,
     "chaos-failover": _cmd_chaos_failover,
+    "chaos-adapt": _cmd_chaos_adapt,
 }
 
 

@@ -86,6 +86,11 @@ class ScatteredDataBuffer:
         self._counts = np.zeros(self.num_chunks, dtype=np.int32)
         self._contributed = np.zeros((self.num_chunks, peer_size), dtype=bool)
         self._reduced = np.zeros(self.num_chunks, dtype=bool)
+        # the once-only crossing signal per chunk, tracked separately from
+        # _reduced so the edge fires exactly once even when the caller
+        # defers reduce() AND when set_reduce_trigger lowers the bar under
+        # counts that already satisfy it
+        self._edge_fired = np.zeros(self.num_chunks, dtype=bool)
         self.reduce_trigger = threshold.reduce_count(peer_size)
 
     def _chunk_bounds(self, chunk_id: int) -> tuple[int, int]:
@@ -125,10 +130,40 @@ class ScatteredDataBuffer:
                 native.accumulate(self._sums[start:stop], value)
         self._counts[chunk_id] += 1
         self._contributed[chunk_id, src_id] = True
-        return (
-            not self._reduced[chunk_id]
-            and int(self._counts[chunk_id]) == self.reduce_trigger
-        )
+        # >= guarded by the once-only edge flag (not ==): the trigger may
+        # have been LOWERED by set_reduce_trigger under counts already
+        # past it (a RoundPolicy arriving after peers ran ahead), and the
+        # first store at or beyond the bar must still fire exactly once
+        if (
+            self._edge_fired[chunk_id]
+            or self._reduced[chunk_id]
+            or int(self._counts[chunk_id]) < self.reduce_trigger
+        ):
+            return False
+        self._edge_fired[chunk_id] = True
+        return True
+
+    def set_reduce_trigger(self, trigger: int) -> list[int]:
+        """Apply a per-round effective reduce trigger (RoundPolicy,
+        control/adapt.py). Returns the chunks that ALREADY satisfy the new
+        trigger and await reduce — the caller must reduce-and-broadcast
+        them now, exactly as if ``store`` had just crossed: the edge signal
+        cannot fire retroactively for contributions that predate the
+        policy. Clamped to [1, peer_size]."""
+        trigger = max(1, min(int(trigger), self.peer_size))
+        if trigger == self.reduce_trigger:
+            return []
+        self.reduce_trigger = trigger
+        ready = [
+            c
+            for c in range(self.num_chunks)
+            if not self._reduced[c]
+            and not self._edge_fired[c]
+            and int(self._counts[c]) >= trigger
+        ]
+        for c in ready:
+            self._edge_fired[c] = True
+        return ready
 
     def count(self, chunk_id: int) -> int:
         self._chunk_bounds(chunk_id)

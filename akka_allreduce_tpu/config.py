@@ -197,6 +197,68 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdaptConfig:
+    """Closed-loop adaptive degradation (control/adapt.py, RESILIENCE.md
+    "Tier 5 — adaptation"): the leader's per-round controller that trades
+    ``th_reduce`` and wire precision against straggler pain.
+
+    The controller walks a degrade ladder of ``levels`` steps (level 0 =
+    configured threshold + configured wire dtype; level 1 = f16 wire +
+    interpolated threshold; level 2 = int8 wire + ``floor_th_reduce``) and
+    is hysteresis-gated: DEGRADE when a worker's contribution lag reaches
+    ``lag_degrade`` rounds (or the window's mean round latency exceeds
+    ``slow_factor`` x the learned healthy baseline, or rounds had to be
+    re-Started, or the window's endpoint-reconnect + dropped-send delta
+    reaches ``noise_degrade``), RESTORE one level only when every lag is
+    back under ``lag_restore`` AND the window was quiet (no restarts, no
+    reorganizations, noise below HALF the degrade threshold) AND the
+    level has dwelt at least ``min_dwell`` rounds — distinct thresholds
+    + dwell, so a noisy tail cannot flap the mode. Decisions happen once per ``window``
+    observed round completions, never on a wall-clock timer.
+
+    Lives in its own config section so it rides ``Welcome`` like every
+    other knob — though workers never read it: the controller's output is
+    fully carried per message as the ``RoundPolicy`` stamp.
+    """
+
+    enabled: bool = False
+    levels: int = 2  # degrade steps past full fidelity (ladder depth)
+    floor_th_reduce: float = 0.5  # th_reduce never degrades below this
+    window: int = 8  # round completions per decision
+    lag_degrade: int = 12  # worker contribution lag (rounds) that degrades
+    lag_restore: int = 4  # lag must fall to this before a restore
+    min_dwell: int = 16  # rounds at a level before the next transition
+    slow_factor: float = 5.0  # window mean latency vs baseline that degrades
+    # per-window reconnects+drops counter delta that reads as degrade
+    # pressure (and, at half this, blocks restores); 0 disables the arm —
+    # lag/latency/restart evidence still applies
+    noise_degrade: int = 8
+
+    def __post_init__(self) -> None:
+        _check_fraction("floor_th_reduce", self.floor_th_reduce)
+        if self.levels not in (1, 2):
+            raise ValueError(f"levels must be 1 or 2, got {self.levels}")
+        if self.window <= 0 or self.min_dwell < 0:
+            raise ValueError(
+                f"window must be > 0 and min_dwell >= 0, got "
+                f"{self.window}/{self.min_dwell}"
+            )
+        if not 0 <= self.lag_restore < self.lag_degrade:
+            raise ValueError(
+                "need 0 <= lag_restore < lag_degrade, got "
+                f"{self.lag_restore}/{self.lag_degrade}"
+            )
+        if self.slow_factor <= 1.0:
+            raise ValueError(
+                f"slow_factor must be > 1, got {self.slow_factor}"
+            )
+        if self.noise_degrade < 0:
+            raise ValueError(
+                f"noise_degrade must be >= 0, got {self.noise_degrade}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class MasterConfig:
     """Cluster-wide control-plane config (reference ``MasterConfig``)."""
 
@@ -233,6 +295,7 @@ class AllreduceConfig:
     node: NodeConfig = dataclasses.field(default_factory=NodeConfig)
     master: MasterConfig = dataclasses.field(default_factory=MasterConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
+    adapt: AdaptConfig = dataclasses.field(default_factory=AdaptConfig)
 
     @classmethod
     def from_json(cls, text: str) -> "AllreduceConfig":
@@ -245,6 +308,7 @@ class AllreduceConfig:
             "node": NodeConfig,
             "master": MasterConfig,
             "chaos": ChaosConfig,
+            "adapt": AdaptConfig,
         }
         unknown = set(raw) - set(sections)
         if unknown:

@@ -58,6 +58,13 @@ log = logging.getLogger(__name__)
 # takeover/fence/walk counters, and the digest stream volume — the signals a
 # failover post-mortem reads next to the chaos event log
 _EPOCH_GAUGE = _metrics.gauge("master.epoch")
+# the AdaptiveController's registry evidence (control/adapt.py): cumulative
+# counters whose window deltas are degrade pressure / restore blockers —
+# held as objects so the per-round gather is attribute reads, not lookups
+_EV_RESTARTS = _metrics.counter("master.rounds_restarted")
+_EV_RECONNECTS = _metrics.counter("remote.endpoint_reconnects")
+_EV_DROPS = _metrics.counter("chaos.injected.drop")
+_EV_REORGS = _metrics.counter("master.reorganizations")
 _TAKEOVERS = _metrics.counter("failover.takeovers")
 _DIGESTS_SENT = _metrics.counter("failover.digests_sent")
 _DIGESTS_RECEIVED = _metrics.counter("failover.digests_received")
@@ -134,6 +141,14 @@ class MasterProcess:
             self.watchdog = RoundWatchdog(
                 config.master.round_deadline_s, clock=clock
             )
+        # closed-loop adaptive degradation (control/adapt.py): the LEADER
+        # drives it; a passive standby builds its own at takeover (from
+        # the adopted config) and inherits the level via the digest
+        self.adapt = None
+        if config.adapt.enabled and standby_of is None:
+            from akka_allreduce_tpu.control.adapt import AdaptiveController
+
+            self.adapt = AdaptiveController(config.adapt, config.threshold)
         self.grid = self._build_grid()
         self.monitor = HeartbeatMonitor(
             PhiAccrualFailureDetector(
@@ -175,13 +190,13 @@ class MasterProcess:
         """One definition of the grid wiring — the ctor and a standby
         takeover (which replaces the grid under the adopted config) must
         never drift apart."""
-        return GridMaster(
+        grid = GridMaster(
             self.config.threshold,
             self.config.master,
             self.config.line_master,
             on_round_complete=(
                 self._on_round_complete
-                if (self.metrics or self.watchdog)
+                if (self.metrics or self.watchdog or self.adapt)
                 else None
             ),
             on_round_start=(
@@ -192,6 +207,11 @@ class MasterProcess:
             on_reorganize=(self.watchdog.reset if self.watchdog else None),
             epoch=self.epoch,
         )
+        if self.adapt is not None:
+            # the controller's current level survives grid rebuilds (a
+            # takeover replaces the grid wholesale mid-incident)
+            grid.set_policy(self.adapt.policy())
+        return grid
 
     def _arm_chaos(self) -> None:
         from akka_allreduce_tpu.control.chaos import (
@@ -527,6 +547,11 @@ class MasterProcess:
             "completed": self.grid.total_completed,
             "config_id": self.grid.config_id,
         }
+        if self.adapt is not None:
+            # the controller's level/dwell/baseline ride the per-tick half:
+            # a promoted standby inherits the CURRENT policy mid-incident
+            # instead of resetting to full fidelity (RESILIENCE.md Tier 5)
+            round_state["adapt"] = self.adapt.digest()
         return (
             self._digest_static + ', "round": ' + json.dumps(round_state) + "}"
         )
@@ -679,7 +704,18 @@ class MasterProcess:
         # re-join (a "restart" of a known member) drives the reorganize
         # that re-prepares everyone under the new epoch
         rnd = state["round"]
-        self.grid = self._build_grid()  # stamps the bumped epoch
+        if self.config.adapt.enabled:
+            # inherit the dead leader's controller mid-incident: level,
+            # dwell and counter watermarks come from the digest, so the
+            # promoted master's FIRST Prepare carries the inherited policy
+            # and the hysteresis clock does not reset with the leader
+            from akka_allreduce_tpu.control.adapt import AdaptiveController
+
+            self.adapt = AdaptiveController(
+                self.config.adapt, self.config.threshold
+            )
+            self.adapt.restore(rnd.get("adapt"))
+        self.grid = self._build_grid()  # stamps the bumped epoch + policy
         live = set(self.book) - self.unreachable
         self.grid.nodes = set(live)
         self.grid.organized = bool(live)
@@ -959,9 +995,39 @@ class MasterProcess:
     ) -> None:
         """Per-round observability (SURVEY.md §6): one JSONL record per
         completed line-round — latency, contributors at threshold, config —
-        and the watchdog's completion signal (retires the round's deadline)."""
+        the watchdog's completion signal (retires the round's deadline),
+        and one tick of straggler evidence into the AdaptiveController
+        (RESILIENCE.md "Tier 5"): the master gathers the grid's lag map
+        and the registry counters HERE and hands them in, so the
+        controller stays a pure, replayable state machine."""
         if self.watchdog is not None:
             self.watchdog.round_completed(line_id, r)
+        if self.adapt is not None and self.active:
+            # the O(lines x workers) lag merge + counter snapshot are only
+            # read on the window-boundary call — skip the gather otherwise
+            if self.adapt.deciding_next:
+                lags = self.grid.worker_lags()
+                counters = {
+                    "restarts": _EV_RESTARTS.value,
+                    "reconnects": _EV_RECONNECTS.value,
+                    "drops": _EV_DROPS.value,
+                    "reorgs": _EV_REORGS.value,
+                }
+            else:
+                lags, counters = {}, {}
+            pol = self.adapt.observe_round(
+                r, lags, counters, latency_s=latency_s
+            )
+            if pol is not None:
+                # rounds started from now on (this very completion's
+                # window refill included) carry the new stamp; the level
+                # rides the digest's per-tick round state, so the standby
+                # learns it within one lease heartbeat
+                self.grid.set_policy(pol)
+                if self.metrics is not None and self.adapt.last_decision:
+                    self.metrics.log_event(
+                        kind="adapt", **self.adapt.last_decision
+                    )
         if self.metrics is not None:
             self.metrics.log_event(
                 kind="round",

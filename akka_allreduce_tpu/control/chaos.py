@@ -196,14 +196,19 @@ def parse_spec(spec: str) -> list[FaultSpec]:
                     raise ValueError(
                         f"partition needs >= 2 groups, got {v!r}"
                     )
-            elif k == "node" and name in ("stall", "crash"):
+            elif k == "node" and name in ("stall", "crash", "delay"):
+                # delay:node=K is the STAGED STRAGGLER (RESILIENCE.md
+                # "Tier 5"): one process's sends run late while its
+                # heartbeats keep their cadence (a constant hold preserves
+                # spacing) — slow-but-alive, the case the adaptive
+                # controller exists for, distinct from stall's silence
                 f.node = _parse_role(v, f"{name} node")
             elif k == "at":
                 f.at = _parse_when(v, f"{name} at")
             elif k == "heal" and name == "partition":
                 f.until = _parse_when(v, "partition heal")
-            elif k == "for" and name == "stall":
-                f.until = _parse_when(v, "stall for")
+            elif k == "for" and name in ("stall", "delay"):
+                f.until = _parse_when(v, f"{name} for")
             else:
                 raise ValueError(f"{name}: unknown param {k!r}")
         if name == "partition" and not f.groups:
@@ -446,6 +451,17 @@ class ChaosInjector:
                 act.delay_s = max(act.delay_s, remain)
                 hit = True
                 continue
+            if name == "delay" and (
+                f.node is not None or f.at != ("time", 0.0) or f.until
+            ):
+                # the targeted/windowed delay form (the staged straggler):
+                # role and window are checked BEFORE the rng draw, so an
+                # un-targeted un-windowed spec's decision stream is
+                # byte-identical to the historical one
+                if f.node is not None and f.node != self.role:
+                    continue
+                if not self._window_active(f, now):
+                    continue
             # probabilistic faults consume exactly one sample per send so
             # the decision stream depends only on (seed, traffic order)
             if rng.random() >= f.p:

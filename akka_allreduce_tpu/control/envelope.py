@@ -30,9 +30,17 @@ class Envelope:
     CURRENT context at send time — so replies built inside a handler
     inherit the inbound message's round trace without every handler
     knowing tracing exists.
+
+    ``wire``, when set, pins this frame's wire precision ("f32"/"f16"/
+    "int8") instead of the transport's configured default — how a
+    :class:`~akka_allreduce_tpu.protocol.RoundPolicy` applies per-round
+    compression to payload frames without any transport-global state
+    (decode is stateless; the mode travels in the frame's count-word
+    flags).
     """
 
     dest: str
     msg: Any
     via: Any = None  # control.cluster.Endpoint | None
     trace: Any = None  # obs.trace.TraceContext | None
+    wire: str | None = None  # per-frame wire precision override

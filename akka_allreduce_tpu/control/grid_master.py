@@ -27,7 +27,11 @@ from akka_allreduce_tpu.control.line_master import LineMaster
 from akka_allreduce_tpu.obs import metrics as obs_metrics
 from akka_allreduce_tpu.obs import trace as obs_trace
 from akka_allreduce_tpu.parallel.mesh import grid_factors
-from akka_allreduce_tpu.protocol import CompleteAllreduce, ConfirmPreparation
+from akka_allreduce_tpu.protocol import (
+    DEFAULT_POLICY,
+    CompleteAllreduce,
+    ConfirmPreparation,
+)
 
 log = logging.getLogger(__name__)
 
@@ -59,6 +63,9 @@ class GridMaster:
         self.on_round_start = on_round_start
         self.on_reorganize = on_reorganize
         self.epoch = epoch
+        # current RoundPolicy (control/adapt.py): new rounds AND new line
+        # configurations start under it; set via set_policy
+        self.policy = DEFAULT_POLICY
         self.nodes: set[int] = set()
         self.config_id = 0
         self.organized = False
@@ -180,6 +187,9 @@ class GridMaster:
                 on_round_start=self.on_round_start,
                 epoch=self.epoch,
             )
+            # the controller's current level survives a reorganization: a
+            # re-mesh mid-incident must not silently reset to full fidelity
+            lm.policy = self.policy
             self.line_masters[line_id] = lm
             for w in worker_ids:
                 self._line_of_worker[w] = line_id
@@ -225,6 +235,25 @@ class GridMaster:
                 return []
             return self.handle_for_line(line_id, msg)
         raise TypeError(f"master cannot handle {type(msg).__name__}")
+
+    # -- adaptive degradation (control/adapt.py) -------------------------------
+
+    def set_policy(self, policy) -> None:
+        """Adopt a new RoundPolicy: rounds started from now on (and any
+        future line configuration) carry it; in-flight rounds keep the
+        stamp they started under."""
+        self.policy = policy
+        for lm in self.line_masters.values():
+            lm.policy = policy
+
+    def worker_lags(self) -> dict[int, int]:
+        """Per-worker contribution lag (rounds) across every line — the
+        controller's straggler evidence (LineMaster.worker_lags)."""
+        out: dict[int, int] = {}
+        for lm in self.line_masters.values():
+            for w, lag in lm.worker_lags().items():
+                out[w] = max(out.get(w, 0), lag)
+        return out
 
     @property
     def total_completed(self) -> int:

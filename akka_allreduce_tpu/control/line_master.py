@@ -18,9 +18,11 @@ from akka_allreduce_tpu.control.envelope import Envelope, peer_addr
 from akka_allreduce_tpu.obs import metrics as obs_metrics
 from akka_allreduce_tpu.obs import trace as obs_trace
 from akka_allreduce_tpu.protocol import (
+    DEFAULT_POLICY,
     CompleteAllreduce,
     ConfirmPreparation,
     PrepareAllreduce,
+    RoundPolicy,
     StartAllreduce,
 )
 
@@ -33,6 +35,13 @@ RoundStartObserver = Callable[[int, int], None]
 
 _ROUNDS_COMPLETED = obs_metrics.counter("master.rounds_completed")
 _ROUND_LATENCY = obs_metrics.histogram("master.round_latency_s")
+# per-wire-mode round accounting (OBSERVABILITY.md adapt.*), held as
+# objects so the per-completion hot path is an attribute read, not a
+# registry name lookup (bootstrap.py's convention)
+_MODE_ROUNDS = {
+    wire: obs_metrics.counter(f"adapt.mode_rounds.{wire or 'full'}")
+    for wire in RoundPolicy.WIRE_MODES
+}
 _ROUNDS_ABANDONED = obs_metrics.counter("master.rounds_abandoned")
 _ROUNDS_DEGRADED = obs_metrics.counter("master.rounds_degraded")
 _ROUNDS_RESTARTED = obs_metrics.counter("master.rounds_restarted")
@@ -58,6 +67,13 @@ class LineMaster:
         # stamped onto every Prepare/Start so nodes can fence a zombie
         # master's round triggers after a failover (-1 = unfenced)
         self.epoch = epoch
+        # the CURRENT RoundPolicy (control/adapt.py): stamped onto each
+        # round's StartAllreduce AT START — the per-round record below is
+        # what re-Starts re-send, so a re-issued Start can never disagree
+        # with buffers already reduced under the round's original policy
+        self.policy: RoundPolicy = DEFAULT_POLICY
+        self._round_policies: dict[int, RoundPolicy] = {}
+        self._prepare_policy: RoundPolicy = DEFAULT_POLICY
         self.clock = clock
         self.on_round_complete = on_round_complete
         self.on_round_start = on_round_start
@@ -87,6 +103,13 @@ class LineMaster:
         # so in-flight rounds at th=1.0 complete gracefully at detection
         # instead of wedging until the watchdog trips (degraded mode)
         self.unreachable: set[int] = set()
+        # highest round each worker EVER asserted complete — updated even
+        # for stale/late completions (which _on_complete otherwise drops),
+        # because the gap between this watermark and completed_up_to IS the
+        # straggler evidence the AdaptiveController consumes: a worker
+        # whose completions chronically arrive after the round retired is
+        # lagging by that many rounds, in round units, no wall clock
+        self.worker_last_complete: dict[int, int] = {}
 
     # -- configuration / handshake ------------------------------------------
 
@@ -113,11 +136,19 @@ class LineMaster:
         self.config_id = config_id
         self.next_round = from_round
         self.completed_so_far = completed_so_far
+        # every worker starts the config with zero lag: from_round - 1 is
+        # the shared watermark (nothing of this config completed yet)
+        self.worker_last_complete = {w: from_round - 1 for w in worker_ids}
         self.started_rounds.clear()
         self.completions.clear()
         self.completed_up_to = from_round - 1
         self._confirmed.clear()
         self.unreachable.clear()  # a new config is built from live members
+        self._round_policies.clear()
+        # the policy in force when this configuration was prepared:
+        # re-sent Prepares (reprepare_pending) carry the SAME stamp, so a
+        # retried handshake cannot smuggle a newer level in
+        self._prepare_policy = self.policy
         self._preparing = True
         self._prepared_at = self.clock()
         return self._prepare_envelopes(self.worker_ids)
@@ -128,7 +159,7 @@ class LineMaster:
                 peer_addr(w),
                 PrepareAllreduce(
                     self.config_id, self.worker_ids, w, self.next_round,
-                    self.line_id, self.epoch,
+                    self.line_id, self.epoch, self._prepare_policy,
                 ),
             )
             for w in workers
@@ -173,8 +204,15 @@ class LineMaster:
             )
             span = self._round_spans.get(r)
             ctx = span.context if span is not None else None
+            # the round's ORIGINAL policy, never the controller's current
+            # one: workers that already reduced buffers for r did so under
+            # the stamp the first Start carried, and a re-issued Start
+            # that disagreed would split the round's threshold semantics
+            pol = self._round_policies.get(r, DEFAULT_POLICY)
             out.extend(
-                Envelope(peer_addr(w), StartAllreduce(r, self.epoch), trace=ctx)
+                Envelope(
+                    peer_addr(w), StartAllreduce(r, self.epoch, pol), trace=ctx
+                )
                 for w in pending
             )
         return out
@@ -267,8 +305,24 @@ class LineMaster:
         )
         return self._fill_window()
 
+    def worker_lags(self) -> dict[int, int]:
+        """Per-worker contribution lag in ROUNDS: how far each worker's
+        newest completion assertion trails the line's completed horizon.
+        Reachable workers only — the detector owns the unreachable story
+        (degraded mode), the controller owns the slow-but-alive one."""
+        return {
+            w: max(0, self.completed_up_to - self.worker_last_complete.get(w, -1))
+            for w in self.worker_ids
+            if w not in self.unreachable
+        }
+
     def _on_complete(self, msg: CompleteAllreduce) -> list[Envelope]:
         r = msg.round_num
+        if msg.src_id in self.worker_last_complete:
+            # the lag watermark advances on EVERY assertion, stale ones
+            # included: a late completion is exactly the straggler signal
+            prev = self.worker_last_complete.get(msg.src_id, -1)
+            self.worker_last_complete[msg.src_id] = max(prev, r)
         if self._preparing or r <= self.completed_up_to or r not in self.started_rounds:
             return []  # stale or unknown round
         done = self.completions.setdefault(r, set())
@@ -290,6 +344,11 @@ class LineMaster:
         self.completed_up_to = max(self.completed_up_to, r)
         self.total_completed += 1
         _ROUNDS_COMPLETED.inc()
+        # per-mode round accounting (OBSERVABILITY.md adapt.*): which wire
+        # mode this round actually ran under — the A/B attribution signal
+        # soak/bench reports carry
+        pol = self._round_policies.get(r, DEFAULT_POLICY)
+        _MODE_ROUNDS[pol.wire].inc()
         started = self._started_at.get(r)
         latency = self.clock() - started if started is not None else -1.0
         if latency >= 0:
@@ -309,6 +368,7 @@ class LineMaster:
             self.completions.pop(stale, None)
             self._started_at.pop(stale, None)
             self._restarted_at.pop(stale, None)
+            self._round_policies.pop(stale, None)
             stale_span = self._round_spans.pop(stale, None)
             if stale_span is not None:
                 _ROUNDS_ABANDONED.inc()
@@ -332,6 +392,12 @@ class LineMaster:
             self.next_round += 1
             self.started_rounds.add(r)
             self._started_at[r] = self.clock()
+            # the policy is FROZEN per round at start (the stamp every
+            # worker and every re-Start of r must agree on) — recorded
+            # unconditionally, so a round started under the DEFAULT policy
+            # can never inherit a later level through a re-Start fallback
+            pol = self.policy
+            self._round_policies[r] = pol
             # the round's trace is minted HERE: one fresh trace id, a
             # line_master.round root span that stays open until the
             # threshold completion, and the context stamped onto every
@@ -344,13 +410,15 @@ class LineMaster:
                 round=r,
                 config=self.config_id,
             )
+            if not pol.is_default:
+                span.set(policy=pol.describe())
             self._round_spans[r] = span
             if self.on_round_start is not None:
                 self.on_round_start(self.line_id, r)
             out.extend(
                 Envelope(
                     peer_addr(w),
-                    StartAllreduce(r, self.epoch),
+                    StartAllreduce(r, self.epoch, pol),
                     trace=span.context,
                 )
                 for w in self.worker_ids

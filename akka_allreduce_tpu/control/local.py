@@ -109,7 +109,10 @@ class LocalRouter:
         from akka_allreduce_tpu.control import wire
 
         try:
-            parts = wire.encode_frame_parts(env.dest, env.msg)
+            # honor the envelope's per-frame wire mode (RoundPolicy): an
+            # in-process int8/f16 round should corrupt the SAME bytes the
+            # TCP path would put on the wire
+            parts = wire.encode_frame_parts(env.dest, env.msg, wire=env.wire)
             parts = self.chaos.corrupt_frame_parts(parts, act)
             body = b"".join(bytes(p) for p in parts)[4:]
             dest, msg = wire.decode_frame_body(body)

@@ -654,7 +654,7 @@ class RemoteTransport:
             return
         t0 = time.perf_counter()
         parts = wire.encode_frame_parts(
-            env.dest, env.msg, f16=self.wire_f16, trace=tctx
+            env.dest, env.msg, f16=self.wire_f16, wire=env.wire, trace=tctx
         )
         if chaos_act is not None and chaos_act.corrupt:
             parts = self.chaos.corrupt_frame_parts(parts, chaos_act)

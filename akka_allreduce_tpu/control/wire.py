@@ -28,11 +28,14 @@ _log = logging.getLogger(__name__)
 from akka_allreduce_tpu import native
 from akka_allreduce_tpu.control import cluster as cl
 from akka_allreduce_tpu.control import statetransfer as st
+from akka_allreduce_tpu.obs import metrics as _obs_metrics
 from akka_allreduce_tpu.protocol import (
+    DEFAULT_POLICY,
     CompleteAllreduce,
     ConfirmPreparation,
     PrepareAllreduce,
     ReduceBlock,
+    RoundPolicy,
     ScatterBlock,
     StartAllreduce,
 )
@@ -123,18 +126,34 @@ def _chunk_payload_view(payload) -> memoryview:
 
 # Top bit of the u32 element count flags a float16 payload (the wire-
 # compression mode, MetaDataConfig.wire_dtype): the f32 format is unchanged
-# byte for byte, and the flag costs nothing. Decode always hands the engine
-# float32 — compression lives entirely on the wire.
+# byte for byte, and the flag costs nothing. Bit 30 flags an int8 payload
+# (``[f32 scale][i8 x n]`` — the adaptive controller's deepest degrade
+# mode, control/adapt.py); the two flags are mutually exclusive. Decode
+# always hands the engine float32 — compression lives entirely on the wire.
 _F16_FLAG = 0x8000_0000
+_I8_FLAG = 0x4000_0000
 
 
 _F16_MAX = np.float32(65504.0)  # float16's finite range
 
 #: total payload elements saturated at ±65504 by f16 wire casts in this
 #: process — saturation silently alters out-of-range values, so operators
-#: need a signal (ADVICE r2); read it via ``f16_clip_count()``
+#: need a signal (ADVICE r2); read it via ``f16_clip_count()``. Mirrored
+#: into the obs registry (``wire.f16_clipped``) so clipping shows up in
+#: metrics_snapshot JSONL, not only this module global + a one-shot warn.
 _f16_clipped = 0
 _f16_clip_warned = False
+_F16_CLIPPED = _obs_metrics.counter("wire.f16_clipped")
+
+#: int8 wire-mode error accounting, mirroring the f16 counter pair: the
+#: accumulated L1 magnitude of quantization residuals this process put on
+#: the wire (``wire.int8_residual_l1`` — what the send-side EF carries
+#: forward, see ``int8_roundtrip``), payload count, and non-finite inputs
+#: saturated to finite values before scaling
+_int8_residual_l1 = 0.0
+_INT8_RESIDUAL = _obs_metrics.counter("wire.int8_residual_l1")
+_INT8_PAYLOADS = _obs_metrics.counter("wire.int8_payloads")
+_INT8_SATURATED = _obs_metrics.counter("wire.int8_saturated")
 
 
 def f16_clip_count() -> int:
@@ -142,9 +161,16 @@ def f16_clip_count() -> int:
     return _f16_clipped
 
 
+def int8_residual_l1() -> float:
+    """Accumulated |residual| the int8 wire mode has injected since
+    process start (the error the worker-side EF loop feeds back)."""
+    return _int8_residual_l1
+
+
 def _note_clipped(n: int) -> None:
     global _f16_clipped, _f16_clip_warned
     _f16_clipped += n
+    _F16_CLIPPED.inc(n)
     if not _f16_clip_warned:
         _f16_clip_warned = True
         _log.warning(
@@ -155,21 +181,74 @@ def _note_clipped(n: int) -> None:
         )
 
 
-def _pack_floats(value: np.ndarray, f16: bool = False) -> tuple[memoryview, int]:
+#: non-finite int8 inputs saturate here: far past any sane payload, yet
+#: ``127 * (_I8_SAT_MAX / 127)`` stays comfortably inside float32, so a
+#: saturated chunk dequantizes FINITE (saturating at f32 max would round
+#: the corner value back to inf)
+_I8_SAT_MAX = np.float32(1e30)
+
+
+def quantize_int8(value: np.ndarray) -> tuple[float, np.ndarray, np.ndarray]:
+    """``(scale, int8 array, sanitized f32 array)`` with a shared
+    per-chunk scale (``max|x| / 127``) — ONE definition used by the
+    encode path and by the worker's error-feedback loop, so the residual
+    the worker carries forward is exactly the error the wire injected.
+    Non-finite inputs are saturated first (counted,
+    ``wire.int8_saturated``) — a silent inf would zero the whole chunk —
+    and the sanitized array is what residuals must be computed against."""
+    arr = np.ascontiguousarray(value, dtype=np.float32)
+    m = float(np.max(np.abs(arr), initial=0.0))
+    if not np.isfinite(m):
+        bad = int(np.count_nonzero(~np.isfinite(arr)))
+        _INT8_SATURATED.inc(bad)
+        arr = np.nan_to_num(arr, posinf=_I8_SAT_MAX, neginf=-_I8_SAT_MAX)
+        m = float(np.max(np.abs(arr), initial=0.0))
+    scale = m / 127.0 if m > 0.0 else 1.0
+    q = np.rint(arr / np.float32(scale)).astype(np.int8)
+    return scale, q, arr
+
+
+def int8_roundtrip(value: np.ndarray) -> np.ndarray:
+    """What the receiver will see after an int8 wire round trip — the
+    worker's EF loop computes ``residual = value - int8_roundtrip(value)``
+    and adds it into the next round's chunk (the same identity as
+    ``comm.allreduce.ring_ef_residual`` with v=1: the whole hop error
+    carries forward)."""
+    scale, q, _ = quantize_int8(value)
+    return q.astype(np.float32) * np.float32(scale)
+
+
+def _pack_floats(value: np.ndarray, mode: str = "f32") -> tuple[memoryview, int]:
     """(payload byte view, count word) — the view aliases the caller's array
-    (or the one f16 cast), so the send path never copies the payload; the
-    transport's vectored write is the only consumer. ``f16`` casts the
-    payload to float16 for the wire, SATURATING at ±65504: a silent cast
-    would turn out-of-range elements into inf and poison every downstream
-    f32 accumulation (unlike bf16, float16 trades range for mantissa).
-    Saturation is counted and warned once (``f16_clip_count``)."""
-    if f16:
+    (or the one cast copy), so the send path never copies the payload; the
+    transport's vectored write is the only consumer.
+
+    ``mode`` selects the wire precision: ``"f16"`` casts to float16,
+    SATURATING at ±65504 (a silent cast would turn out-of-range elements
+    into inf and poison every downstream f32 accumulation — unlike bf16,
+    float16 trades range for mantissa; saturation is counted and warned
+    once, ``f16_clip_count``). ``"int8"`` quantizes with a shared
+    per-chunk scale (``[f32 scale][i8 x n]``, quantize_int8) and accounts
+    the injected residual (``wire.int8_residual_l1``) — senders that want
+    the error back must run the worker's EF loop."""
+    if mode == "f16":
         arr32 = np.asarray(value, dtype=np.float32)
         clipped = int(np.count_nonzero(np.abs(arr32) > _F16_MAX))
         if clipped:
             _note_clipped(clipped)
         arr = np.clip(arr32, -_F16_MAX, _F16_MAX).astype("<f2")
         return memoryview(arr).cast("B"), arr.size | _F16_FLAG
+    if mode == "int8":
+        global _int8_residual_l1
+        scale, q, arr32 = quantize_int8(value)
+        resid = float(
+            np.abs(arr32 - q.astype(np.float32) * np.float32(scale)).sum()
+        )
+        _int8_residual_l1 += resid
+        _INT8_RESIDUAL.inc(resid)
+        _INT8_PAYLOADS.inc()
+        payload = struct.pack("<f", scale) + q.tobytes()
+        return memoryview(payload), q.size | _I8_FLAG
     arr = np.ascontiguousarray(value, dtype="<f4")
     return memoryview(arr).cast("B"), arr.size
 
@@ -180,7 +259,16 @@ def _decode_block(buf: memoryview):
     One native call parses the header AND verifies the payload checksum
     (``native.unpack_block``); the returned array is a zero-copy
     ``np.frombuffer`` view into ``buf`` (f16 payloads decompress — the
-    astype is the one necessary copy)."""
+    astype is the one necessary copy). int8 frames (count-word bit 30)
+    predate the native parser's vocabulary, so they take an exact Python
+    path here: header struct reads + the generic native checksum — int8
+    is the DEGRADED wire mode, so the hot path stays the native call."""
+    tag = buf[0]
+    cw_off = 21 if tag == 2 else 25 if tag == 3 else None
+    if cw_off is not None and len(buf) >= cw_off + 8:
+        (count_word,) = _U32.unpack_from(buf, cw_off)
+        if count_word & _I8_FLAG:
+            return _decode_block_i8(buf, tag, cw_off, count_word)
     src, dest, chunk, rnd, count, n, is_f16, off = native.unpack_block(buf)
     if is_f16:
         value = np.frombuffer(buf, dtype="<f2", count=n, offset=off).astype(
@@ -191,32 +279,102 @@ def _decode_block(buf: memoryview):
     return value, src, dest, chunk, rnd, count
 
 
-def encode(msg: Any, *, f16: bool = False) -> bytes:
+def _decode_block_i8(buf: memoryview, tag: int, cw_off: int, count_word: int):
+    """The int8 arm of the payload decode: ``[f32 scale][i8 x n]`` behind
+    the ordinary ``[count_word][checksum]`` header, checksum over the whole
+    scale+data payload. Same contracts as the native path: ValueError on
+    truncation/corruption, trailing bytes tolerated (``<=`` bound)."""
+    if tag == 2:
+        src, dest, chunk, rnd = struct.unpack_from("<iiiq", buf, 1)
+        count = 0
+    else:
+        src, dest, chunk, rnd, count = struct.unpack_from("<iiiqi", buf, 1)
+    (ck,) = _U32.unpack_from(buf, cw_off + 4)
+    off = cw_off + 8
+    n = count_word & ~_I8_FLAG
+    nbytes = 4 + n  # f32 scale + n int8 elements
+    if off + nbytes > len(buf):
+        raise ValueError("truncated payload")
+    payload = buf[off : off + nbytes]
+    if native.wire_checksum(payload) != ck:
+        raise ValueError("payload checksum mismatch")
+    (scale,) = struct.unpack_from("<f", buf, off)
+    q = np.frombuffer(buf, dtype=np.int8, count=n, offset=off + 4)
+    value = q.astype(np.float32) * np.float32(scale)
+    return value, src, dest, chunk, rnd, count
+
+
+def _wire_mode(f16: bool, wire: str | None) -> str:
+    """Normalize the two wire-precision spellings: an explicit per-frame
+    ``wire`` mode (the RoundPolicy path) wins over the transport-default
+    ``f16`` bool."""
+    if wire:
+        return wire
+    return "f16" if f16 else "f32"
+
+
+def encode(msg: Any, *, f16: bool = False, wire: str | None = None) -> bytes:
     """Message -> ``[tag][body]`` bytes."""
-    return b"".join(_encode_parts(msg, f16))
+    return b"".join(_encode_parts(msg, _wire_mode(f16, wire)))
 
 
-def _encode_parts(msg: Any, f16: bool = False) -> list:
+def _encode_policy(policy: RoundPolicy) -> bytes:
+    """``[f32 th_reduce][u8 wire_mode]`` — the RoundPolicy trailing field
+    on tags 1/5. Appended AFTER every previously-last field, so an old
+    decoder (which reads exactly the bytes it knows) ignores it — the same
+    version-skew contract as the trace trailer, ratcheted per tag in
+    tests/test_wire_roundtrip.py."""
+    return struct.pack(
+        "<fB", policy.th_reduce, RoundPolicy.WIRE_MODES.index(policy.wire)
+    )
+
+
+_POLICY_LEN = 5
+
+
+def _decode_policy(buf: memoryview, off: int) -> RoundPolicy:
+    """Inverse of ``_encode_policy`` — a frame too short to carry the
+    field is an old encoder's: default policy. Unknown future wire-mode
+    bytes degrade to "inherit" rather than refusing the frame."""
+    if len(buf) < off + _POLICY_LEN:
+        return DEFAULT_POLICY
+    th, mode = struct.unpack_from("<fB", buf, off)
+    wire_mode = (
+        RoundPolicy.WIRE_MODES[mode]
+        if mode < len(RoundPolicy.WIRE_MODES)
+        else ""
+    )
+    if not th and not wire_mode:
+        return DEFAULT_POLICY
+    return RoundPolicy(float(th), wire_mode)
+
+
+def _encode_parts(msg: Any, mode: str = "f32") -> list:
     """Message -> list of buffer segments (bytes / memoryviews).
 
     Payload-carrying messages keep the float array as a zero-copy view so the
-    caller's single ``join`` is the only copy on the send path.
+    caller's single ``join`` is the only copy on the send path. ``mode`` is
+    the wire precision for float payloads ("f32"/"f16"/"int8").
     """
     tag = _TAGS.get(type(msg))
     if tag is None:
         raise TypeError(f"no wire tag for {type(msg).__name__}")
     head = bytes([tag])
     if tag == 1:
-        return [head, struct.pack("<qq", msg.round_num, msg.epoch)]
+        return [
+            head,
+            struct.pack("<qq", msg.round_num, msg.epoch),
+            _encode_policy(msg.policy),
+        ]
     if tag == 2:
-        payload, count_word = _pack_floats(msg.value, f16)
+        payload, count_word = _pack_floats(msg.value, mode)
         head = native.pack_block_header(
             2, msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num, 0,
             payload, count_word,
         )
         return [head, payload]
     if tag == 3:
-        payload, count_word = _pack_floats(msg.value, f16)
+        payload, count_word = _pack_floats(msg.value, mode)
         head = native.pack_block_header(
             3, msg.src_id, msg.dest_id, msg.chunk_id, msg.round_num,
             msg.count, payload, count_word,
@@ -227,7 +385,8 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
     if tag == 5:
         peers = msg.peer_ids
         # epoch rides AFTER the peer list so the variable-length tail stays
-        # where every decoder expects it
+        # where every decoder expects it; the policy stamp is the trailing
+        # field after THAT (old decoders stop at the epoch)
         return [
             head,
             struct.pack(
@@ -240,6 +399,7 @@ def _encode_parts(msg: Any, f16: bool = False) -> list:
                 *peers,
                 msg.epoch,
             ),
+            _encode_policy(msg.policy),
         ]
     if tag == 6:
         return [head, struct.pack("<qi", msg.config_id, msg.worker_id)]
@@ -342,7 +502,8 @@ def decode(data: bytes | memoryview) -> Any:
     tag = buf[0]
     off = 1
     if tag == 1:
-        return StartAllreduce(*struct.unpack_from("<qq", buf, off))
+        rnd, epoch = struct.unpack_from("<qq", buf, off)
+        return StartAllreduce(rnd, epoch, _decode_policy(buf, off + 16))
     if tag == 2:
         value, src, dest, chunk, rnd, _ = _decode_block(buf)
         return ScatterBlock(value, src, dest, chunk, rnd)
@@ -357,8 +518,9 @@ def decode(data: bytes | memoryview) -> Any:
         )
         peers = struct.unpack_from(f"<{n}i", buf, off + 26)
         (epoch,) = struct.unpack_from("<q", buf, off + 26 + 4 * n)
+        policy = _decode_policy(buf, off + 34 + 4 * n)
         return PrepareAllreduce(
-            config_id, peers, worker_id, round_num, line_id, epoch
+            config_id, peers, worker_id, round_num, line_id, epoch, policy
         )
     if tag == 6:
         return ConfirmPreparation(*struct.unpack_from("<qi", buf, off))
@@ -503,7 +665,8 @@ def split_trace(buf: memoryview):
 
 
 def encode_frame_parts(
-    dest: str, msg: Any, *, f16: bool = False, trace=None
+    dest: str, msg: Any, *, f16: bool = False, wire: str | None = None,
+    trace=None,
 ) -> list[bytes | memoryview]:
     """Framed envelope as scatter-gather segments:
     ``[u32 len][u16 dest_len][dest][tag][body...][trace trailer?]``.
@@ -514,10 +677,14 @@ def encode_frame_parts(
     so the kernel gathers them. The payload memory must stay unmodified
     until the send completes (the engine's frozen-after-reduce buffers and
     snapshot-publishing sources guarantee this). ``f16`` sends float
-    payloads at half width (decode side is automatic). ``trace`` appends
-    the 25-byte trace-context trailer (see above — old decoders ignore
-    it)."""
-    parts: list[Any] = [b"", _pack_str(dest), *_encode_parts(msg, f16)]
+    payloads at half width; ``wire`` overrides it per frame with an
+    explicit mode ("f32"/"f16"/"int8" — the RoundPolicy path; decode is
+    stateless, the mode travels in the count-word flags). ``trace``
+    appends the 25-byte trace-context trailer (see above — old decoders
+    ignore it)."""
+    parts: list[Any] = [
+        b"", _pack_str(dest), *_encode_parts(msg, _wire_mode(f16, wire))
+    ]
     if trace is not None:
         parts.append(encode_trace(trace))
     body_len = sum(len(p) for p in parts)
@@ -525,10 +692,15 @@ def encode_frame_parts(
     return parts
 
 
-def encode_frame(dest: str, msg: Any, *, f16: bool = False, trace=None) -> bytes:
+def encode_frame(
+    dest: str, msg: Any, *, f16: bool = False, wire: str | None = None,
+    trace=None,
+) -> bytes:
     """``encode_frame_parts`` joined to one buffer (compat / tests — the
     transport itself sends the segments unjoined)."""
-    return b"".join(encode_frame_parts(dest, msg, f16=f16, trace=trace))
+    return b"".join(
+        encode_frame_parts(dest, msg, f16=f16, wire=wire, trace=trace)
+    )
 
 
 def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:

@@ -30,11 +30,13 @@ from akka_allreduce_tpu.config import (
     ThresholdConfig,
     WorkerConfig,
 )
+from akka_allreduce_tpu.control import wire as wire_codec
 from akka_allreduce_tpu.control.envelope import Envelope, master_addr, peer_addr
 from akka_allreduce_tpu.obs import flight as obs_flight
 from akka_allreduce_tpu.obs import metrics as obs_metrics
 from akka_allreduce_tpu.obs import trace as obs_trace
 from akka_allreduce_tpu.protocol import (
+    DEFAULT_POLICY,
     AllReduceInput,
     AllReduceInputRequest,
     AllReduceOutput,
@@ -42,6 +44,7 @@ from akka_allreduce_tpu.protocol import (
     ConfirmPreparation,
     PrepareAllreduce,
     ReduceBlock,
+    RoundPolicy,
     ScatterBlock,
     StartAllreduce,
 )
@@ -89,6 +92,17 @@ class AllreduceWorker:
         # flush of the same round. Callers that rebuild the worker (a node
         # rejoin) carry the value across instances via AllreduceNode.
         self.flushed_up_to = -1
+        # per-round degradation policy (RESILIENCE.md "Tier 5"): the
+        # StartAllreduce stamp, applied to this round's reduce trigger and
+        # this round's outgoing payload frames — every worker sees the
+        # SAME stamp for a round id, so thresholds can never disagree
+        self._policies: dict[int, RoundPolicy] = {}
+        # int8 wire-mode error feedback: per-(dest worker, chunk) residual
+        # of the last quantized send, added into the next round's chunk —
+        # the ring_ef_residual identity (comm/allreduce.py) with v=1: the
+        # whole hop error carries forward, so steady-state reduce error
+        # stays bounded by ONE quantization step instead of accumulating
+        self._ef_residual: dict[tuple[int, int], np.ndarray] = {}
 
     # -- configuration -------------------------------------------------------
 
@@ -146,6 +160,13 @@ class AllreduceWorker:
         self.peer_ids = msg.peer_ids
         self.config_id = msg.config_id
         self.line_id = msg.line_id
+        # a new configuration resets per-round policies and the EF keys
+        # (both are keyed against the old peer set); the Prepare's own
+        # policy stamp seeds rounds whose Start we have not seen yet
+        self._policies.clear()
+        self._ef_residual.clear()
+        if not msg.policy.is_default:
+            self._policies[msg.round_num] = msg.policy
         self.rounds = RoundBuffers(
             self.metadata,
             self.threshold,
@@ -204,10 +225,41 @@ class AllreduceWorker:
         # flight-recorder post-mortem wants to know
         _ROUND_IN_FLIGHT.set(r)
         obs_flight.set_state("worker.round_in_flight", r)
+        # apply the round's policy stamp BEFORE scattering: the trigger
+        # must be in force when our own self-delivery contributions land,
+        # and chunks that peers already filled past the (lowered) trigger
+        # fire their once-only reduce-broadcast right now
+        for stale in [k for k in self._policies if k <= rounds.completed_up_to]:
+            del self._policies[stale]
+        out: list[Envelope] = []
+        pol = msg.policy
+        if pol.is_default:
+            # the Start's stamp is authoritative for its round id: drop a
+            # Prepare-seeded policy it supersedes (the controller may have
+            # restored between the Prepare and the line's first Start — the
+            # round must run at the mode the master froze for it, not the
+            # seed), so _wire_for/_round_policy agree with the master
+            self._policies.pop(r, None)
+        else:
+            self._policies[r] = pol
+        trig = pol.reduce_count(self.peer_size)
+        if trig is not None:
+            buf = rounds.scattered(r)
+            for chunk_id in buf.set_reduce_trigger(trig):
+                out.extend(self._reduce_and_broadcast(buf, r, chunk_id))
         with obs_trace.span(
             "worker.round_start", worker=self.worker_id, round=r
         ):
-            return self._scatter_round(msg)
+            out.extend(self._scatter_round(msg))
+        return out
+
+    def _round_policy(self, r: int) -> RoundPolicy:
+        return self._policies.get(r, DEFAULT_POLICY)
+
+    def _wire_for(self, r: int) -> str | None:
+        """Per-frame wire precision for round ``r``'s payload envelopes
+        (None = the transport's configured default)."""
+        return self._round_policy(r).wire or None
 
     def _scatter_round(self, msg: StartAllreduce) -> list[Envelope]:
         r = msg.round_num
@@ -232,6 +284,14 @@ class AllreduceWorker:
         # synchronously, and the snapshot is what the socket reads.
         data = np.ascontiguousarray(data, dtype=np.float32)
         zero_copy = self.config.zero_copy_scatter
+        pol = self._round_policy(r)
+        wire_mode = pol.wire or None
+        int8 = pol.wire == "int8"
+        if not int8 and self._ef_residual:
+            # the mode restored out of int8: the pending corrections are
+            # bounded by one quantization step — drop them rather than
+            # inject stale int8-era error into full-fidelity rounds
+            self._ef_residual.clear()
         my_id = self.worker_id
         assert my_id is not None
         my_rank = self.peer_ids.index(my_id)
@@ -245,11 +305,25 @@ class AllreduceWorker:
                     chunk = np.zeros(hi - lo, dtype=np.float32)
                     if lo < meta.data_size:
                         chunk[: meta.data_size - lo] = data[lo:]
+                if int8 and dest_id != my_id:
+                    # error feedback on the wire-bound copy (self-delivery
+                    # never quantizes): fold the last send's residual in,
+                    # then carry THIS send's residual forward — computed
+                    # with the exact quantizer the encode path runs
+                    # (wire.quantize_int8), so sent - received == residual
+                    prev = self._ef_residual.pop((dest_id, c), None)
+                    if prev is not None and prev.shape == chunk.shape:
+                        chunk = chunk + prev
+                    self._ef_residual[(dest_id, c)] = (
+                        chunk - wire_codec.int8_roundtrip(chunk)
+                    )
                 sb = ScatterBlock(chunk, my_rank, dest_rank, c, r)
                 if dest_id == my_id:
                     out.extend(self._on_scatter(sb))  # self-delivery, no wire
                 else:
-                    out.append(Envelope(peer_addr(dest_id), sb))
+                    out.append(
+                        Envelope(peer_addr(dest_id), sb, wire=wire_mode)
+                    )
         return out
 
     def _on_scatter(self, msg: ScatterBlock) -> list[Envelope]:
@@ -264,21 +338,32 @@ class AllreduceWorker:
         crossed = buf.store(msg.value, msg.src_id, msg.chunk_id)
         if not crossed:
             return []
+        return self._reduce_and_broadcast(buf, r, msg.chunk_id)
+
+    def _reduce_and_broadcast(self, buf, r: int, chunk_id: int) -> list[Envelope]:
+        """The once-per-chunk reduce + broadcast body — fired either by
+        ``store``'s trigger crossing or by a RoundPolicy lowering the
+        trigger under contributions that already satisfy it. The broadcast
+        rides at the round's policy wire mode (decode is stateless, so a
+        frame sent before the policy stamp arrived mixes harmlessly)."""
         with obs_trace.span(
             "worker.reduce",
             worker=self.worker_id,
             round=r,
-            chunk=msg.chunk_id,
+            chunk=chunk_id,
         ):
-            value, count = buf.reduce(msg.chunk_id)
+            value, count = buf.reduce(chunk_id)
             my_rank = self.peer_ids.index(self.worker_id)
+            wire_mode = self._wire_for(r)
             out: list[Envelope] = []
             for dest_id in self.peer_ids:
-                rb = ReduceBlock(value, my_rank, 0, msg.chunk_id, r, count)
+                rb = ReduceBlock(value, my_rank, 0, chunk_id, r, count)
                 if dest_id == self.worker_id:
                     out.extend(self._on_reduce(rb))
                 else:
-                    out.append(Envelope(peer_addr(dest_id), rb))
+                    out.append(
+                        Envelope(peer_addr(dest_id), rb, wire=wire_mode)
+                    )
             return out
 
     def _on_reduce(self, msg: ReduceBlock) -> list[Envelope]:
@@ -302,6 +387,8 @@ class AllreduceWorker:
             rounds.complete(r)  # evicts this round AND abandons older ones
             self.completed_rounds += 1
             self.flushed_up_to = max(self.flushed_up_to, r)
+            for stale in [k for k in self._policies if k <= r]:
+                del self._policies[stale]  # evicted with their rounds
             self.data_sink(AllReduceOutput(data, counts, r))
         _ROUNDS_COMPLETED.inc()
         obs_flight.set_state("worker.last_completed_round", r)

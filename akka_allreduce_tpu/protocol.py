@@ -23,9 +23,71 @@ identity; pure-control messages compare by value (handy in tests).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Sequence
 
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundPolicy:
+    """Per-round degradation knobs the leader's :class:`AdaptiveController`
+    (control/adapt.py, RESILIENCE.md "Tier 5") stamps onto every
+    ``PrepareAllreduce``/``StartAllreduce``, so EVERY worker applies the
+    same effective threshold and wire precision for a given round id.
+
+    Falsy fields mean "inherit the configured value" — the default policy
+    is a no-op, so systems that never run the controller behave exactly as
+    before. On the wire the policy rides as a trailing field with the same
+    version-skew contract as the trace trailer: old decoders ignore it,
+    and this decoder treats its absence as the default policy.
+
+    - ``th_reduce``: effective scatter-reduce threshold for the round
+      (``0.0`` = the configured ``ThresholdConfig.th_reduce``). The
+      controller only ever lowers it, bounded by a configured floor.
+    - ``wire``: wire precision for the round's payload frames — ``"f32"``,
+      ``"f16"`` or ``"int8"`` (``""`` = the configured
+      ``MetaDataConfig.wire_dtype``). ``int8`` quantizes with a shared
+      per-chunk scale and the send side feeds the quantization residual
+      into the next round's chunk (the EF identity,
+      ``comm/allreduce.py ring_ef_residual``).
+    """
+
+    th_reduce: float = 0.0
+    wire: str = ""
+
+    #: wire-mode byte values (``0`` = inherit); keep in sync with
+    #: ``control/wire.py``'s trailing-field codec
+    WIRE_MODES = ("", "f32", "f16", "int8")
+
+    def __post_init__(self) -> None:
+        if self.th_reduce and not (0.0 < self.th_reduce <= 1.0):
+            raise ValueError(
+                f"policy th_reduce must be 0 or in (0, 1], got {self.th_reduce}"
+            )
+        if self.wire not in self.WIRE_MODES:
+            raise ValueError(
+                f"policy wire must be one of {self.WIRE_MODES}, got {self.wire!r}"
+            )
+
+    @property
+    def is_default(self) -> bool:
+        return not self.th_reduce and not self.wire
+
+    def reduce_count(self, peer_size: int) -> int | None:
+        """Effective scatter-reduce trigger, or None to keep the
+        configured one."""
+        if not self.th_reduce:
+            return None
+        return max(1, math.ceil(self.th_reduce * peer_size))
+
+    def describe(self) -> str:
+        """Compact human/JSONL form (span attributes, drill logs)."""
+        return f"{self.wire or 'full'}@{self.th_reduce or 'cfg'}"
+
+
+#: the inherit-everything policy (one shared frozen instance)
+DEFAULT_POLICY = RoundPolicy()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,10 +97,15 @@ class StartAllreduce:
     ``epoch`` is the issuing master's leadership epoch (RESILIENCE.md
     "Tier 4"): after a failover, nodes reject round triggers from a fenced
     zombie leader. ``-1`` = unfenced (in-process systems, tests).
+    ``policy`` is the round's :class:`RoundPolicy` — every worker applies
+    the SAME effective threshold/precision for this round id, and a
+    re-issued Start (``LineMaster.restart_stalled``) carries the round's
+    ORIGINAL policy, never the controller's current one.
     """
 
     round_num: int
     epoch: int = -1
+    policy: RoundPolicy = DEFAULT_POLICY
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -104,6 +171,9 @@ class PrepareAllreduce:
     # issuing master's leadership epoch (-1 = unfenced); a node that has
     # joined a newer master drops configuration attempts from the old one
     epoch: int = -1
+    # the RoundPolicy in force when this configuration was prepared (the
+    # controller's current level) — re-sent Prepares carry the SAME one
+    policy: RoundPolicy = DEFAULT_POLICY
 
     def __post_init__(self) -> None:
         object.__setattr__(self, "peer_ids", tuple(self.peer_ids))

@@ -43,6 +43,12 @@ class SoakReport:
     # peer replication bookkeeping when the replica sidecar is on
     # (chunks/bytes copied into the replica store across the run)
     replication: dict | None
+    # the per-round policy trail of the AdaptiveController driven by the
+    # chaos schedule's straggler evidence (``--chaos`` runs only):
+    # {degrades, restores, final_level, mode_rounds: {mode: steps},
+    # transitions: [...]} — so an A/B pair of soak JSONs can attribute a
+    # throughput shift to mode changes instead of guessing
+    adapt: dict | None
     checkpoint_saves: int
     # a skip because a background save is still in flight (real contention —
     # the stall signal) vs a skip because the step is already durable (the
@@ -158,11 +164,16 @@ def run_soak(
 
     silent_plan = None
     leader_kill = None
+    adapt_ctl = None
+    adapt_lags: dict[int, int] = {}
     # steps the simulated control plane is LEADERLESS after the kill (the
     # lease window): the detector dies with the leader — no polls, no
     # expulsions — then the standby's takeover re-meshes everyone
     failover_steps = 3
     if chaos_seed is not None:
+        from akka_allreduce_tpu.config import AdaptConfig
+        from akka_allreduce_tpu.control.adapt import AdaptiveController
+        from akka_allreduce_tpu.config import ThresholdConfig
         from akka_allreduce_tpu.control.chaos import (
             leader_kill_step,
             membership_schedule,
@@ -170,6 +181,20 @@ def run_soak(
 
         silent_plan = membership_schedule(chaos_seed, nodes, steps)
         leader_kill = leader_kill_step(chaos_seed, steps)
+        # the adaptive controller rides the SAME seeded schedule: a node's
+        # consecutive silent steps feed it as contribution lag, so the
+        # policy trail is a pure function of the chaos seed (deterministic
+        # A/B). The trail is REPORTED, not applied — re-compiling the
+        # trainer per mode flip would swamp the soak's timing story; the
+        # TCP cluster (cluster-master --adapt) is where the policy drives
+        # the actual wire.
+        adapt_ctl = AdaptiveController(
+            AdaptConfig(
+                enabled=True, window=4, min_dwell=8,
+                lag_degrade=3, lag_restore=1,
+            ),
+            ThresholdConfig(),
+        )
     elastic = ElasticTrainer(factory, assignment, clock=lambda: now["t"])
     churn = (
         f"chaos seed {chaos_seed} "
@@ -253,6 +278,8 @@ def run_soak(
         rows = elastic.trainer.dp * batch_per_replica
         return next(ds.batches(rows, 1, seed_offset=seed))
 
+    adapt_trail = reg.series("soak.adapt.transitions")
+    adapt_mode_steps: dict[str, int] = {}
     for step in range(steps):
         if silent_plan is not None:
             silent = silent_plan.get(step, frozenset())
@@ -313,6 +340,28 @@ def run_soak(
                 f"step {step}: re-mesh ({kind}) -> "
                 f"{elastic.trainer.n_devices} devices in {dt:.2f}s"
             )
+        if adapt_ctl is not None:
+            # one "round" of straggler evidence per step: a silent node's
+            # lag is its consecutive silent steps (round units — the same
+            # shape the TCP master feeds from LineMaster.worker_lags)
+            for k in range(nodes):
+                adapt_lags[k] = 0 if k in alive else adapt_lags.get(k, 0) + 1
+            pol = adapt_ctl.observe_round(step, dict(adapt_lags), {})
+            if pol is not None:
+                rec = dict(adapt_ctl.decisions[-1], step=step)
+                adapt_trail.append(rec)
+                reg.counter(
+                    "soak.adapt.degrades"
+                    if rec["to"] > rec["from"]
+                    else "soak.adapt.restores"
+                ).inc()
+                log(
+                    f"step {step}: adapt level {rec['from']} -> "
+                    f"{rec['to']} ({'+'.join(rec['why'])}) policy "
+                    f"{rec['policy']}"
+                )
+            mode = adapt_ctl.policy().wire or "full"
+            adapt_mode_steps[mode] = adapt_mode_steps.get(mode, 0) + 1
         step_ms.append(dt * 1e3)
         losses.append(m.loss)
         c_steps.inc()
@@ -410,6 +459,17 @@ def run_soak(
         remesh_events=list(remesh_events.values),
         restore=restore_rec,
         replication=replication,
+        adapt=(
+            {
+                "degrades": reg.counter("soak.adapt.degrades").value,
+                "restores": reg.counter("soak.adapt.restores").value,
+                "final_level": adapt_ctl.level,
+                "mode_rounds": dict(adapt_mode_steps),
+                "transitions": list(adapt_trail.values),
+            }
+            if adapt_ctl is not None
+            else None
+        ),
         checkpoint_saves=c_saves.value,
         checkpoint_skipped_busy=c_skip_busy.value,
         checkpoint_skipped_dedup=c_skip_dedup.value,

@@ -53,7 +53,34 @@ import time
 REFERENCE_GBPS = 1.25  # 10 GbE ceiling of the reference's Netty data plane
 
 
+def _adapt_trail() -> dict | None:
+    """Per-round policy trail of the adaptive controller, read from the
+    obs registry (``adapt.*`` + ``wire.*`` error counters) when anything
+    in-process drove it — so an A/B pair of BENCH json lines can
+    attribute a throughput shift to degradation mode changes. None (field
+    omitted) when no controller ran: the common bench path is unchanged."""
+    try:
+        from akka_allreduce_tpu.obs.metrics import REGISTRY
+    except Exception:
+        return None
+    snap = REGISTRY.snapshot()
+    trail = {
+        k.split(".", 1)[1]: v
+        for k, v in snap.items()
+        if k.startswith("adapt.") and not isinstance(v, dict)
+    }
+    if not any(trail.values()):
+        return None
+    for k in ("wire.f16_clipped", "wire.int8_residual_l1"):
+        if snap.get(k):
+            trail[k] = round(snap[k], 3) if isinstance(snap[k], float) else snap[k]
+    return trail
+
+
 def _emit(metric: str, value: float, **extra) -> None:
+    adapt = _adapt_trail()
+    if adapt is not None:
+        extra["adapt"] = adapt
     print(
         json.dumps(
             {

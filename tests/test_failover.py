@@ -160,6 +160,54 @@ def test_takeover_from_stale_round_digest_continues_numbering():
     assert all(p.config_id == leader.grid.config_id + 1 for p in prepares)
 
 
+def test_takeover_inherits_the_active_round_policy():
+    """ISSUE 8 acceptance: a leader killed MID-INCIDENT hands the active
+    RoundPolicy to the standby via the StateDigest — the promoted
+    master's FIRST Prepare already carries the inherited policy (level,
+    dwell and counter watermarks restored, not reset to full fidelity)."""
+    import dataclasses
+
+    from akka_allreduce_tpu.config import AdaptConfig
+    from akka_allreduce_tpu.protocol import RoundPolicy
+
+    cfg = dataclasses.replace(
+        _config(2, th=1.0),
+        adapt=AdaptConfig(
+            enabled=True, window=2, min_dwell=2, lag_degrade=5, lag_restore=1
+        ),
+    )
+    leader = MasterProcess(cfg, port=0, epoch=3)
+    for nid in range(2):
+        _join(leader, nid)
+    assert leader.adapt is not None
+    # mid-incident: sustained straggler evidence degrades the leader
+    for r in range(6):
+        leader.adapt.observe_round(r, {1: 9}, {})
+    leader.grid.set_policy(leader.adapt.policy())
+    degraded = leader.adapt.policy()
+    assert degraded != RoundPolicy() and leader.adapt.level >= 1
+    (digest_env,) = leader._on_cluster_msg(
+        cl.StandbyRegister("10.1.0.1", 9001)
+    )[-1:]
+    standby = MasterProcess(
+        _config(2), port=0, standby_of=cl.Endpoint("l", 1),
+        clock=lambda: 0.0,
+    )
+    standby._on_cluster_msg(digest_env.msg)
+    standby._takeover(0.0)
+    # the controller survived the leader: same level, same policy, dwell
+    # and counter watermarks carried (the hysteresis clock did not reset)
+    assert standby.adapt is not None
+    assert standby.adapt.level == leader.adapt.level
+    assert standby.adapt.policy() == degraded
+    assert standby.adapt._rounds_at_level == leader.adapt._rounds_at_level
+    assert standby.grid.policy == degraded
+    # the first post-takeover Prepare (a known member re-joins) carries it
+    out = _join(standby, 0, inc=5000)
+    prepares = [e.msg for e in out if isinstance(e.msg, PrepareAllreduce)]
+    assert prepares and all(p.policy == degraded for p in prepares)
+
+
 def test_zombie_leader_is_fenced_by_its_own_digest_stream():
     """After a takeover the deposed leader keeps digesting to its standby
     — which is now the active master: it answers with

@@ -23,10 +23,12 @@ from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.obs.trace import TraceContext
 from akka_allreduce_tpu.protocol import (
+    DEFAULT_POLICY,
     CompleteAllreduce,
     ConfirmPreparation,
     PrepareAllreduce,
     ReduceBlock,
+    RoundPolicy,
     ScatterBlock,
     StartAllreduce,
 )
@@ -48,16 +50,22 @@ _DIGEST_STATE = (
     ' "round": {"next": 12, "completed": 9, "config_id": 3}}'
 )
 
+# the RoundPolicy trailing field on tags 1/5 (control/adapt.py): a
+# non-default stamp in the canonical samples, so a dropped trailing field
+# cannot round-trip by luck; the default form + old-decoder simulations
+# get their own tests below
+_POLICY = RoundPolicy(th_reduce=0.75, wire="int8")
+
 # one representative instance per wire type; every field non-default so a
 # dropped/reordered struct field cannot round-trip by luck
 _SAMPLES = {
-    StartAllreduce: StartAllreduce(round_num=41, epoch=6),
+    StartAllreduce: StartAllreduce(round_num=41, epoch=6, policy=_POLICY),
     ScatterBlock: ScatterBlock(_PAYLOAD, 2, 1, 3, 17),
     ReduceBlock: ReduceBlock(_PAYLOAD * 2.0, 1, 0, 2, 18, 5),
     CompleteAllreduce: CompleteAllreduce(src_id=4, round_num=19),
     PrepareAllreduce: PrepareAllreduce(
         config_id=7, peer_ids=(0, 1, 5), worker_id=5, round_num=20,
-        line_id=2, epoch=6,
+        line_id=2, epoch=6, policy=_POLICY,
     ),
     ConfirmPreparation: ConfirmPreparation(config_id=7, worker_id=3),
     cl.JoinCluster: cl.JoinCluster("10.0.0.9", 7171, 2, 12345),
@@ -157,6 +165,125 @@ def test_truncated_payload_is_rejected(msg_type):
     data = wire.encode(_SAMPLES[msg_type])
     with pytest.raises(ValueError):
         wire.decode(data[: len(data) - 3])
+
+
+# --- RoundPolicy trailing field: version skew (ISSUE 8) -----------------------
+#
+# The policy rides tags 1/5 as a TRAILING field with the trace trailer's
+# version-skew contract: an old decoder reads exactly the bytes it knows
+# and ignores the stamp; this decoder treats a frame too short to carry it
+# as the default policy. Both directions over both policy forms.
+
+_POLICY_FORMS = [
+    DEFAULT_POLICY,
+    RoundPolicy(th_reduce=0.75, wire=""),
+    RoundPolicy(th_reduce=0.0, wire="f16"),
+    RoundPolicy(th_reduce=0.5, wire="int8"),
+]
+
+
+def _policy_samples(policy):
+    return [
+        StartAllreduce(round_num=41, epoch=6, policy=policy),
+        PrepareAllreduce(
+            config_id=7, peer_ids=(0, 1, 5), worker_id=5, round_num=20,
+            line_id=2, epoch=6, policy=policy,
+        ),
+    ]
+
+
+@pytest.mark.parametrize(
+    "policy", _POLICY_FORMS, ids=lambda p: p.describe()
+)
+def test_policy_stamped_forms_roundtrip(policy):
+    for msg in _policy_samples(policy):
+        back = wire.decode(wire.encode(msg))
+        _assert_equal(msg, back)
+        assert back.policy == policy
+
+
+@pytest.mark.parametrize(
+    "policy", _POLICY_FORMS, ids=lambda p: p.describe()
+)
+def test_old_decoder_ignores_the_policy_stamp(policy):
+    """Exact replica of the PRE-policy decode arms (fixed struct reads,
+    trailing bytes ignored) fed policy-stamped frames — the same
+    simulation the trace-trailer ratchet runs."""
+    import struct
+
+    start, prepare = _policy_samples(policy)
+    buf = memoryview(wire.encode(start))
+    assert struct.unpack_from("<qq", buf, 1) == (41, 6)
+    buf = memoryview(wire.encode(prepare))
+    config_id, worker_id, round_num, line_id, n = struct.unpack_from(
+        "<qiqiH", buf, 1
+    )
+    peers = struct.unpack_from(f"<{n}i", buf, 27)
+    (epoch,) = struct.unpack_from("<q", buf, 27 + 4 * n)
+    assert (config_id, worker_id, round_num, line_id) == (7, 5, 20, 2)
+    assert peers == (0, 1, 5) and epoch == 6
+
+
+def test_new_decoder_reads_old_frames_as_default_policy():
+    """An OLD encoder's frames (no trailing stamp) decode to the default
+    policy — byte-exact reconstruction of the pre-policy layouts."""
+    import struct
+
+    old_start = bytes([1]) + struct.pack("<qq", 41, 6)
+    back = wire.decode(old_start)
+    assert back == StartAllreduce(41, 6) and back.policy is DEFAULT_POLICY
+    peers = (0, 1, 5)
+    old_prep = bytes([5]) + struct.pack(
+        f"<qiqiH{len(peers)}iq", 7, 5, 20, 2, len(peers), *peers, 6
+    )
+    back = wire.decode(old_prep)
+    assert back.policy is DEFAULT_POLICY
+    _assert_equal(PrepareAllreduce(7, peers, 5, 20, 2, 6), back)
+
+
+def test_policy_stamp_composes_with_trace_trailer():
+    """Trailing-field stacking: [body][policy][trace trailer] — the
+    trailer is stripped first (frame layer), the policy parsed next, and
+    an old decoder ignores both."""
+    msg = StartAllreduce(41, 6, RoundPolicy(0.5, "int8"))
+    framed = wire.encode_frame("worker:9", msg, trace=_TCTX)
+    dest, back, tctx = wire.decode_frame_body_ex(memoryview(framed)[4:])
+    assert back == msg and tctx == _TCTX
+
+
+@pytest.mark.parametrize(
+    "msg_type", [ScatterBlock, ReduceBlock], ids=["tag2", "tag3"]
+)
+def test_payload_tags_roundtrip_int8(msg_type):
+    """The int8 payload mode ([f32 scale][i8 x n] behind the ordinary
+    checksum header): values come back within one quantization step, and
+    the count-word flag keeps f16/int8/f32 frames self-describing."""
+    msg = _SAMPLES[msg_type]
+    back = wire.decode(wire.encode(msg, wire="int8"))
+    assert type(back) is type(msg)
+    step = float(np.abs(msg.value).max()) / 127.0
+    np.testing.assert_allclose(back.value, msg.value, atol=step / 2 + 1e-7)
+    assert back.round_num == msg.round_num
+
+
+def test_int8_corruption_and_truncation_rejected():
+    data = bytearray(wire.encode(_SAMPLES[ScatterBlock], wire="int8"))
+    data[-2] ^= 0x40
+    with pytest.raises(ValueError):
+        wire.decode(bytes(data))
+    whole = wire.encode(_SAMPLES[ScatterBlock], wire="int8")
+    with pytest.raises(ValueError):
+        wire.decode(whole[: len(whole) - 3])
+
+
+def test_int8_frame_tolerates_trailing_bytes():
+    """Same `<=` bound as every other payload decode: the trace trailer
+    after an int8 payload must not read as truncation or corruption."""
+    framed = wire.encode_frame(
+        "worker:1", _SAMPLES[ScatterBlock], wire="int8", trace=_TCTX
+    )
+    _, back, tctx = wire.decode_frame_body_ex(memoryview(framed)[4:])
+    assert tctx == _TCTX and isinstance(back, ScatterBlock)
 
 
 # --- tag 18 raw-buffer payload specifics --------------------------------------
