@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo chaos chaos-recover chaos-failover chaos-adapt
+.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -29,13 +29,22 @@ native:
 trace-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu obs demo --out-dir trace_demo
 
+# deterministic host data-plane microbench (BENCHMARKS.md round 8): wire
+# codec throughput (encode+checksum / decode+verify) and the syscall-
+# batching levers (one sendmsg per frame vs one sendmmsg per burst, plus
+# the recvmmsg mirror) over loopback — interleaved legs, JSON medians, so
+# the batch-path win is measurable even when the shared box is too noisy
+# for the pair-cluster A/B to resolve it.
+bench-wire:
+	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu bench-wire --json
+
 # fixed-seed 30-second chaos soak (RESILIENCE.md): real master + 3 node
 # processes under seeded drop/delay/corruption + a mid-run partition that
 # heals; exits non-zero unless rounds completed UNDER the chaos. The same
 # seed replays the same per-process chaos event logs (chaos_run/*.jsonl).
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu chaos --seed 1234 \
-	  --duration 30 --nodes 3 --th 0.66 --out-dir chaos_run \
+	  --duration 30 --nodes 3 --th 0.66 --streams 2 --out-dir chaos_run \
 	  --spec "drop:p=0.05;delay:ms=10;corrupt:p=0.02;partition:groups=m+0+1|2,at=10s,heal=8s"
 
 # fixed-seed crash + disk-loss recovery drill (RESILIENCE.md "Recovery"):
@@ -46,7 +55,7 @@ chaos:
 # same scenario inside tier-1.
 chaos-recover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-recover --seed 1234 --out-dir chaos_recover_run
+	  chaos-recover --seed 1234 --streams 2 --out-dir chaos_recover_run
 
 # fixed-seed master-kill failover drill (RESILIENCE.md "Tier 4"): a seeded
 # chaos crash kills the LEADER mid-round; the warm standby must take over
@@ -55,7 +64,7 @@ chaos-recover:
 # failover must still peer-restore via the replicated holder registry.
 chaos-failover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-failover --seed 1234 --out-dir chaos_failover_run
+	  chaos-failover --seed 1234 --streams 2 --out-dir chaos_failover_run
 
 # fixed-seed adaptive-degradation drill (RESILIENCE.md "Tier 5"): a seeded
 # staged straggler (windowed targeted delay + a stall burst) slows one
@@ -65,7 +74,7 @@ chaos-failover:
 # payloads, --uniform-check) must stay within the EF error budget.
 chaos-adapt:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-adapt --seed 1234 --out-dir chaos_adapt_run
+	  chaos-adapt --seed 1234 --streams 2 --out-dir chaos_adapt_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

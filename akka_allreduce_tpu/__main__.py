@@ -159,6 +159,25 @@ def _add_wire_dtype_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_data_plane_flags(p: argparse.ArgumentParser) -> None:
+    """Host data-plane sharding knobs (cluster masters only — distributed
+    to every node via Welcome, like --wire-dtype)."""
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="parallel TCP sockets per peer endpoint: stream 0 carries "
+        "control (ordering preserved, byte-identical legacy wire), "
+        "payload frames stripe across streams 1..N-1 by chunk id, each "
+        "drained by a dedicated sender thread running deferred "
+        "encode/checksum/sendmmsg off the event loop "
+        "(BENCHMARKS.md round 8); 1 = the legacy single-socket plane",
+    )
+    p.add_argument(
+        "--pump-pool", type=int, default=0,
+        help="worker threads for INBOUND decode offload of >=4MB bodies "
+        "(0 = auto: streams x endpoints, capped at 8)",
+    )
+
+
 def _add_sharded_compress_flag(p: argparse.ArgumentParser) -> None:
     """--compress/--overlap for the sharded-param trainers (train-lm/-moe/-pp)."""
     p.add_argument(
@@ -1013,6 +1032,7 @@ def _cmd_cluster_master(argv: list[str]) -> int:
         "seconds dumps the flight recorder (0 = off)",
     )
     _add_wire_dtype_flag(p)
+    _add_data_plane_flags(p)
     _add_chaos_flags(p)
     _add_adapt_flags(p)
     _add_obs_flags(p)
@@ -1039,6 +1059,7 @@ def _run_cluster_master(args) -> int:
     from akka_allreduce_tpu.config import (
         AllreduceConfig,
         ChaosConfig,
+        DataPlaneConfig,
         LineMasterConfig,
         MasterConfig,
         MetaDataConfig,
@@ -1083,6 +1104,10 @@ def _run_cluster_master(args) -> int:
             seed=getattr(args, "chaos_seed", 0), spec=chaos_spec
         ),
         adapt=_adapt_config_from(args),
+        data_plane=DataPlaneConfig(
+            streams=getattr(args, "streams", 1),
+            pump_pool=getattr(args, "pump_pool", 0),
+        ),
     )
     _install_obs(args)
 
@@ -2530,6 +2555,234 @@ def _drill_phase_waiter(timeout_s: float, failures: list):
     return await_phase
 
 
+def _cmd_bench_wire(argv: list[str]) -> int:
+    """Deterministic host data-plane microbench (``make bench-wire``):
+    per-core codec throughput (encode+checksum / decode+verify) and the
+    syscall-batching comparison (one ``sendmsg`` per frame vs one
+    ``sendmmsg`` per burst, plus the recv side) over loopback TCP. The
+    pair-cluster A/B (BENCHMARKS.md round 8) measures the system; this
+    measures the LEVERS, on a box whose run-to-run drift would otherwise
+    drown them — legs are interleaved and the medians reported."""
+    p = argparse.ArgumentParser(
+        "bench-wire",
+        description="wire codec + batch-syscall microbench (JSON output)",
+    )
+    p.add_argument(
+        "--size", type=int, default=4096,
+        help="floats per payload frame (default 16KB frames — small "
+        "enough that per-syscall overhead is visible)",
+    )
+    p.add_argument("--frames", type=int, default=64, help="frames per burst")
+    p.add_argument("--reps", type=int, default=9, help="interleaved reps/leg")
+    p.add_argument("--json", action="store_true", help="print the JSON record")
+    p.add_argument("--out", default=None, help="append the JSON record here")
+    args = p.parse_args(argv)
+
+    import json
+    import socket
+    import statistics
+    import threading
+
+    import numpy as np
+
+    from akka_allreduce_tpu import native
+    from akka_allreduce_tpu.control import wire
+    from akka_allreduce_tpu.protocol import ScatterBlock
+
+    rng = np.random.default_rng(7)
+    payloads = [
+        rng.standard_normal(args.size).astype(np.float32)
+        for _ in range(args.frames)
+    ]
+    msgs = [
+        ScatterBlock(v, 0, 1, i, 1) for i, v in enumerate(payloads)
+    ]
+    dest = "worker:1"
+    payload_bytes = args.size * 4 * args.frames
+
+    def timed(fn) -> float:
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    # -- codec legs (pure compute, no sockets) --------------------------------
+    def leg_encode() -> None:
+        for m in msgs:
+            wire.encode_frame_parts(dest, m)
+
+    frames_bytes = [b"".join(wire.encode_frame_parts(dest, m)) for m in msgs]
+
+    def leg_decode() -> None:
+        for f in frames_bytes:
+            wire.decode_frame_body_ex(memoryview(f)[4:])
+
+    def leg_checksum() -> None:
+        for v in payloads:
+            native.wire_checksum(memoryview(v).cast("B"))
+
+    codec: dict[str, list[float]] = {"encode": [], "decode": [], "checksum": []}
+    for _ in range(args.reps):
+        codec["encode"].append(timed(leg_encode))
+        codec["decode"].append(timed(leg_decode))
+        codec["checksum"].append(timed(leg_checksum))
+
+    # -- syscall legs: loopback TCP, a drain thread on the far end ------------
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    tx = socket.create_connection(srv.getsockname())
+    rx, _ = srv.accept()
+    srv.close()
+    tx.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    for sk in (tx, rx):
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                sk.setsockopt(socket.SOL_SOCKET, opt, 8 << 20)
+            except OSError:
+                pass
+    stop = threading.Event()
+
+    def drain() -> None:
+        sink = bytearray(1 << 20)
+        while not stop.is_set():
+            try:
+                if not rx.recv_into(sink):
+                    return
+            except OSError:
+                return
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+
+    frame_views = [
+        [memoryview(f)] for f in frames_bytes
+    ]  # one message per frame, rebuilt per send below
+
+    def send_all(batched: bool, force_fallback: bool = False) -> None:
+        frames = [list(f) for f in frame_views]
+        if batched:
+            while frames:
+                n = native.batch_send(
+                    tx.fileno(), frames, force_fallback=force_fallback
+                )
+                # advance past sent bytes
+                while n and frames:
+                    head = frames[0]
+                    while n and head:
+                        seg = head[0]
+                        if n >= len(seg):
+                            n -= len(seg)
+                            head.pop(0)
+                        else:
+                            head[0] = seg[n:]
+                            n = 0
+                    if not head:
+                        frames.pop(0)
+            return
+        for f in frames:  # one syscall per frame: the un-batched baseline
+            views = list(f)
+            while views:
+                n = tx.sendmsg(views)
+                while n and views:
+                    seg = views[0]
+                    if n >= len(seg):
+                        n -= len(seg)
+                        views.pop(0)
+                    else:
+                        views[0] = seg[n:]
+                        n = 0
+
+    have_native = native.batch_send_available()
+    have_mmsg = native.sendmmsg_available()
+    sysc: dict[str, list[float]] = {
+        "sendmsg_loop": [], "sendmmsg": [], "sendmmsg_fallback": [],
+    }
+    for _ in range(args.reps):  # interleaved: noise hits every leg alike
+        sysc["sendmsg_loop"].append(timed(lambda: send_all(False)))
+        if have_native:
+            sysc["sendmmsg"].append(timed(lambda: send_all(True)))
+            sysc["sendmmsg_fallback"].append(
+                timed(lambda: send_all(True, force_fallback=True))
+            )
+    stop.set()
+    tx.close()
+    rx.close()
+    drainer.join(timeout=2.0)
+
+    # -- recv legs: recvmmsg batch vs recv loop over a pre-pumped stream ------
+    recv: dict[str, list[float]] = {"recv_loop": [], "recvmmsg": []}
+    if have_native:
+        chunk = 64 << 10
+        nbufs = 16
+        bufs = [bytearray(chunk) for _ in range(nbufs)]
+        total = payload_bytes
+
+        def recv_bench(batched: bool) -> float:
+            a = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            a.bind(("127.0.0.1", 0))
+            a.listen(1)
+            c = socket.create_connection(a.getsockname())
+            b, _ = a.accept()
+            a.close()
+            blob = b"\x00" * total
+
+            def pump() -> None:
+                try:
+                    c.sendall(blob)
+                finally:
+                    c.close()
+
+            th = threading.Thread(target=pump, daemon=True)
+            th.start()
+            got = 0
+            t0 = time.perf_counter()
+            while got < total:
+                if batched:
+                    n = native.batch_recv(b.fileno(), bufs)
+                else:
+                    n = b.recv_into(bufs[0])
+                if n <= 0:
+                    break
+                got += n
+            dt = time.perf_counter() - t0
+            b.close()
+            th.join(timeout=2.0)
+            return dt
+
+        for _ in range(args.reps):
+            recv["recv_loop"].append(recv_bench(False))
+            recv["recvmmsg"].append(recv_bench(True))
+
+    def mbps(times: list[float]) -> float | None:
+        if not times:
+            return None
+        return round(payload_bytes / statistics.median(times) / 1e6, 1)
+
+    record = {
+        "bench": "wire",
+        "size_floats": args.size,
+        "frames": args.frames,
+        "reps": args.reps,
+        "native_loaded": native.loaded(),
+        "sendmmsg_available": have_mmsg,
+        "encode_mbps": mbps(codec["encode"]),
+        "decode_mbps": mbps(codec["decode"]),
+        "checksum_mbps": mbps(codec["checksum"]),
+        "sendmsg_loop_mbps": mbps(sysc["sendmsg_loop"]),
+        "sendmmsg_mbps": mbps(sysc["sendmmsg"]),
+        "sendmmsg_fallback_mbps": mbps(sysc["sendmmsg_fallback"]),
+        "recv_loop_mbps": mbps(recv["recv_loop"]),
+        "recvmmsg_mbps": mbps(recv["recvmmsg"]),
+    }
+    line = json.dumps(record, sort_keys=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
+    if args.json or not args.out:
+        print(line)
+    return 0
+
+
 def _cmd_chaos(argv: list[str]) -> int:
     """Chaos harness: a real master + N node OS processes over loopback,
     every transport armed with the SAME seeded fault schedule (the master
@@ -2560,6 +2813,12 @@ def _cmd_chaos(argv: list[str]) -> int:
     p.add_argument("--chunk", type=int, default=8192)
     p.add_argument("--th", type=float, default=0.66)
     p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome); "
+        "2 makes the drill exercise the multi-stream reassembly path "
+        "under every injected fault",
+    )
     p.add_argument("--out-dir", default="chaos_run")
     args = p.parse_args(argv)
     # fail fast on a malformed spec BEFORE spawning anything — a parse
@@ -2592,6 +2851,7 @@ def _cmd_chaos(argv: list[str]) -> int:
         "--rounds", str(rounds), "--size", str(args.size),
         "--chunk", str(args.chunk), "--th", str(args.th),
         "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
         "--chaos-seed", str(args.seed), "--chaos-spec", args.spec,
         "--chaos-log", master_log, "--metrics-out", metrics_path,
     )
@@ -2778,6 +3038,10 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     p.add_argument("--chunk", type=int, default=8192)
     p.add_argument("--th", type=float, default=0.66)
     p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome)",
+    )
     p.add_argument("--state-every", type=int, default=5)
     p.add_argument("--out-dir", default="chaos_recover_run")
     args = p.parse_args(argv)
@@ -2834,6 +3098,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         "--rounds", "-1", "--size", str(args.size),
         "--chunk", str(args.chunk), "--th", str(args.th),
         "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--metrics-out", metrics_path,
     )
@@ -3014,6 +3279,10 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
     p.add_argument("--chunk", type=int, default=8192)
     p.add_argument("--th", type=float, default=0.66)
     p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome)",
+    )
     p.add_argument("--state-every", type=int, default=5)
     p.add_argument("--out-dir", default="chaos_failover_run")
     args = p.parse_args(argv)
@@ -3089,6 +3358,7 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
         "--rounds", "-1", "--size", str(args.size),
         "--chunk", str(args.chunk), "--th", str(args.th),
         "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--chaos-log", os.path.join(args.out_dir, "chaos-leader.jsonl"),
         "--metrics-out", leader_metrics,
@@ -3326,6 +3596,10 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
     p.add_argument("--chunk", type=int, default=8192)
     p.add_argument("--th", type=float, default=0.66)
     p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome)",
+    )
     p.add_argument("--adapt-window", type=int, default=6)
     p.add_argument("--adapt-dwell", type=int, default=12)
     p.add_argument("--adapt-lag", type=int, default=8)
@@ -3390,6 +3664,7 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
         "--rounds", "-1", "--size", str(args.size),
         "--chunk", str(args.chunk), "--th", str(args.th),
         "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--chaos-log", os.path.join(args.out_dir, "chaos-master.jsonl"),
         "--metrics-out", metrics_path,
@@ -3708,6 +3983,7 @@ COMMANDS = {
     "lm-generate": _cmd_lm_generate,
     "elastic-demo": _cmd_elastic_demo,
     "obs": _cmd_obs,
+    "bench-wire": _cmd_bench_wire,
     "chaos": _cmd_chaos,
     "chaos-recover": _cmd_chaos_recover,
     "chaos-failover": _cmd_chaos_failover,

@@ -177,6 +177,46 @@ class RetryPolicy:
 
 
 @dataclasses.dataclass(frozen=True)
+class DataPlaneConfig:
+    """Host TCP data-plane sharding (control/remote.py, BENCHMARKS.md round 8).
+
+    ``streams`` is how many parallel sockets a transport opens per peer
+    endpoint. Stream 0 always carries control traffic with the exact legacy
+    framing (Prepare/Start/epoch fencing keep their per-connection FIFO and
+    their byte-identical wire format); with ``streams > 1`` the payload
+    frames (Scatter/ReduceBlock) are striped across streams ``1..N-1`` by
+    chunk id, each stream-connection announcing itself with a preamble and
+    sequencing its frames so the receive side can account loss per stream.
+    Each payload stream is drained by a DEDICATED sender thread (deferred
+    encode + checksum + the ``sendmmsg`` batch run in that thread, on a
+    blocking socket), so peer A's encode no longer serializes with peer
+    B's decode/accumulate on the event loop.
+
+    ``pump_pool`` caps the shared worker threads that offload INBOUND
+    decode of state-transfer-scale bodies (>= 4 MB; round-scale payloads
+    decode inline — the executor hop costs more than it saves there).
+    0 = auto: ``streams`` x live endpoints, capped at 8. Distributed via
+    ``Welcome`` like every other
+    section, so the whole cluster agrees on one stream count — a cluster
+    left at the ``streams=1`` default speaks the PR-8 wire byte for byte
+    (the version-skew contract, pinned in tests/test_multistream.py).
+    """
+
+    streams: int = 1
+    pump_pool: int = 0
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.streams <= 16:
+            raise ValueError(
+                f"streams must be in [1, 16], got {self.streams}"
+            )
+        if not 0 <= self.pump_pool <= 64:
+            raise ValueError(
+                f"pump_pool must be in [0, 64], got {self.pump_pool}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosConfig:
     """Deterministic fault injection for the transports (control/chaos.py).
 
@@ -296,6 +336,9 @@ class AllreduceConfig:
     master: MasterConfig = dataclasses.field(default_factory=MasterConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     adapt: AdaptConfig = dataclasses.field(default_factory=AdaptConfig)
+    data_plane: DataPlaneConfig = dataclasses.field(
+        default_factory=DataPlaneConfig
+    )
 
     @classmethod
     def from_json(cls, text: str) -> "AllreduceConfig":
@@ -309,6 +352,7 @@ class AllreduceConfig:
             "master": MasterConfig,
             "chaos": ChaosConfig,
             "adapt": AdaptConfig,
+            "data_plane": DataPlaneConfig,
         }
         unknown = set(raw) - set(sections)
         if unknown:

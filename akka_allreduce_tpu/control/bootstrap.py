@@ -166,6 +166,8 @@ class MasterProcess:
         self.transport = RemoteTransport(host, port)
         self.transport.wire_f16 = config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = config.master.retry
+        self.transport.streams = config.data_plane.streams
+        self.transport.pump_pool_size = config.data_plane.pump_pool
         if config.chaos.enabled:
             self._arm_chaos()
         # peer checkpoint registry (statetransfer, RESILIENCE.md "Recovery"):
@@ -634,6 +636,8 @@ class MasterProcess:
         # these knobs
         self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = self.config.master.retry
+        self.transport.streams = self.config.data_plane.streams
+        self.transport.pump_pool_size = self.config.data_plane.pump_pool
         if self.config.chaos.enabled and self.transport.chaos is None:
             self._arm_chaos()
             from akka_allreduce_tpu.control.chaos import MASTER_ROLE
@@ -1469,6 +1473,11 @@ class NodeProcess:
         # width (decode is stateless — the flag travels per frame)
         self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = self.config.master.retry
+        # the data-plane shard count arrives the same way: connections made
+        # BEFORE Welcome (the join itself) were legacy stream-0 links and
+        # stay valid; new payload senders stripe from here on
+        self.transport.streams = self.config.data_plane.streams
+        self.transport.pump_pool_size = self.config.data_plane.pump_pool
         self.node_id = msg.node_id
         dims = self.config.master.dimensions
         if self.config.chaos.enabled:

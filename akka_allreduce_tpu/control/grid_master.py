@@ -106,13 +106,18 @@ class GridMaster:
         if not self.nodes:
             # cluster emptied: fold the dying configuration's progress and
             # round high-water mark exactly as _organize would, so a later
-            # repopulation neither undercounts nor reuses round numbers
-            self.resume_round = max(
-                lm.next_round for lm in self.line_masters.values()
-            )
-            self._completed_before_reorg += sum(
-                lm.total_completed for lm in self.line_masters.values()
-            )
+            # repopulation neither undercounts nor reuses round numbers.
+            # A promoted standby can reach here with ZERO live lines
+            # (takeover marks the grid organized before any re-join lands,
+            # then the detector expels the last known member) — its
+            # digest-carried resume_round is already the high-water mark.
+            if self.line_masters:
+                self.resume_round = max(
+                    lm.next_round for lm in self.line_masters.values()
+                )
+                self._completed_before_reorg += sum(
+                    lm.total_completed for lm in self.line_masters.values()
+                )
             self.organized = False
             for lm in self.line_masters.values():
                 lm.abandon_open_spans()

@@ -703,6 +703,96 @@ def encode_frame(
     )
 
 
+# -- multi-stream preamble and frame sizing ------------------------------------
+#
+# With DataPlaneConfig.streams > 1 a transport opens N sockets per peer
+# endpoint. Every such connection opens with a PREAMBLE so the receive side
+# knows (a) this is a stream connection, (b) which stream it is, and (c) the
+# sender's canonical endpoint (for per-endpoint rx telemetry — the TCP
+# peername carries an ephemeral port). The magic's first four bytes are
+# 0xFFFFFFFF — as a legacy length prefix that is ~16x over
+# ``RemoteTransport.max_frame_bytes``, so no valid legacy frame can ever
+# start with it: one 4-byte peek disambiguates the two framings, and a
+# legacy (streams=1 / pre-streams) connection is byte-identical to PR-8.
+#
+# Frames on payload streams (stream_id >= 1) are framed
+# ``[u32 body_len][u32 seq][body]`` — the per-stream sequence number is
+# framing, not message bytes, so the message wire format (tags, checksums,
+# trace trailer) is untouched. Stream 0 keeps legacy ``[u32 len][body]``
+# framing after its preamble: control ordering rides one FIFO socket.
+
+STREAM_MAGIC = b"\xff\xff\xff\xffAWS1"
+_PREAMBLE_FIXED = struct.Struct("<HHHH")  # stream_id, total, port, host_len
+
+
+def encode_stream_preamble(
+    stream_id: int, total_streams: int, host: str, port: int
+) -> bytes:
+    """``[magic 8][u16 stream_id][u16 total][u16 port][u16 host_len][host]``."""
+    raw = host.encode("utf-8")
+    return (
+        STREAM_MAGIC
+        + _PREAMBLE_FIXED.pack(stream_id, total_streams, port, len(raw))
+        + raw
+    )
+
+
+def parse_stream_preamble(buf: memoryview):
+    """``(stream_id, total, host, port, consumed) | None`` (need more bytes).
+
+    The caller has already matched :data:`STREAM_MAGIC`'s first 4 bytes;
+    a full-magic mismatch raises ``ValueError`` (protocol error — close)."""
+    if len(buf) < 8:
+        return None
+    if bytes(buf[:8]) != STREAM_MAGIC:
+        raise ValueError("bad stream preamble magic")
+    if len(buf) < 16:
+        return None
+    stream_id, total, port, host_len = _PREAMBLE_FIXED.unpack_from(buf, 8)
+    if host_len > 1024:
+        # no real hostname; also keeps the preamble well under the receive
+        # ring so an incomplete one can always finish buffering
+        raise ValueError(f"stream preamble host_len {host_len} implausible")
+    if len(buf) < 16 + host_len:
+        return None
+    host = bytes(buf[16 : 16 + host_len]).decode("utf-8")
+    return stream_id, total, host, port, 16 + host_len
+
+
+def payload_frame_nbytes(
+    dest: str, msg: Any, mode: str, has_trace: bool
+) -> int:
+    """Exact byte size of ``encode_frame_parts(dest, msg, ...)`` for a
+    payload message (ScatterBlock / ReduceBlock) WITHOUT encoding it — the
+    deferred-encode senders charge backpressure accounting at enqueue time,
+    before the sender thread runs the actual encode + checksum pass.
+
+    NB this is the size of the ENCODED PARTS (length prefix + body); the
+    4-byte per-stream seq header is connection framing stamped by the
+    sender thread, and the caller accounts for it (+4 per frame)."""
+    tag = _TAGS[type(msg)]
+    if tag == 2:
+        header = 1 + 20 + 8  # tag + <iiiq> + count word + checksum
+    elif tag == 3:
+        header = 1 + 24 + 8  # tag + <iiiqi> + count word + checksum
+    else:  # non-payload messages never take the deferred path
+        raise ValueError(f"not a payload frame tag: {tag}")
+    n = msg.value.size
+    if mode == "f16":
+        payload = 2 * n
+    elif mode == "int8":
+        payload = 4 + n  # f32 scale + i8 elements
+    else:
+        payload = 4 * n
+    return (
+        4  # u32 length prefix
+        + 2 + len(dest.encode("utf-8"))
+        + header
+        + payload
+        + (_TRACE_LEN if has_trace else 0)
+    )
+
+
 def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:
     """Inverse of ``encode_frame`` minus the length prefix."""
     dest, msg, _ = decode_frame_body_ex(body)

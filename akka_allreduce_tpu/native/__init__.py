@@ -32,7 +32,7 @@ _SRC_PATHS = [
 ]
 _SRC_PATH = _SRC_PATHS[0]  # sentinel the build/test machinery stats
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lib = None
 _lock = threading.Lock()
@@ -119,6 +119,17 @@ def _bind(lib) -> None:
     lib.aw_pack_block.restype = ctypes.c_int
     lib.aw_unpack_block.argtypes = [_u8p, ctypes.c_int64, _i64p]
     lib.aw_unpack_block.restype = ctypes.c_int64
+    lib.aw_have_sendmmsg.argtypes = []
+    lib.aw_have_sendmmsg.restype = ctypes.c_int
+    _u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.aw_sendmmsg.argtypes = [
+        ctypes.c_int, _u64p, _i64p, _i32p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.aw_sendmmsg.restype = ctypes.c_int64
+    lib.aw_recvmmsg.argtypes = [
+        ctypes.c_int, _u64p, _i64p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.aw_recvmmsg.restype = ctypes.c_int64
 
 
 def _load(*, build_wait: bool = False, _retried: bool = False):
@@ -444,3 +455,104 @@ def unpack_block(body) -> tuple[int, int, int, int, int, int, bool, int]:
     if wire_checksum(mv[off : off + nbytes]) != ck:
         raise ValueError("payload checksum mismatch")
     return (src, dest, chunk, rnd, count, n_elems, is_f16, off)
+
+
+# -- batch syscalls (wire.cpp aw_sendmmsg/aw_recvmmsg) -------------------------
+#
+# The multi-stream senders drain a burst of frames in one syscall per stream.
+# Wire bytes are IDENTICAL either path (batching is pure syscall coalescing);
+# the plain sendmsg loop is compiled in unconditionally and selected at
+# runtime — by the kernel's ENOSYS answer, or by force_fallback for the
+# byte-identity pin in tests.
+
+
+def batch_send_available() -> bool:
+    """True iff the native batch-send entry point is loadable (the Python
+    caller keeps its own socket.sendmsg loop for when it is not)."""
+    return _load() is not None
+
+
+def sendmmsg_available() -> bool:
+    """True iff the RUNNING kernel implements sendmmsg (runtime probe);
+    False also when the native library itself is unavailable."""
+    lib = _load()
+    return bool(lib is not None and lib.aw_have_sendmmsg())
+
+
+def _iovec_arrays(views: list) -> tuple[np.ndarray, np.ndarray, list]:
+    """(bases u64, lens i64, keepalive) for a flat list of buffer views.
+
+    The keepalive list pins the np.frombuffer wrappers (and thus the
+    addresses) for the duration of the syscall."""
+    keep = []
+    bases = np.empty(len(views), dtype=np.uint64)
+    lens = np.empty(len(views), dtype=np.int64)
+    for i, v in enumerate(views):
+        arr = np.frombuffer(v, dtype=np.uint8)
+        keep.append(arr)
+        bases[i] = arr.ctypes.data
+        lens[i] = arr.nbytes
+    return bases, lens, keep
+
+
+def batch_send(fd: int, frames: list[list], *, force_fallback: bool = False) -> int:
+    """Send ``frames`` (each a list of buffer segments) on connected stream
+    socket ``fd`` in one ``sendmmsg`` (or the runtime-selected ``sendmsg``
+    loop). Returns bytes sent — short counts and partial trailing frames
+    are normal; the caller advances its views and re-enters. Raises
+    ``BlockingIOError`` when nothing could be sent (EAGAIN) and ``OSError``
+    for other errnos; ``RuntimeError`` when the native library is absent
+    (query :func:`batch_send_available` first)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native wire library unavailable")
+    flat: list = []
+    counts = np.empty(len(frames), dtype=np.int32)
+    for i, parts in enumerate(frames):
+        parts = [p for p in parts if len(p)]
+        counts[i] = len(parts)
+        flat.extend(parts)
+    bases, lens, _keep = _iovec_arrays(flat)
+    n = int(
+        lib.aw_sendmmsg(
+            fd,
+            bases.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(_i64p),
+            counts.ctypes.data_as(_i32p),
+            len(frames),
+            1 if force_fallback else 0,
+        )
+    )
+    if n < 0:
+        import errno as _errno
+
+        if -n in (_errno.EAGAIN, _errno.EWOULDBLOCK):
+            raise BlockingIOError(-n, os.strerror(-n))
+        raise OSError(-n, os.strerror(-n))
+    return n
+
+
+def batch_recv(fd: int, bufs: list, *, force_fallback: bool = False) -> int:
+    """Receive into ``bufs`` (writable buffers, filled in order) from
+    stream socket ``fd`` via ``recvmmsg`` (or the recvmsg loop). Returns
+    total bytes read (0 = orderly EOF); raises like :func:`batch_send`."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native wire library unavailable")
+    bases, lens, _keep = _iovec_arrays(bufs)
+    n = int(
+        lib.aw_recvmmsg(
+            fd,
+            bases.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(_i64p),
+            len(bufs),
+            1 if force_fallback else 0,
+        )
+    )
+    if n < 0:
+        import errno as _errno
+
+        if -n in (_errno.EAGAIN, _errno.EWOULDBLOCK):
+            raise BlockingIOError(-n, os.strerror(-n))
+        raise OSError(-n, os.strerror(-n))
+    return n

@@ -16,6 +16,14 @@
 // Compiled into the same .so as threshold_reduce.cpp (one loader, one ABI).
 
 #include <cstdint>
+#include <cstring>
+
+#if defined(__linux__) || defined(__APPLE__)
+#define AW_HAVE_SOCKETS 1
+#include <cerrno>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#endif
 
 namespace {
 
@@ -124,6 +132,187 @@ int64_t aw_unpack_block(const uint8_t* body, int64_t nbytes, int64_t* out) {
   if (payload_bytes > nbytes - off) return -1;
   if (aw_checksum(body + off, payload_bytes) != checksum) return -2;
   return off;
+}
+
+}  // extern "C"
+
+// -- batch syscalls (multi-stream data plane, BENCHMARKS.md round 8) ---------
+//
+// One coalesced burst of frames drains in ONE syscall per stream:
+// `aw_sendmmsg` maps a (bases, lens, counts) flattening of per-frame iovec
+// lists onto Linux's sendmmsg(2) — message m owns counts[m] consecutive
+// iovecs — and `aw_recvmmsg` is its receive-side mirror over recvmmsg(2).
+// Neither changes a single wire byte: batching is pure syscall coalescing,
+// and the plain sendmsg/recvmsg LOOP fallback below is compiled in
+// unconditionally and selected at RUNTIME (first-call ENOSYS probe, or
+// use_fallback=1 for tests pinning byte-identical output). Both return
+// total bytes moved (callers advance their views and re-enter — short
+// counts and partial trailing messages are normal on stream sockets), or
+// -errno when nothing moved.
+
+#if AW_HAVE_SOCKETS
+namespace {
+
+constexpr int kMaxBatchMsgs = 64;
+constexpr int kMaxBatchIovs = 1024;
+
+int64_t sendmsg_loop(int fd, const uint64_t* bases, const int64_t* lens,
+                     const int32_t* counts, int32_t nmsgs) {
+  int64_t total = 0;
+  int64_t iov_off = 0;
+  for (int32_t m = 0; m < nmsgs; ++m) {
+    struct iovec iov[kMaxBatchIovs];
+    int32_t cnt = counts[m];
+    if (cnt > kMaxBatchIovs) return total > 0 ? total : -EINVAL;
+    int64_t want = 0;
+    for (int32_t i = 0; i < cnt; ++i) {
+      iov[i].iov_base = (void*)(uintptr_t)bases[iov_off + i];
+      iov[i].iov_len = (size_t)lens[iov_off + i];
+      want += lens[iov_off + i];
+    }
+    struct msghdr hdr;
+    memset(&hdr, 0, sizeof(hdr));
+    hdr.msg_iov = iov;
+    hdr.msg_iovlen = cnt;
+    ssize_t n = sendmsg(fd, &hdr, 0);
+    if (n < 0) return total > 0 ? total : -(int64_t)errno;
+    total += n;
+    if (n < want) break;  // kernel buffer full mid-frame: caller re-enters
+    iov_off += cnt;
+  }
+  return total;
+}
+
+}  // namespace
+#endif  // AW_HAVE_SOCKETS
+
+extern "C" {
+
+// 1 iff the running kernel implements sendmmsg/recvmmsg (runtime probe, not
+// a compile-time guess — the batch path must degrade on kernels/libcs that
+// compiled fine but answer ENOSYS).
+int aw_have_sendmmsg(void) {
+#if defined(__linux__)
+  static int cached = -1;
+  if (cached < 0) {
+    struct mmsghdr hdr;
+    memset(&hdr, 0, sizeof(hdr));
+    // fd -1 never touches a real socket: an implemented syscall answers
+    // EBADF, an unimplemented one ENOSYS
+    int r = sendmmsg(-1, &hdr, 1, 0);
+    cached = (r >= 0 || errno != ENOSYS) ? 1 : 0;
+  }
+  return cached;
+#else
+  return 0;
+#endif
+}
+
+// Batch send: nmsgs messages, message m owning counts[m] iovecs taken in
+// order from (bases, lens). Returns total bytes written, or -errno when
+// nothing was written. use_fallback != 0 forces the sendmsg loop.
+int64_t aw_sendmmsg(int fd, const uint64_t* bases, const int64_t* lens,
+                    const int32_t* counts, int32_t nmsgs,
+                    int32_t use_fallback) {
+#if !AW_HAVE_SOCKETS
+  (void)fd; (void)bases; (void)lens; (void)counts; (void)nmsgs;
+  (void)use_fallback;
+  return -38;  // ENOSYS
+#else
+  if (nmsgs <= 0) return 0;
+#if defined(__linux__)
+  if (!use_fallback && aw_have_sendmmsg()) {
+    struct mmsghdr hdrs[kMaxBatchMsgs];
+    struct iovec iov[kMaxBatchIovs];
+    int32_t n = nmsgs < kMaxBatchMsgs ? nmsgs : kMaxBatchMsgs;
+    int64_t iov_off = 0;
+    int32_t built = 0;
+    for (; built < n; ++built) {
+      int32_t cnt = counts[built];
+      if (iov_off + cnt > kMaxBatchIovs) break;
+      memset(&hdrs[built], 0, sizeof(hdrs[built]));
+      for (int32_t i = 0; i < cnt; ++i) {
+        iov[iov_off + i].iov_base = (void*)(uintptr_t)bases[iov_off + i];
+        iov[iov_off + i].iov_len = (size_t)lens[iov_off + i];
+      }
+      hdrs[built].msg_hdr.msg_iov = &iov[iov_off];
+      hdrs[built].msg_hdr.msg_iovlen = cnt;
+      iov_off += cnt;
+    }
+    if (built > 0) {
+      int r = sendmmsg(fd, hdrs, built, 0);
+      if (r < 0) return -(int64_t)errno;
+      int64_t total = 0;
+      for (int i = 0; i < r; ++i) total += (int64_t)hdrs[i].msg_len;
+      return total;
+    }
+    // first message alone overflows the iovec budget: fall through
+  }
+#endif  // __linux__
+  return sendmsg_loop(fd, bases, lens, counts, nmsgs);
+#endif  // AW_HAVE_SOCKETS
+}
+
+// Batch receive: fill up to nbufs buffers (one iovec each) in order.
+// Returns total bytes read (a short tail buffer is normal on stream
+// sockets), 0 on orderly EOF, or -errno when nothing was read.
+int64_t aw_recvmmsg(int fd, const uint64_t* bases, const int64_t* lens,
+                    int32_t nbufs, int32_t use_fallback) {
+#if !AW_HAVE_SOCKETS
+  (void)fd; (void)bases; (void)lens; (void)nbufs; (void)use_fallback;
+  return -38;  // ENOSYS
+#else
+  if (nbufs <= 0) return 0;
+#if defined(__linux__)
+  // Some kernels/sandboxes (e.g. gVisor) implement recvmmsg but reject
+  // MSG_WAITFORONE with EINVAL — a second RUNTIME probe, cached like the
+  // ENOSYS one: first EINVAL answer routes every later call to the loop.
+  static int waitforone_broken = 0;
+  if (!use_fallback && !waitforone_broken && aw_have_sendmmsg()) {
+    struct mmsghdr hdrs[kMaxBatchMsgs];
+    struct iovec iov[kMaxBatchMsgs];
+    int32_t n = nbufs < kMaxBatchMsgs ? nbufs : kMaxBatchMsgs;
+    for (int32_t i = 0; i < n; ++i) {
+      iov[i].iov_base = (void*)(uintptr_t)bases[i];
+      iov[i].iov_len = (size_t)lens[i];
+      memset(&hdrs[i], 0, sizeof(hdrs[i]));
+      hdrs[i].msg_hdr.msg_iov = &iov[i];
+      hdrs[i].msg_hdr.msg_iovlen = 1;
+    }
+    // MSG_WAITFORONE: block for the FIRST message only — on a blocking
+    // socket a bare recvmmsg would otherwise wait for all n, hanging a
+    // caller whose stream holds fewer bytes than the buffer set
+    int r = recvmmsg(fd, hdrs, n, MSG_WAITFORONE, nullptr);
+    if (r >= 0) {
+      int64_t total = 0;
+      for (int i = 0; i < r; ++i) total += (int64_t)hdrs[i].msg_len;
+      return total;
+    }
+    if (errno != EINVAL) return -(int64_t)errno;
+    waitforone_broken = 1;  // fall through to the recvmsg loop
+  }
+#endif  // __linux__
+  int64_t total = 0;
+  for (int32_t i = 0; i < nbufs; ++i) {
+    struct iovec one;
+    one.iov_base = (void*)(uintptr_t)bases[i];
+    one.iov_len = (size_t)lens[i];
+    struct msghdr hdr;
+    memset(&hdr, 0, sizeof(hdr));
+    hdr.msg_iov = &one;
+    hdr.msg_iovlen = 1;
+    // mirror MSG_WAITFORONE: only the first recv may block
+    ssize_t got = recvmsg(fd, &hdr, i == 0 ? 0 : MSG_DONTWAIT);
+    if (got < 0) {
+      if (total > 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+        return total;
+      return total > 0 ? total : -(int64_t)errno;
+    }
+    total += got;
+    if (got < (ssize_t)one.iov_len) break;  // short read: stream drained
+  }
+  return total;
+#endif  // AW_HAVE_SOCKETS
 }
 
 }  // extern "C"

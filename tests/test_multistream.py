@@ -1,0 +1,522 @@
+"""Multi-stream host data plane (ISSUE 9, BENCHMARKS.md round 8).
+
+Covers the sharded transport end to end: stream preamble + sequence framing,
+chunk-id striping across payload streams, out-of-order cross-stream
+reassembly equivalence against ``streams=1`` (under the chaos reorder
+fault), the version-skew pin (``streams=1`` stays byte-identical to the
+legacy wire, a config without the ``data_plane`` section parses, a
+legacy-framing peer talks to a streams-capable receiver), the runtime
+``sendmmsg`` fallback's byte identity, per-endpoint bandwidth telemetry,
+and a full in-process cluster round-trip with ``streams=2``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu import native
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    DataPlaneConfig,
+    LineMasterConfig,
+    MasterConfig,
+    MetaDataConfig,
+)
+from akka_allreduce_tpu.control import wire
+from akka_allreduce_tpu.control.bootstrap import MasterProcess, NodeProcess
+from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.control.remote import RemoteTransport
+from akka_allreduce_tpu.protocol import AllReduceInput, ScatterBlock
+
+
+async def wait_until(pred, timeout: float = 20.0) -> None:
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not pred():
+        if loop.time() > deadline:
+            raise TimeoutError("condition not reached")
+        await asyncio.sleep(0.005)
+
+
+# --- preamble + config plumbing ----------------------------------------------
+
+
+def test_stream_preamble_roundtrip():
+    pre = wire.encode_stream_preamble(3, 4, "10.1.2.3", 45000)
+    got = wire.parse_stream_preamble(memoryview(pre))
+    assert got == (3, 4, "10.1.2.3", 45000, len(pre))
+    # incomplete prefixes ask for more bytes instead of mis-parsing
+    for cut in (0, 4, 8, 12, 15, len(pre) - 1):
+        assert wire.parse_stream_preamble(memoryview(pre)[:cut]) is None
+    # the magic's length prefix can never be a legal legacy frame length
+    (as_len,) = wire._U32.unpack_from(wire.STREAM_MAGIC, 0)
+    assert as_len > RemoteTransport.max_frame_bytes
+    with pytest.raises(ValueError):
+        wire.parse_stream_preamble(memoryview(b"\xff\xff\xff\xffXXXX" + b"\x00" * 8))
+
+
+def test_data_plane_config_via_welcome_json_and_version_skew_default():
+    cfg = AllreduceConfig(data_plane=DataPlaneConfig(streams=4, pump_pool=3))
+    back = AllreduceConfig.from_json(cfg.to_json())
+    assert back.data_plane.streams == 4 and back.data_plane.pump_pool == 3
+    # version skew: a Welcome from a master that predates the data_plane
+    # section parses and lands on streams=1 — the node negotiates DOWN to
+    # the legacy wire, nothing breaks
+    import json
+
+    raw = json.loads(cfg.to_json())
+    del raw["data_plane"]
+    old = AllreduceConfig.from_json(json.dumps(raw))
+    assert old.data_plane.streams == 1
+    with pytest.raises(ValueError):
+        DataPlaneConfig(streams=0)
+    with pytest.raises(ValueError):
+        DataPlaneConfig(streams=17)
+
+
+def test_payload_frame_nbytes_exact():
+    """The deferred-encode backpressure charge must match the real encode."""
+    from akka_allreduce_tpu.obs.trace import TraceContext
+    from akka_allreduce_tpu.protocol import ReduceBlock
+
+    value = np.arange(1000, dtype=np.float32)
+    tctx = TraceContext(1, 2, True)
+    for msg in (
+        ScatterBlock(value, 1, 2, 3, 4),
+        ReduceBlock(value, 1, 2, 3, 4, count=5),
+    ):
+        for mode in ("f32", "f16", "int8"):
+            for trace in (None, tctx):
+                parts = wire.encode_frame_parts(
+                    "worker:12", msg, wire=mode, trace=trace
+                )
+                want = sum(len(p) for p in parts)
+                got = wire.payload_frame_nbytes(
+                    "worker:12", msg, mode, trace is not None
+                )
+                assert got == want, (mode, trace)
+
+
+# --- transport-level striping and reassembly ---------------------------------
+
+
+def _payload_transports(streams: int):
+    rx, tx = RemoteTransport(), RemoteTransport()
+    rx.streams = streams
+    tx.streams = streams
+    return rx, tx
+
+
+def test_striping_across_streams_and_telemetry():
+    """Payload frames stripe across streams 1..N-1 by chunk id; control
+    stays on stream 0; every payload decodes identically; the bandwidth
+    gauges land in the registry snapshot."""
+
+    async def run():
+        rx, tx = _payload_transports(3)
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            vals = [
+                np.arange(20_000, dtype=np.float32) + i for i in range(10)
+            ]
+            for i, v in enumerate(vals):
+                await tx.send(Envelope("sink", ScatterBlock(v, 0, 1, i, 1)))
+            await wait_until(lambda: len(got) == 10)
+            by_chunk = {m.chunk_id: m.value for m in got}
+            for i, v in enumerate(vals):
+                np.testing.assert_array_equal(by_chunk[i], v)
+            # chunk i rides stream 1 + (i % 2): both payload streams opened
+            opened = sorted(s for (_ep, s) in tx._senders)
+            assert opened == [1, 2]
+            # the receive side identified both inbound payload streams
+            assert list(rx._rx_streams.values()) == [2]
+            key = f"{tx.endpoint.host}:{tx.endpoint.port}"
+            assert rx.endpoint_rx[key] > 10 * 20_000 * 4
+            txkey = f"{ep.host}:{ep.port}"
+            assert tx.endpoint_tx[txkey] > 10 * 20_000 * 4
+            from akka_allreduce_tpu.obs import metrics as obs_metrics
+
+            snap = obs_metrics.REGISTRY.snapshot()
+            assert snap[f"transport.endpoint.{txkey}.tx_bytes"] > 0
+            assert snap[f"transport.endpoint.{key}.rx_bytes"] > 0
+            assert snap[f"transport.endpoint.{key}.stream_count"] == 2
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_out_of_order_reassembly_matches_streams1():
+    """Property (ISSUE 9): striped frames arriving out of order across
+    streams decode to the same payload bytes as streams=1. The chaos
+    reorder+delay faults supply the out-of-order arrival — every stream of
+    the endpoint is interposed on, because the injector hooks ``send()``
+    BEFORE stream selection."""
+    from akka_allreduce_tpu.control.chaos import ChaosInjector
+
+    def run_leg(streams: int) -> dict[int, bytes]:
+        async def run():
+            rx, tx = _payload_transports(streams)
+            tx.chaos = ChaosInjector(
+                99, "reorder:p=0.5;delay:ms=5", role=0
+            )
+            got: list = []
+            rx.register("sink", lambda m: got.append(m) or [])
+            ep = await rx.start()
+            await tx.start()
+            tx.set_route("sink", ep)
+            try:
+                rng = np.random.default_rng(5)
+                vals = [
+                    rng.standard_normal(8_192).astype(np.float32)
+                    for _ in range(12)
+                ]
+                for i, v in enumerate(vals):
+                    await tx.send(
+                        Envelope("sink", ScatterBlock(v, 0, 1, i, 1))
+                    )
+                await wait_until(lambda: len(got) == 12)
+                assert tx.chaos.counts().get("reorder", 0) > 0
+                return {
+                    m.chunk_id: np.asarray(m.value).tobytes() for m in got
+                }
+            finally:
+                await tx.stop()
+                await rx.stop()
+
+        return asyncio.run(run())
+
+    multi = run_leg(4)
+    single = run_leg(1)
+    assert multi == single  # same chunks, same payload bytes
+
+
+def test_stream_seq_gap_is_counted_not_fatal():
+    """A sequence gap on a payload stream (a peer reconnect dropped frames
+    mid-stream) is counted and resynchronized — at-most-once absorbs it."""
+
+    async def run():
+        from akka_allreduce_tpu.obs import metrics as obs_metrics
+
+        rx = RemoteTransport()
+        rx.streams = 2
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        gaps0 = obs_metrics.REGISTRY.snapshot().get(
+            "transport.stream_seq_gaps", 0
+        )
+        try:
+            reader = socket.create_connection((ep.host, ep.port))
+            reader.sendall(wire.encode_stream_preamble(1, 2, "127.0.0.1", 1))
+            value = np.arange(100, dtype=np.float32)
+            body = wire.encode_frame("sink", ScatterBlock(value, 0, 1, 0, 1))
+            frame = body[:4] + wire._U32.pack(0) + body[4:]
+            reader.sendall(frame)
+            # seq jumps 0 -> 7: a gap, logged + counted, frame still lands
+            frame2 = body[:4] + wire._U32.pack(7) + body[4:]
+            reader.sendall(frame2)
+            await wait_until(lambda: len(got) == 2)
+            gaps = obs_metrics.REGISTRY.snapshot()["transport.stream_seq_gaps"]
+            assert gaps == gaps0 + 1
+            reader.close()
+            # the expectation SURVIVES the connection: a rebuilt sender
+            # restarting at seq=0 on a FRESH connection (the dead-letter
+            # rebuild — the only way real frames are lost) is the
+            # discontinuity this counter exists for
+            reader2 = socket.create_connection((ep.host, ep.port))
+            reader2.sendall(
+                wire.encode_stream_preamble(1, 2, "127.0.0.1", 1)
+            )
+            reader2.sendall(body[:4] + wire._U32.pack(0) + body[4:])
+            await wait_until(lambda: len(got) == 3)
+            gaps = obs_metrics.REGISTRY.snapshot()["transport.stream_seq_gaps"]
+            assert gaps == gaps0 + 2  # expected 8 (after 7), got 0
+            reader2.close()
+        finally:
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+# --- version-skew pins --------------------------------------------------------
+
+
+def test_streams1_wire_byte_identical_to_legacy():
+    """The whole point of the default: a streams=1 transport puts EXACTLY
+    the PR-8 bytes on the wire — no preamble, no sequence headers."""
+
+    async def run():
+        captured = bytearray()
+        done = asyncio.Event()
+
+        async def sink(reader, writer):
+            while True:
+                chunk = await reader.read(1 << 16)
+                if not chunk:
+                    break
+                captured.extend(chunk)
+                if len(captured) >= expected_len:
+                    done.set()
+
+        server = await asyncio.start_server(sink, "127.0.0.1", 0)
+        host, port = server.sockets[0].getsockname()[:2]
+        from akka_allreduce_tpu.control.cluster import Endpoint
+
+        tx = RemoteTransport()
+        await tx.start()
+        tx.set_route("sink", Endpoint(host, port))
+        value = np.arange(5_000, dtype=np.float32)
+        msg = ScatterBlock(value, 3, 1, 2, 9)
+        expected = wire.encode_frame("sink", msg)
+        expected_len = len(expected)
+        try:
+            await tx.send(Envelope("sink", msg, trace=None))
+            await asyncio.wait_for(done.wait(), 10.0)
+            assert bytes(captured) == expected
+        finally:
+            await tx.stop()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_legacy_peer_talks_to_streams_capable_receiver():
+    """Skew, other direction: a legacy (streams=1) sender against a
+    receiver whose cluster runs streams=4 — the receiver sniffs legacy
+    framing per connection and everything decodes."""
+
+    async def run():
+        rx = RemoteTransport()
+        rx.streams = 4  # receiver is streams-capable
+        tx = RemoteTransport()  # legacy peer: default streams=1
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            value = np.arange(30_000, dtype=np.float32)
+            await tx.send(Envelope("sink", ScatterBlock(value, 0, 1, 5, 2)))
+            await wait_until(lambda: len(got) == 1)
+            np.testing.assert_array_equal(got[0].value, value)
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+# --- native batch syscalls ----------------------------------------------------
+
+
+@pytest.mark.skipif(
+    not native.batch_send_available(), reason="native wire library not built"
+)
+def test_sendmmsg_fallback_byte_identical():
+    """Runtime-fallback pin (ISSUE 9 CI satellite): the sendmsg-loop
+    fallback puts byte-identical data on the wire vs the sendmmsg batch
+    path, for the same frame mix."""
+    rng = np.random.default_rng(11)
+    frames = []
+    for i in range(7):
+        value = rng.standard_normal(500 + 100 * i).astype(np.float32)
+        parts = wire.encode_frame_parts(f"worker:{i}", ScatterBlock(value, 0, 1, i, 1))
+        frames.append([memoryview(bytes(p)) for p in parts])
+    want = b"".join(bytes(v) for f in frames for v in f)
+
+    def send_leg(force_fallback: bool) -> bytes:
+        a, b = socket.socketpair()
+        try:
+            a.setblocking(True)
+            sent = 0
+            work = [list(f) for f in frames]
+            while work:
+                n = native.batch_send(
+                    a.fileno(), work, force_fallback=force_fallback
+                )
+                sent += n
+                while n and work:
+                    head = work[0]
+                    while n and head:
+                        seg = head[0]
+                        if n >= len(seg):
+                            n -= len(seg)
+                            head.pop(0)
+                        else:
+                            head[0] = seg[n:]
+                            n = 0
+                    if not head:
+                        work.pop(0)
+            out = bytearray()
+            b.setblocking(False)
+            while True:
+                try:
+                    chunk = b.recv(1 << 16)
+                except BlockingIOError:
+                    break
+                if not chunk:
+                    break
+                out.extend(chunk)
+            return bytes(out)
+        finally:
+            a.close()
+            b.close()
+
+    assert send_leg(False) == want
+    assert send_leg(True) == want
+
+
+@pytest.mark.skipif(
+    not native.batch_send_available(), reason="native wire library not built"
+)
+def test_batch_recv_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        blob = bytes(range(256)) * 64
+        a.sendall(blob)
+        bufs = [bytearray(4096) for _ in range(8)]
+        got = bytearray()
+        while len(got) < len(blob):
+            n = native.batch_recv(b.fileno(), bufs)
+            assert n > 0
+            flat = b"".join(bytes(x) for x in bufs)[:n]
+            got.extend(flat)
+        assert bytes(got) == blob
+    finally:
+        a.close()
+        b.close()
+
+
+# --- full cluster -------------------------------------------------------------
+
+
+def _cluster_cfg(streams: int, rounds: int = 6) -> AllreduceConfig:
+    return AllreduceConfig(
+        metadata=MetaDataConfig(data_size=120_000, max_chunk_size=20_000),
+        line_master=LineMasterConfig(max_rounds=rounds),
+        master=MasterConfig(node_num=2),
+        data_plane=DataPlaneConfig(streams=streams),
+    )
+
+
+def test_cluster_rounds_complete_with_streams2():
+    """In-process master + 2 nodes with streams=2 distributed via Welcome:
+    the round budget completes, the numeric oracle holds, and payload
+    frames demonstrably rode the payload streams."""
+
+    async def run():
+        master = MasterProcess(_cluster_cfg(2), "127.0.0.1", 0)
+        ep = await master.start()
+        outs: dict[int, list] = {0: [], 1: []}
+        nodes = []
+        for k in range(2):
+            payload = np.full(120_000, float(k + 1), dtype=np.float32)
+            node = NodeProcess(
+                ep,
+                lambda req, p=payload: AllReduceInput(p),
+                lambda o, k=k: outs[k].append(o),
+                "127.0.0.1",
+                0,
+            )
+            nodes.append(node)
+            await node.start()
+        try:
+            await master.run_until_done()
+            await wait_until(
+                lambda: len(outs[0]) == 6 and len(outs[1]) == 6
+            )
+            np.testing.assert_allclose(
+                outs[0][-1].average(), 1.5, rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                outs[1][-1].average(), 1.5, rtol=1e-6
+            )
+            for node in nodes:
+                # Welcome armed the stream count...
+                assert node.transport.streams == 2
+                # ...and payload senders actually striped onto stream 1
+                assert any(s == 1 for (_ep, s) in node.transport._senders)
+        finally:
+            for node in nodes:
+                await node.stop()
+            await master.stop()
+
+    asyncio.run(run())
+
+
+def test_cluster_under_chaos_with_streams2():
+    """Chaos satellite: drop/delay/reorder interpose on EVERY stream (the
+    hook sits before stream selection), and the cluster still completes
+    its budget over the multi-stream plane."""
+
+    async def run():
+        from akka_allreduce_tpu.config import ChaosConfig
+
+        cfg = AllreduceConfig(
+            metadata=MetaDataConfig(data_size=60_000, max_chunk_size=10_000),
+            line_master=LineMasterConfig(max_rounds=5),
+            master=MasterConfig(node_num=2),
+            data_plane=DataPlaneConfig(streams=2),
+            chaos=ChaosConfig(
+                seed=42, spec="drop:p=0.03;delay:ms=2;reorder:p=0.2"
+            ),
+        )
+        master = MasterProcess(cfg, "127.0.0.1", 0)
+        ep = await master.start()
+        outs: dict[int, list] = {0: [], 1: []}
+        nodes = []
+        for k in range(2):
+            payload = np.full(60_000, float(k + 1), dtype=np.float32)
+            node = NodeProcess(
+                ep,
+                lambda req, p=payload: AllReduceInput(p),
+                lambda o, k=k: outs[k].append(o),
+                "127.0.0.1",
+                0,
+            )
+            nodes.append(node)
+            await node.start()
+        try:
+            await master.run_until_done()
+            # generous: chaos delay/drop under a saturated shared box can
+            # stretch rounds well past the quiet-box ~1s this takes
+            await wait_until(lambda: len(outs[0]) >= 5, timeout=180.0)
+            # chaos hit traffic on this plane (injector sits above striping)
+            assert any(
+                n.transport.chaos is not None and n.transport.chaos.events
+                for n in nodes
+            )
+        finally:
+            for node in nodes:
+                await node.stop()
+            await master.stop()
+
+    asyncio.run(run())
+
+
+def test_chaos_event_log_deterministic_with_streams():
+    """Same seed + same traffic = byte-identical chaos event JSONL, with a
+    streams>1 transport — the injector's decision stream sits ABOVE stream
+    selection, so sharding the data plane cannot perturb it."""
+    from akka_allreduce_tpu.control.chaos import ChaosInjector
+
+    def one_run() -> str:
+        inj = ChaosInjector(7, "drop:p=0.2;reorder:p=0.3;corrupt:p=0.1", role=1)
+        rng = np.random.default_rng(3)
+        for i in range(50):
+            v = rng.standard_normal(64).astype(np.float32)
+            inj.plan_send(Envelope("worker:0", ScatterBlock(v, 1, 0, i, i // 4)))
+        return inj.event_log_jsonl()
+
+    assert one_run() == one_run()
