@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt
+.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -44,7 +44,8 @@ bench-wire:
 # seed replays the same per-process chaos event logs (chaos_run/*.jsonl).
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu chaos --seed 1234 \
-	  --duration 30 --nodes 3 --th 0.66 --streams 2 --out-dir chaos_run \
+	  --duration 30 --nodes 3 --th 0.66 --streams 2 --gossip \
+	  --out-dir chaos_run \
 	  --spec "drop:p=0.05;delay:ms=10;corrupt:p=0.02;partition:groups=m+0+1|2,at=10s,heal=8s"
 
 # fixed-seed crash + disk-loss recovery drill (RESILIENCE.md "Recovery"):
@@ -55,7 +56,8 @@ chaos:
 # same scenario inside tier-1.
 chaos-recover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-recover --seed 1234 --streams 2 --out-dir chaos_recover_run
+	  chaos-recover --seed 1234 --streams 2 --gossip \
+	  --out-dir chaos_recover_run
 
 # fixed-seed master-kill failover drill (RESILIENCE.md "Tier 4"): a seeded
 # chaos crash kills the LEADER mid-round; the warm standby must take over
@@ -64,7 +66,8 @@ chaos-recover:
 # failover must still peer-restore via the replicated holder registry.
 chaos-failover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-failover --seed 1234 --streams 2 --out-dir chaos_failover_run
+	  chaos-failover --seed 1234 --streams 2 --gossip \
+	  --out-dir chaos_failover_run
 
 # fixed-seed adaptive-degradation drill (RESILIENCE.md "Tier 5"): a seeded
 # staged straggler (windowed targeted delay + a stall burst) slows one
@@ -74,7 +77,17 @@ chaos-failover:
 # payloads, --uniform-check) must stay within the EF error budget.
 chaos-adapt:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-adapt --seed 1234 --streams 2 --out-dir chaos_adapt_run
+	  chaos-adapt --seed 1234 --streams 2 --gossip --out-dir chaos_adapt_run
+
+# fixed-seed decentralized-membership drill (RESILIENCE.md "Tier 6"): a
+# seeded ONE-DIRECTIONAL partition cuts one node's sends to the master
+# while SWIM gossip membership is armed — the indirect-probe path must
+# keep the healthy node in the cluster (zero expulsions, rounds keep
+# completing), and a node killed for real afterwards must still be
+# confirmed dead by the ring and expelled.
+chaos-gossip:
+	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
+	  chaos-gossip --seed 1234 --streams 2 --out-dir chaos_gossip_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -96,6 +96,45 @@ def _add_chaos_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_gossip_flags(p: argparse.ArgumentParser) -> None:
+    """SWIM gossip membership (control/gossip.py, RESILIENCE.md 'Tier 6').
+    Master-role flags: the section rides Welcome, so one flag switches the
+    whole cluster from hub heartbeats to decentralized probing."""
+    p.add_argument(
+        "--gossip", action="store_true",
+        help="decentralized membership: nodes probe each other (SWIM "
+        "ping / ping-req / suspicion) instead of all heartbeating into "
+        "the master's phi detector; the master consumes the gossip view",
+    )
+    p.add_argument(
+        "--gossip-interval", type=float, default=0.5, metavar="S",
+        help="gossip probe period in seconds (ack timeout is 0.3x this; "
+        "suspicion confirms after 4 unrefuted periods)",
+    )
+
+
+def _gossip_config_from(args):
+    import math
+
+    from akka_allreduce_tpu.config import GossipConfig
+
+    if not getattr(args, "gossip", False):
+        return GossipConfig()
+    interval = getattr(args, "gossip_interval", 0.5)
+    return GossipConfig(
+        enabled=True,
+        probe_interval_s=interval,
+        probe_timeout_s=interval * 0.3,
+        # keep the suspicion window >= ~2s regardless of the probe
+        # cadence: a short interval should mean fast PROBING, not a
+        # hair-trigger conviction — a loaded host can stall a healthy
+        # process past 1s (GIL, checkpoint fsync), and refutation needs
+        # time to travel
+        suspicion_periods=max(4, math.ceil(2.0 / interval)),
+        seed=getattr(args, "chaos_seed", 0),
+    )
+
+
 def _add_adapt_flags(p: argparse.ArgumentParser) -> None:
     """Closed-loop adaptive degradation (control/adapt.py, RESILIENCE.md
     'Tier 5'): the leader's per-round controller. Master-role flags only —
@@ -1025,6 +1064,12 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     )
     p.add_argument("--th", type=float, default=1.0, help="all three thresholds")
     p.add_argument("--heartbeat", type=float, default=1.0, help="interval (s)")
+    p.add_argument(
+        "--line-shards", type=int, default=1,
+        help="dims-1 round-scheduling shards: split the membership into "
+        "up to N LineMasters, each owning (and reducing within) a "
+        "contiguous worker subset (RESILIENCE.md 'Tier 6')",
+    )
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
     p.add_argument(
         "--round-deadline", type=float, default=0.0,
@@ -1035,6 +1080,7 @@ def _cmd_cluster_master(argv: list[str]) -> int:
     _add_data_plane_flags(p)
     _add_chaos_flags(p)
     _add_adapt_flags(p)
+    _add_gossip_flags(p)
     _add_obs_flags(p)
     args = p.parse_args(argv)
     from akka_allreduce_tpu.config import WorkerConfig
@@ -1089,6 +1135,7 @@ def _run_cluster_master(args) -> int:
         master=MasterConfig(
             node_num=args.nodes,
             dimensions=args.dims,
+            line_shards=getattr(args, "line_shards", 1),
             heartbeat_interval_s=args.heartbeat,
             round_deadline_s=getattr(args, "round_deadline", 0.0),
             retry=RetryPolicy(
@@ -1108,6 +1155,7 @@ def _run_cluster_master(args) -> int:
             streams=getattr(args, "streams", 1),
             pump_pool=getattr(args, "pump_pool", 0),
         ),
+        gossip=_gossip_config_from(args),
     )
     _install_obs(args)
 
@@ -2517,27 +2565,60 @@ def _drill_spawn(env):
     return spawn
 
 
-def _drill_full_rounds(path, workers: int) -> int:
-    """Completed line-rounds with FULL membership recorded in a master's
-    metrics JSONL — recovery progress only counts when every node is back
-    in the line. Tolerates the torn last line of a live writer."""
+def _add_drill_gossip_flags(p: argparse.ArgumentParser) -> None:
+    """Every chaos drill can run its cluster under SWIM gossip membership
+    instead of hub heartbeats (the Makefile pins --gossip on all of them,
+    like --streams 2): the drills then prove their scenario survives the
+    decentralized detector too."""
+    p.add_argument(
+        "--gossip", action="store_true",
+        help="arm SWIM gossip membership on the drill's cluster "
+        "(distributed via Welcome, RESILIENCE.md 'Tier 6')",
+    )
+    p.add_argument(
+        "--gossip-interval", type=float, default=0.25, metavar="S",
+        help="gossip probe period for the drill cluster",
+    )
+
+
+def _drill_gossip_args(args) -> list[str]:
+    """Extra cluster-master CLI args for a drill's master spawn."""
+    if not getattr(args, "gossip", False):
+        return []
+    return [
+        "--gossip", "--gossip-interval",
+        str(getattr(args, "gossip_interval", 0.25)),
+    ]
+
+
+def _drill_jsonl_records(path):
+    """Records of a (possibly live) metrics JSONL — the ONE torn-tolerant
+    reader every drill scan goes through: blank lines and the in-progress
+    writer's torn last line are skipped, never a traceback."""
     import json
     import os
 
     if not os.path.exists(path):
-        return 0
-    n = 0
+        return
     with open(path) as f:
         for ln in f:
             if not ln.strip():
                 continue
             try:
-                rec = json.loads(ln)
+                yield json.loads(ln)
             except ValueError:
                 continue  # the writer is mid-append
-            if rec.get("kind") == "round" and rec.get("workers") == workers:
-                n += 1
-    return n
+
+
+def _drill_full_rounds(path, workers: int) -> int:
+    """Completed line-rounds with FULL membership recorded in a master's
+    metrics JSONL — recovery progress only counts when every node is back
+    in the line."""
+    return sum(
+        1
+        for rec in _drill_jsonl_records(path)
+        if rec.get("kind") == "round" and rec.get("workers") == workers
+    )
 
 
 def _drill_phase_waiter(timeout_s: float, failures: list):
@@ -2820,6 +2901,7 @@ def _cmd_chaos(argv: list[str]) -> int:
         "under every injected fault",
     )
     p.add_argument("--out-dir", default="chaos_run")
+    _add_drill_gossip_flags(p)
     args = p.parse_args(argv)
     # fail fast on a malformed spec BEFORE spawning anything — a parse
     # error inside the master subprocess would surface as an opaque
@@ -2854,6 +2936,7 @@ def _cmd_chaos(argv: list[str]) -> int:
         "--streams", str(args.streams),
         "--chaos-seed", str(args.seed), "--chaos-spec", args.spec,
         "--chaos-log", master_log, "--metrics-out", metrics_path,
+        *_drill_gossip_args(args),
     )
     nodes = []
     t0 = time.perf_counter()
@@ -3044,6 +3127,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     )
     p.add_argument("--state-every", type=int, default=5)
     p.add_argument("--out-dir", default="chaos_recover_run")
+    _add_drill_gossip_flags(p)
     args = p.parse_args(argv)
     if args.nodes < 3:
         p.error("need >= 3 nodes: the victim plus at least 2 replica holders")
@@ -3101,6 +3185,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         "--streams", str(args.streams),
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--metrics-out", metrics_path,
+        *_drill_gossip_args(args),
     )
     nodes = []
     try:
@@ -3126,6 +3211,20 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         rounds_at_crash = full_rounds()
         # phase 2: the disk dies with the process
         shutil.rmtree(state_dirs[victim], ignore_errors=True)
+        # phase 2.5 (the deflake gate): wait for the MASTER to have
+        # OBSERVED the death — a reduced-membership round record in its
+        # metrics JSONL proves the victim was expelled and the grid
+        # re-organized. Respawning before that races the detector: the
+        # victim's id still reads as a LIVE member, so the reborn
+        # process's preferred id is "taken" and it gets minted a fresh
+        # id whose checkpoint history is empty — the restore then misses
+        # through no fault of the recovery path (the historical flake).
+        if not failures:
+            await_phase(
+                lambda: _drill_full_rounds(metrics_path, args.nodes - 1) >= 1,
+                "the master's expulsion of the victim "
+                "(reduced-membership rounds in the metrics log)",
+            )
         # phase 3: same identity, empty disk — recovery must come from
         # peers; its stdout is pumped on a thread so RESTORE is observable
         # while the cluster keeps running
@@ -3213,13 +3312,14 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     if not post_rounds:
         failures.append("no post-restore round progress at the reborn node")
 
-    rounds_completed = 0
-    if os.path.exists(metrics_path):
-        with open(metrics_path) as f:
-            rounds_completed = sum(
-                1 for ln in f
-                if ln.strip() and json.loads(ln).get("kind") == "round"
-            )
+    # torn-tolerant via the shared reader: when the master had to be
+    # killed (a failure path), its metrics writer may have died mid-append
+    # — the summary must still come out instead of a JSON traceback
+    rounds_completed = sum(
+        1
+        for rec in _drill_jsonl_records(metrics_path)
+        if rec.get("kind") == "round"
+    )
     summary = {
         "seed": args.seed,
         "spec": spec,
@@ -3231,6 +3331,220 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         "restore": restore,
         "post_restore_rounds": post_rounds,
         "byte_identical": byte_identical,
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
+def _cmd_chaos_gossip(argv: list[str]) -> int:
+    """Decentralized-membership drill (RESILIENCE.md "Tier 6",
+    ``make chaos-gossip``): a real master + N node processes run under
+    SWIM gossip membership while a SEEDED ONE-DIRECTIONAL partition cuts
+    one node's sends TO the master (``partition:from=K,to=m``) — the
+    exact asymmetric loss that makes a hub detector read a healthy node
+    as dead. Pass requires:
+
+    - ZERO expulsions while the bad link is down (indirect probes through
+      the other nodes keep vouching for the victim — full-membership
+      rounds keep completing throughout);
+    - after the window heals, a node SIGKILLed for real IS expelled by
+      the gossip verdict and the grid reorganizes (the detector still
+      detects — it just needs more than one vantage point to convict).
+    """
+    p = argparse.ArgumentParser(
+        "chaos-gossip",
+        description="seeded asymmetric partition of the master's inbound "
+        "link under gossip membership; assert zero false expulsions, "
+        "then a real kill is still detected",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument("--nodes", type=int, default=5)
+    p.add_argument(
+        "--partition-at", type=float, default=6.0,
+        help="seconds (per-process clock) until the one-way partition",
+    )
+    p.add_argument(
+        "--partition-for", type=float, default=6.0,
+        help="how long the bad link stays down",
+    )
+    p.add_argument(
+        "--min-post-rounds", type=int, default=10,
+        help="reduced-membership rounds required after the real kill",
+    )
+    p.add_argument("--phase-timeout", type=float, default=240.0)
+    p.add_argument("--size", type=int, default=65536)
+    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument("--gossip-interval", type=float, default=0.25)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome)",
+    )
+    p.add_argument("--out-dir", default="chaos_gossip_run")
+    args = p.parse_args(argv)
+    if args.nodes < 4:
+        # th=0.66 must stay satisfiable by the reporters the master can
+        # hear while ONE node's completions are cut: need
+        # ceil(0.66*N) <= N-1, and >= 2 relays for indirect probes
+        p.error("need >= 4 nodes (threshold headroom + indirect relays)")
+
+    import json
+    import os
+    import signal as _signal
+    import subprocess
+
+    victim = args.nodes - 1  # the bad-link node (stays healthy)
+    killed = args.nodes - 2  # the really-dead node of phase 2
+    spec = (
+        f"partition:from={victim},to=m,"
+        f"at={args.partition_at:g}s,heal={args.partition_for:g}s"
+    )
+    os.makedirs(args.out_dir, exist_ok=True)
+    metrics_path = os.path.join(args.out_dir, "rounds.jsonl")
+    stale = [f for f in os.listdir(args.out_dir) if f.endswith(".jsonl")]
+    for f in stale:
+        os.remove(os.path.join(args.out_dir, f))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    spawn = _drill_spawn(env)
+    failures: list[str] = []
+    await_phase = _drill_phase_waiter(args.phase_timeout, failures)
+
+    def full_rounds() -> int:
+        return _drill_full_rounds(metrics_path, args.nodes)
+
+    def reduced_rounds() -> int:
+        return _drill_full_rounds(metrics_path, args.nodes - 1)
+
+    master = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(args.nodes),
+        "--rounds", "-1", "--size", str(args.size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
+        "--gossip", "--gossip-interval", str(args.gossip_interval),
+        "--chaos-seed", str(args.seed), "--chaos-spec", spec,
+        "--chaos-log", os.path.join(args.out_dir, "chaos-master.jsonl"),
+        "--metrics-out", metrics_path,
+    )
+    nodes = []
+    master_done = False
+    master_lines: list[str] = []
+    rounds_before_partition = rounds_after_heal = 0
+    false_expulsions = kill_detected = None
+    detect_s = None
+    try:
+        seed_ep = None
+        for line in master.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("master never reported its endpoint")
+        t_spawn = time.monotonic()
+        for k in range(args.nodes):
+            nodes.append(
+                spawn(
+                    "cluster-node", "--seed", seed_ep, "--node-id", str(k),
+                    "--chaos-log",
+                    os.path.join(args.out_dir, f"chaos-node{k}.jsonl"),
+                )
+            )
+        # phase 1: a healthy baseline before the bad link goes down
+        await_phase(
+            lambda: full_rounds() >= 5, "pre-partition full-membership rounds"
+        )
+        rounds_before_partition = full_rounds()
+        # phase 2: full-membership rounds must KEEP accumulating through
+        # the one-way partition — gated on observed round records, not a
+        # wall anchor: the partition triggers are per-process clocks
+        # (each injector's t0 is its process start), and on a loaded box
+        # the jax imports alone can eat most of a wall-anchored window,
+        # turning a progress comparison into a vacuous 6 -> 6
+        await_phase(
+            lambda: full_rounds() >= rounds_before_partition + 8,
+            "full-membership rounds continuing through the one-way "
+            "partition (a stall here means the bad link wedged the line)",
+        )
+        # ...and the kill phase must not overlap the partition window:
+        # ride out whatever remains of it (per-process t0 >= t_spawn, so
+        # this bounds every process's window from above) plus several
+        # suspicion windows of post-heal slack
+        window_end = (
+            t_spawn + args.partition_at + args.partition_for
+            + 8 * args.gossip_interval
+        )
+        while time.monotonic() < window_end:
+            time.sleep(0.2)
+        rounds_after_heal = full_rounds()
+        false_expulsions = reduced_rounds()
+        if false_expulsions:
+            failures.append(
+                f"{false_expulsions} reduced-membership round(s) during the "
+                "one-way partition: a healthy node was expelled"
+            )
+        # phase 3: a REAL death must still be detected by the ring
+        t_kill = time.monotonic()
+        nodes[killed].kill()
+        target = args.min_post_rounds
+        kill_detected = await_phase(
+            lambda: reduced_rounds() >= target,
+            f"{target} reduced-membership rounds after the real kill",
+        )
+        detect_s = round(time.monotonic() - t_kill, 2)
+        # phase 4: graceful end (Shutdown broadcast flushes every log)
+        master.send_signal(_signal.SIGTERM)
+        try:
+            out_master, _ = master.communicate(timeout=60)
+            master_lines = out_master.splitlines()
+            master_done = any("master done" in ln for ln in master_lines)
+        except subprocess.TimeoutExpired:
+            failures.append("master did not shut down on SIGTERM")
+        for i, n in enumerate(nodes):
+            if i == killed:
+                continue
+            try:
+                n.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                n.kill()
+    finally:
+        for proc in [master, *nodes]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # the master's exit snapshot carries the gossip counters (expulsions
+    # must be exactly 1: the killed node — never the bad-link victim)
+    gossip_metrics = {}
+    for rec in _drill_jsonl_records(metrics_path):
+        if (
+            rec.get("kind") == "metrics_snapshot"
+            and rec.get("role") == "master"
+        ):
+            gossip_metrics = {
+                k: v
+                for k, v in rec.get("metrics", {}).items()
+                if k.startswith("gossip.")
+            }
+    if gossip_metrics.get("gossip.expulsions") != 1:
+        failures.append(
+            "expected exactly 1 gossip expulsion (the killed node), got "
+            f"{gossip_metrics.get('gossip.expulsions')!r}"
+        )
+    if not master_done:
+        failures.append("run did not finish cleanly")
+    summary = {
+        "seed": args.seed,
+        "spec": spec,
+        "full_rounds_pre_partition": rounds_before_partition,
+        "full_rounds_post_heal": rounds_after_heal,
+        "false_expulsions": false_expulsions,
+        "kill_detected": bool(kill_detected),
+        "reduced_rounds_post_kill": reduced_rounds(),
+        "detect_plus_rounds_s": detect_s,
+        "gossip": gossip_metrics,
+        "master_done": master_done,
         "failures": failures,
     }
     print(json.dumps(summary))
@@ -3285,6 +3599,7 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
     )
     p.add_argument("--state-every", type=int, default=5)
     p.add_argument("--out-dir", default="chaos_failover_run")
+    _add_drill_gossip_flags(p)
     args = p.parse_args(argv)
     if args.nodes < 3:
         p.error("need >= 3 nodes: a restore victim plus 2 replica holders")
@@ -3362,6 +3677,7 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--chaos-log", os.path.join(args.out_dir, "chaos-leader.jsonl"),
         "--metrics-out", leader_metrics,
+        *_drill_gossip_args(args),
     )
     standby = None
     nodes = []
@@ -3604,6 +3920,7 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
     p.add_argument("--adapt-dwell", type=int, default=12)
     p.add_argument("--adapt-lag", type=int, default=8)
     p.add_argument("--out-dir", default="chaos_adapt_run")
+    _add_drill_gossip_flags(p)
     args = p.parse_args(argv)
 
     import json
@@ -3672,6 +3989,7 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
         "--adapt-dwell", str(args.adapt_dwell),
         "--adapt-lag", str(args.adapt_lag),
         "--adapt-log", adapt_log,
+        *_drill_gossip_args(args),
     )
     nodes = []
     node_out: dict[int, str] = {}
@@ -3988,6 +4306,7 @@ COMMANDS = {
     "chaos-recover": _cmd_chaos_recover,
     "chaos-failover": _cmd_chaos_failover,
     "chaos-adapt": _cmd_chaos_adapt,
+    "chaos-gossip": _cmd_chaos_gossip,
 }
 
 

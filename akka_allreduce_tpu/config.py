@@ -217,6 +217,66 @@ class DataPlaneConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class GossipConfig:
+    """SWIM-style decentralized membership (control/gossip.py,
+    RESILIENCE.md "Tier 6 — decentralized membership").
+
+    With ``enabled``, nodes stop heartbeating into the master's phi hub:
+    each process probes ONE random member per ``probe_interval_s``, falls
+    back to ``indirect`` ping-reqs through other members when the direct
+    ack misses ``probe_timeout_s``, and only SUSPECTS the target when the
+    indirect round also comes up empty at the end of the probe period —
+    one bad link cannot expel a healthy node. A suspicion left unrefuted
+    for ``suspicion_periods`` probe periods is confirmed dead; the
+    suspected node refutes by bumping its incarnation (the PR-5/6 rejoin
+    plumbing's ordering token) and gossiping itself alive. Membership
+    digests are piggybacked on probe/ack traffic, bounded at
+    ``digest_max`` entries per message.
+
+    Rides ``Welcome`` like every section. A cluster left at the disabled
+    default speaks the hub-heartbeat wire byte for byte (no gossip tags
+    ever appear — the version-skew contract, pinned in tests), and a
+    gossip-enabled master keeps hub-heartbeating legacy nodes alive via
+    the phi detector (capability is learned per peer from the first
+    gossip frame, never assumed).
+    """
+
+    enabled: bool = False
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 0.15  # direct-ack deadline before ping-reqs
+    indirect: int = 3  # K ping-req relays per failed direct probe
+    suspicion_periods: int = 4  # probe periods before suspect -> dead
+    digest_max: int = 12  # piggybacked membership entries per message
+    seed: int = 0  # decision-stream seed (sims replay byte-identically)
+
+    def __post_init__(self) -> None:
+        if self.probe_interval_s <= 0:
+            raise ValueError(
+                f"probe_interval_s must be positive, got {self.probe_interval_s}"
+            )
+        if not 0 < self.probe_timeout_s < self.probe_interval_s:
+            raise ValueError(
+                "need 0 < probe_timeout_s < probe_interval_s, got "
+                f"{self.probe_timeout_s}/{self.probe_interval_s}"
+            )
+        if not 0 <= self.indirect <= 16:
+            raise ValueError(f"indirect must be in [0, 16], got {self.indirect}")
+        if self.suspicion_periods < 1:
+            raise ValueError(
+                f"suspicion_periods must be >= 1, got {self.suspicion_periods}"
+            )
+        if not 1 <= self.digest_max <= 256:
+            raise ValueError(
+                f"digest_max must be in [1, 256], got {self.digest_max}"
+            )
+
+    @property
+    def suspicion_window_s(self) -> float:
+        """How long an unrefuted suspicion lives before it is confirmed."""
+        return self.suspicion_periods * self.probe_interval_s
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosConfig:
     """Deterministic fault injection for the transports (control/chaos.py).
 
@@ -273,6 +333,15 @@ class AdaptConfig:
     # pressure (and, at half this, blocks restores); 0 disables the arm —
     # lag/latency/restart evidence still applies
     noise_degrade: int = 8
+    # bandwidth-imbalance arm (PR-9's per-endpoint gauges as straggler
+    # evidence, ROADMAP item 4's follow-on): an endpoint whose per-window
+    # byte delta falls below this fraction of the MEDIAN endpoint's delta
+    # reads as degrade pressure; restores additionally require the ratio
+    # back above DOUBLE this bar (its own hysteresis gap, mirroring the
+    # noise arm's half-bar rule). 0 disables the arm. Needs >= 3 active
+    # endpoints to be meaningful — with fewer there is no median to
+    # stand out against, and the arm stays inert.
+    bw_degrade_ratio: float = 0.0
 
     def __post_init__(self) -> None:
         _check_fraction("floor_th_reduce", self.floor_th_reduce)
@@ -296,6 +365,13 @@ class AdaptConfig:
             raise ValueError(
                 f"noise_degrade must be >= 0, got {self.noise_degrade}"
             )
+        if not 0.0 <= self.bw_degrade_ratio <= 0.5:
+            # the restore bar is 2x the degrade bar; past 0.5 the restore
+            # bar would exceed 1.0 and no balance could ever satisfy it
+            raise ValueError(
+                f"bw_degrade_ratio must be in [0, 0.5], got "
+                f"{self.bw_degrade_ratio}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -304,6 +380,14 @@ class MasterConfig:
 
     node_num: int = 1  # expected nodes before lines are organized
     dimensions: int = 1  # grid dimensionality (2 => butterfly)
+    # dims-1 round-scheduling shards: split the single all-workers line
+    # into up to this many LineMasters, each owning a contiguous worker
+    # subset (the paper's grid generalized: round fan-out stops being one
+    # scheduler's job as the member count grows). 1 = the historical one
+    # line; each line runs its own independent round sequence, so sharded
+    # lines reduce within their subset (the grid reorganizes shards from
+    # the membership view on every change).
+    line_shards: int = 1
     heartbeat_interval_s: float = 1.0
     heartbeat_timeout_s: float = 5.0
     # stall watchdog (obs.watchdog): a line round in flight longer than this
@@ -320,6 +404,16 @@ class MasterConfig:
         # coerce the nested policy so MasterConfig(**json_dict) just works
         if isinstance(self.retry, dict):
             object.__setattr__(self, "retry", RetryPolicy(**self.retry))
+        if self.line_shards < 1:
+            raise ValueError(
+                f"line_shards must be >= 1, got {self.line_shards}"
+            )
+        if self.line_shards > 1 and self.dimensions != 1:
+            raise ValueError(
+                "line_shards applies to dimensions=1 only (2D grids are "
+                f"already sharded into row/column lines), got dims="
+                f"{self.dimensions}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -339,6 +433,7 @@ class AllreduceConfig:
     data_plane: DataPlaneConfig = dataclasses.field(
         default_factory=DataPlaneConfig
     )
+    gossip: GossipConfig = dataclasses.field(default_factory=GossipConfig)
 
     @classmethod
     def from_json(cls, text: str) -> "AllreduceConfig":
@@ -353,6 +448,7 @@ class AllreduceConfig:
             "chaos": ChaosConfig,
             "adapt": AdaptConfig,
             "data_plane": DataPlaneConfig,
+            "gossip": GossipConfig,
         }
         unknown = set(raw) - set(sections)
         if unknown:

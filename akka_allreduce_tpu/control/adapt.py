@@ -98,6 +98,9 @@ class AdaptiveController:
         self._window_latency_s = 0.0  # sum of this window's round latencies
         self._window_rounds = 0
         self._last_counters: dict[str, int] = {}
+        # per-endpoint cumulative byte watermarks (PR-9's bandwidth
+        # gauges): the controller diffs them per window, like counters
+        self._last_bw: dict[str, float] = {}
         # healthy-latency baseline: learned from the FIRST full window
         # observed at level 0 with no pressure, then frozen — the yardstick
         # "slow" is measured against (0 until learned; latency evidence is
@@ -150,6 +153,7 @@ class AdaptiveController:
         worker_lags: dict[int, int],
         counters: dict[str, int],
         latency_s: float | None = None,
+        bandwidth: dict[str, float] | None = None,
     ) -> RoundPolicy | None:
         """One completed line-round of evidence; returns the new policy on
         a level transition, else None.
@@ -159,7 +163,13 @@ class AdaptiveController:
         in :data:`COUNTER_EVIDENCE` (the controller diffs them against the
         previous window); ``latency_s`` is the round's latency observation
         (the same number the registry histogram absorbed) — optional, for
-        callers without a clock (the soak simulation).
+        callers without a clock (the soak simulation). ``bandwidth`` maps
+        peer endpoints to CUMULATIVE bytes moved (PR-9's
+        ``transport.endpoint.<host:port>.tx_bytes + rx_bytes`` gauges, as
+        visible to the gathering process) — the bandwidth-imbalance arm
+        (``AdaptConfig.bw_degrade_ratio``) diffs them per window and
+        reads one endpoint moving far less than the median as straggler
+        pressure, with its own hysteresis bar on the restore side.
         """
         self._observed += 1
         self._rounds_at_level += 1
@@ -168,15 +178,47 @@ class AdaptiveController:
             self._window_latency_s += latency_s
         if self._observed < self.config.window:
             return None
-        return self._decide(round_num, worker_lags, counters)
+        return self._decide(round_num, worker_lags, counters, bandwidth)
 
     # -- the decision --------------------------------------------------------
+
+    def _bw_ratio(self, bandwidth: dict[str, float] | None) -> float | None:
+        """slowest-endpoint / median-endpoint byte delta for the window,
+        or None when the arm is disabled or the evidence is too thin
+        (fewer than 3 endpoints that moved anything: no median to stand
+        out against)."""
+        if self.config.bw_degrade_ratio <= 0 or bandwidth is None:
+            return None
+        known = self._last_bw
+        deltas = sorted(
+            d
+            for k, v in bandwidth.items()
+            if k in known and (d := max(0.0, float(v) - known[k])) > 0.0
+            # zero-delta endpoints are excluded: the transport's gauge
+            # rows are cumulative and never removed, so an expelled or
+            # departed peer's FROZEN row would otherwise read as
+            # permanent pressure (ratio 0 forever, restore never) — a
+            # silent endpoint is membership's problem (tiers 3/6); this
+            # arm judges links that are MOVING data, just too little.
+            # First-seen endpoints (no watermark yet) are excluded too:
+            # a peer that joined mid-window carries only partial-window
+            # bytes and would read as a spurious straggler — it gets its
+            # watermark seeded now and is judged from the next window
+        )
+        self._last_bw = {k: float(v) for k, v in bandwidth.items()}
+        if len(deltas) < 3:
+            return None
+        median = deltas[len(deltas) // 2]
+        if median <= 0.0:
+            return None  # a quiet window indicts nobody
+        return deltas[0] / median
 
     def _decide(
         self,
         round_num: int,
         worker_lags: dict[int, int],
         counters: dict[str, int],
+        bandwidth: dict[str, float] | None = None,
     ) -> RoundPolicy | None:
         cfg = self.config
         deltas = {
@@ -202,7 +244,14 @@ class AdaptiveController:
         # re-Start still reads as pressure once it reaches the threshold
         noise = deltas["reconnects"] + deltas["drops"]
         noisy = cfg.noise_degrade > 0 and noise >= cfg.noise_degrade
-        pressed = lagging or slow or deltas["restarts"] > 0 or noisy
+        # bandwidth-imbalance arm (PR-9 gauges): one endpoint moving far
+        # below the median endpoint's bytes this window is a straggling
+        # link even when completions still arrive in time
+        bw_ratio = self._bw_ratio(bandwidth)
+        bw_lagging = bw_ratio is not None and bw_ratio < cfg.bw_degrade_ratio
+        pressed = (
+            lagging or slow or deltas["restarts"] > 0 or noisy or bw_lagging
+        )
         # the healthy baseline is learned from the first quiet full window
         # at full fidelity, then frozen — degraded rounds are FASTER by
         # design and must not drag the yardstick down with them
@@ -227,6 +276,7 @@ class AdaptiveController:
                         ("lag", lagging), ("latency", slow),
                         ("restarts", deltas["restarts"] > 0),
                         ("noise", noisy),
+                        ("bandwidth", bw_lagging),
                     )
                     if hit
                 ],
@@ -242,6 +292,10 @@ class AdaptiveController:
             # hysteresis gap on the noise arm: restore only when the
             # window's reconnects+drops fell below HALF the degrade bar
             and (cfg.noise_degrade <= 0 or noise * 2 < cfg.noise_degrade)
+            # the bandwidth arm's own hysteresis bar: the slow endpoint
+            # must be back above DOUBLE the degrade ratio (thin evidence
+            # — too few endpoints, a quiet window — never blocks)
+            and (bw_ratio is None or bw_ratio >= 2.0 * cfg.bw_degrade_ratio)
         )
         if recovered and self.level > 0 and dwelt:
             return self._transition(
@@ -312,6 +366,7 @@ class AdaptiveController:
             "dwell": self._rounds_at_level,
             "baseline_s": self.baseline_latency_s,
             "counters": dict(self._last_counters),
+            "bw": dict(self._last_bw),
             "transitions": self.transitions,
         }
 
@@ -324,6 +379,9 @@ class AdaptiveController:
         self.baseline_latency_s = float(state.get("baseline_s", 0.0))
         self._last_counters = {
             k: int(v) for k, v in dict(state.get("counters", {})).items()
+        }
+        self._last_bw = {
+            k: float(v) for k, v in dict(state.get("bw", {})).items()
         }
         self.transitions = int(state.get("transitions", 0))
         _LEVEL.set(self.level)

@@ -40,6 +40,7 @@ from akka_allreduce_tpu.control.failure import (
     MemberState,
     PhiAccrualFailureDetector,
 )
+from akka_allreduce_tpu.control import gossip as gsp
 from akka_allreduce_tpu.control.grid_master import GridMaster
 from akka_allreduce_tpu.control.node import AllreduceNode
 from akka_allreduce_tpu.control.remote import (
@@ -71,6 +72,11 @@ _DIGESTS_RECEIVED = _metrics.counter("failover.digests_received")
 _FENCED = _metrics.counter("failover.fenced")
 _WALKS = _metrics.counter("failover.walks")
 _SOLICITS = _metrics.counter("failover.advert_solicits")
+# decentralized-membership observability (RESILIENCE.md "Tier 6"): how
+# many expulsions the GOSSIP verdict drove (vs the legacy phi hub's), and
+# how often a freshly-admitted member was shielded from a stale rumor
+_GOSSIP_EXPULSIONS = _metrics.counter("gossip.expulsions")
+_GOSSIP_SHIELDED = _metrics.counter("gossip.rumors_shielded")
 
 
 class MasterProcess:
@@ -185,6 +191,30 @@ class MasterProcess:
         # method, not self.book.get: a standby takeover replaces the book
         self.transport.set_prefix_route("node", self._node_book_endpoint)
         self.transport.set_prefix_route("ckpt", self._node_endpoint)
+        self.transport.set_prefix_route("gossip", self._gossip_endpoint)
+        # SWIM gossip membership (control/gossip.py, RESILIENCE.md
+        # "Tier 6"): with it enabled, nodes stop heartbeating into this
+        # process's phi detector — the master becomes ONE member of the
+        # probe ring and the HeartbeatMonitor a SUBSCRIBER of the gossip
+        # verdict (mirror-refreshed for live members, force_unreachable
+        # on confirmed deaths). A passive standby builds its own ring
+        # identity at takeover (fresh epoch = fresh incarnation).
+        self.gossip: gsp.GossipState | None = None
+        self._gossip_agent: gsp.GossipAgent | None = None
+        # members observed HUB-HEARTBEATING under a gossip-enabled config:
+        # a legacy node that negotiated down (it never joined the ring)
+        # stays under the phi hub's judgement — gossip's verdict never
+        # expels it, its own heartbeats keep the monitor fresh. Everyone
+        # else is the ring's to judge from the moment of admission (the
+        # capability must default ring-ward: learning it per member takes
+        # O(N) probe periods, far longer than a phi timeout).
+        self._hub_speakers: set[int] = set()
+        # clock of each member's latest (re)admission: a DEAD rumor that
+        # predates the admission window is a stale slander about the old
+        # process, never grounds to expel the one just welcomed
+        self._gossip_admitted: dict[int, float] = {}
+        if config.gossip.enabled and standby_of is None:
+            self._build_gossip()
         self._poll_task: asyncio.Task | None = None
         self._done = asyncio.Event()
 
@@ -214,6 +244,120 @@ class MasterProcess:
             # takeover replaces the grid wholesale mid-incident)
             grid.set_policy(self.adapt.policy())
         return grid
+
+    def _build_gossip(self) -> None:
+        """One definition of the master's ring identity — the ctor and a
+        standby takeover (fresh epoch) must never drift apart."""
+        self.gossip = gsp.GossipState(
+            gsp.MASTER_ID,
+            self.epoch,
+            self.config.gossip,
+            seed=self.config.gossip.seed,
+        )
+        self._gossip_agent = gsp.GossipAgent(
+            self.transport,
+            self.gossip,
+            clock=self.clock,
+            # a fenced-out / finished master must not keep acking probes:
+            # its silence is what lets the ring converge on the successor
+            gate=lambda: self.active and not self._done.is_set(),
+            on_message=self._on_gossip_msg,
+        )
+
+    def _gossip_roster(self) -> None:
+        """Re-derive the probe ring's member set from the authoritative
+        membership (book minus unreachable) after any change."""
+        if self.gossip is not None:
+            self.gossip.set_members(set(self.book) - self.unreachable)
+
+    def _on_gossip_msg(self, msg) -> list[Envelope] | None:
+        """Pre-handle hook on every inbound gossip frame: the unknown-
+        pinger arm — a REPLACEMENT master that does not know the sender
+        replies ``Rejoin`` + ``AdvertSolicit``, exactly like the hub's
+        unknown-heartbeat path (a gossip cluster must not lose that
+        recovery)."""
+        sender = getattr(msg, "sender", None)
+        if not isinstance(sender, int) or sender < 0 or not self.active:
+            return None
+        if sender in self.book:
+            inc = getattr(msg, "incarnation", None)
+            if inc is not None:
+                sup = self._superseded.get(sender)
+                if sup is not None and sup[0] == inc:
+                    # zombie: the REMEMBERED superseded predecessor of
+                    # the id's current holder is gossiping — the hub's
+                    # heartbeat path had exactly this guard; tell the
+                    # ghost to stand down like the hub did.
+                    return [
+                        Envelope(
+                            f"node:{sender}",
+                            cl.Shutdown("superseded", self.epoch),
+                            via=sup[1],
+                        )
+                    ]
+                if inc < self._incarnations.get(sender, inc):
+                    # BELOW the admitted cluster incarnation: a stale
+                    # predecessor we don't remember — not evidence, not
+                    # healable. Strictly-below only: the HOLDER's gossip
+                    # incarnation legitimately drifts ABOVE its cluster
+                    # incarnation with every slander refutation
+                    # (GossipState bumps itself past the rumor), and a
+                    # `!=` check here once locked a refuted-then-expelled
+                    # healthy node out of the heal arm forever.
+                    return None
+            # a ring member speaking gossip is certainly not negotiated
+            # down — clear any stale legacy marking from a predecessor
+            self._hub_speakers.discard(sender)
+            if sender in self.unreachable:
+                # an EXPELLED member is alive and talking to us: the hub
+                # flow healed this through resumed heartbeats
+                # (_on_heartbeat's re-line path); the ring edition heals
+                # it here — without this, a member expelled on a
+                # transient freeze could never get back in (its gossip
+                # record was dropped with the roster, so no vouch arm
+                # can fire for it)
+                log.info(
+                    "master: expelled node %d is gossiping -> rejoin",
+                    sender,
+                )
+                return self._readmit(sender, self.clock())
+            return None
+        if isinstance(msg, gsp.Ping) and msg.port > 0:
+            via = cl.Endpoint(msg.host, msg.port)
+            _SOLICITS.inc()
+            return [
+                Envelope(
+                    f"node:{sender}", cl.Rejoin("unknown-node", self.epoch),
+                    via=via,
+                ),
+                Envelope(
+                    f"node:{sender}", st.AdvertSolicit("unknown-node"),
+                    via=via,
+                ),
+            ]
+        return None
+
+    def _readmit(self, nid: int, now: float) -> list[Envelope]:
+        """ONE definition of re-lining a member whose process turned out
+        to be alive (resumed heartbeats, or gossip frames from an
+        expelled member): clear the unreachable mark, reset the detector
+        history (the outage gap must not poison the inter-arrival
+        model), refresh the ring record + admission-grace window, and
+        re-run the membership machinery. Three call sites used to
+        hand-roll drifting copies of this."""
+        self.unreachable.discard(nid)
+        self.monitor.detector.remove(nid)
+        self.monitor.heartbeat(nid, now)
+        self._gossip_roster()
+        if self.gossip is not None:
+            self.gossip.reset_member(nid, self._incarnations.get(nid, 0))
+            self._gossip_admitted[nid] = now
+        self._digest_static = None
+        return (
+            self._broadcast(self._address_book())
+            + self.grid.member_up(nid)
+            + self._digest_envelopes()
+        )
 
     def _arm_chaos(self) -> None:
         from akka_allreduce_tpu.control.chaos import (
@@ -266,11 +410,16 @@ class MasterProcess:
         else:
             _EPOCH_GAUGE.set(self.epoch)
             log.info("master listening on %s (epoch %d)", ep, self.epoch)
+        if self._gossip_agent is not None:
+            self.gossip.host, self.gossip.port = ep.host, ep.port
+            self._gossip_agent.start()
         return ep
 
     async def stop(self) -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self._gossip_agent is not None:
+            await self._gossip_agent.stop()
         for attr in ("_poll_task", "_standby_task"):
             task = getattr(self, attr)
             if task is not None:
@@ -320,6 +469,11 @@ class MasterProcess:
     def _node_book_endpoint(self, node_id: int) -> cl.Endpoint | None:
         return self.book.get(node_id)
 
+    def _gossip_endpoint(self, node_id: int) -> cl.Endpoint | None:
+        # the master never dials its own ring address; expelled members
+        # leave the roster, so probing stops with the membership
+        return self.book.get(node_id) if node_id >= 0 else None
+
     def _broadcast(self, msg: Any) -> list[Envelope]:
         return [
             Envelope(f"node:{nid}", msg)
@@ -365,6 +519,10 @@ class MasterProcess:
             self.unreachable.discard(msg.node_id)
             self._incarnations.pop(msg.node_id, None)
             self._superseded.pop(msg.node_id, None)
+            if self.gossip is not None:
+                self.gossip.remove_member(msg.node_id)
+                self._hub_speakers.discard(msg.node_id)
+                self._gossip_admitted.pop(msg.node_id, None)
             self._digest_static = None  # membership changed
             # a departed process can no longer serve chunks; its manifests
             # stay known (replicas may still hold the bytes)
@@ -538,6 +696,13 @@ class MasterProcess:
                     [ep.host, ep.port] for ep in self.standby_eps
                 ],
             }
+            if self.gossip is not None:
+                # the ring's judgement rides failover too: a promoted
+                # standby inherits WHO was suspect/dead mid-incident and
+                # which members actually speak gossip, instead of
+                # re-learning both from scratch under a fresh epoch
+                static["gossip_view"] = self.gossip.digest_state()
+                static["hub_speakers"] = sorted(self._hub_speakers)
             # serialized once per state change, held OPEN (trailing `}`
             # stripped) so the per-tick round counters splice in cheaply
             self._digest_static = json.dumps(static)[:-1]
@@ -730,6 +895,26 @@ class MasterProcess:
         # never re-joins is expelled by the normal poll path
         for nid in sorted(live):
             self.monitor.heartbeat(nid, now)
+        if self.config.gossip.enabled:
+            # join the probe ring under the bumped epoch (a fresh leader
+            # identity — nodes' record of gossip:-1 updates to the higher
+            # incarnation on first contact), inheriting the replicated
+            # view and the per-member speaker capability
+            self._build_gossip()
+            self._gossip_roster()
+            self.gossip.restore_state(state.get("gossip_view"))
+            self._hub_speakers = {
+                int(n) for n in state.get("hub_speakers", [])
+            }
+            self._gossip_admitted = {nid: now for nid in live}
+            me_ep = self.transport.endpoint
+            self.gossip.host, self.gossip.port = me_ep.host, me_ep.port
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                pass  # sync-driven sims tick the state machine directly
+            else:
+                self._gossip_agent.start()
         _EPOCH_GAUGE.set(self.epoch)
         _TAKEOVERS.inc()
         _flight.note(
@@ -923,6 +1108,15 @@ class MasterProcess:
         # is still UP and HeartbeatMonitor's own reset branch would not run
         self.monitor.detector.remove(nid)
         self.monitor.heartbeat(nid, now)
+        if self.gossip is not None:
+            # the probe ring adopts the admission: fresh ALIVE record at
+            # the cluster incarnation (a predecessor's DEAD record must
+            # not shadow the process the master just vouched for), and a
+            # fresh grace window against rumors that predate it
+            self._gossip_roster()
+            self.gossip.reset_member(nid, msg.incarnation)
+            self._gossip_admitted[nid] = now
+            self._hub_speakers.discard(nid)  # re-learned per process
         log.info("master: node %d joined from %s:%d", nid, msg.host, msg.port)
         out = [welcome]
         out.extend(self._broadcast(self._address_book()))
@@ -981,17 +1175,17 @@ class MasterProcess:
                     )
                 ]
             return []
+        if self.gossip is not None:
+            # a member hub-heartbeating under a gossip-enabled config
+            # negotiated down (legacy binary): the phi detector keeps
+            # owning its liveness, and the ring's inevitable slander of
+            # the never-acking member is ignored (_consume_gossip)
+            self._hub_speakers.add(node_id)
         event = self.monitor.heartbeat(node_id, now)
         if event is not None and node_id not in self.grid.nodes:
             # silence marked it unreachable but the process lives: rejoin it
             log.info("master: node %d heartbeat resumed -> rejoin", node_id)
-            self.unreachable.discard(node_id)
-            self._digest_static = None  # membership changed
-            return (
-                self._broadcast(self._address_book())
-                + self.grid.member_up(node_id)
-                + self._digest_envelopes()
-            )
+            return self._readmit(node_id, now)
         return []
 
     def _on_round_complete(
@@ -1017,10 +1211,11 @@ class MasterProcess:
                     "drops": _EV_DROPS.value,
                     "reorgs": _EV_REORGS.value,
                 }
+                bandwidth = self._gather_bandwidth()
             else:
-                lags, counters = {}, {}
+                lags, counters, bandwidth = {}, {}, None
             pol = self.adapt.observe_round(
-                r, lags, counters, latency_s=latency_s
+                r, lags, counters, latency_s=latency_s, bandwidth=bandwidth
             )
             if pol is not None:
                 # rounds started from now on (this very completion's
@@ -1044,6 +1239,24 @@ class MasterProcess:
                 data_bytes=self.config.metadata.data_size * 4,
             )
 
+    def _gather_bandwidth(self) -> dict[str, float] | None:
+        """Per-endpoint cumulative tx+rx bytes from PR-9's transport
+        gauges, as visible to THIS process (in-process transports all
+        report through the shared registry collector) — the bandwidth
+        evidence arm's input, gathered only on window-boundary calls.
+        None when the arm is disabled (skips the collector sweep)."""
+        if self.adapt is None or self.adapt.config.bw_degrade_ratio <= 0:
+            return None
+        prefix = "transport.endpoint."
+        out: dict[str, float] = {}
+        for key, value in _metrics.REGISTRY.snapshot().items():
+            if not key.startswith(prefix):
+                continue
+            endpoint, _, field = key[len(prefix):].rpartition(".")
+            if field in ("tx_bytes", "rx_bytes") and endpoint:
+                out[endpoint] = out.get(endpoint, 0.0) + float(value)
+        return out
+
     def _standby_tuple(self) -> tuple[tuple[str, int], ...]:
         return tuple((ep.host, ep.port) for ep in self.standby_eps)
 
@@ -1064,6 +1277,10 @@ class MasterProcess:
         now = self.clock()
         out: list[Envelope] = []
         expelled = False
+        if self.gossip is not None:
+            out2, expelled2 = self._consume_gossip(now)
+            out.extend(out2)
+            expelled = expelled or expelled2
         for event in self.monitor.poll(now):
             if event.state is MemberState.UNREACHABLE:
                 log.info(
@@ -1103,6 +1320,69 @@ class MasterProcess:
                 self._broadcast(cl.Shutdown("done", self.epoch))
                 + self._standby_shutdowns("done")
             )
+
+    def _consume_gossip(self, now: float) -> tuple[list[Envelope], bool]:
+        """One subscriber pass over the gossip view (RESILIENCE.md
+        "Tier 6"): mirror ALIVE/SUSPECT members into the phi monitor (a
+        suspect is innocent until the suspicion times out — the hub's
+        clock must never front-run the ring's verdict), then act on the
+        edge events: a CONFIRMED death drives the exact
+        ``member_unreachable`` path a phi expulsion always drove."""
+        assert self.gossip is not None
+        out: list[Envelope] = []
+        expelled = False
+        window = self.config.gossip.suspicion_window_s
+        for nid in self.gossip.alive_or_suspect():
+            if (
+                nid not in self._hub_speakers
+                and nid in self.book
+                and nid not in self.unreachable
+            ):
+                event = self.monitor.heartbeat(nid, now)
+                if event is not None and nid not in self.grid.nodes:
+                    # the ring vouches for a member the grid dropped (a
+                    # refutation landed after a phi expulsion): re-line it,
+                    # exactly like the hub's heartbeat-resume path
+                    log.info(
+                        "master: gossip vouches node %d alive -> rejoin", nid
+                    )
+                    out.extend(self._readmit(nid, now))
+        for gev in self.gossip.poll_events():
+            nid = gev.node_id
+            if nid < 0 or gev.status != gsp.DEAD:
+                continue
+            if self.gossip.status_of(nid) != gsp.DEAD:
+                # a refutation (or direct frame) flipped the record back
+                # between the confirm and this poll: the queued verdict
+                # is already stale — acting on it would expel a node the
+                # ring no longer believes dead, and under the asymmetric
+                # partition no direct frame could ever heal it back
+                continue
+            if nid in self._hub_speakers:
+                continue  # negotiated-down legacy member: the phi hub owns it
+            if nid not in self.book or nid in self.unreachable:
+                continue
+            admitted = self._gossip_admitted.get(nid)
+            if admitted is not None and now - admitted < window + \
+                    self.config.gossip.probe_interval_s:
+                # stale slander: this verdict's suspicion predates (or
+                # straddles) the member's latest admission — shield the
+                # fresh process and outrank the rumor so it dies out
+                _GOSSIP_SHIELDED.inc()
+                self.gossip.reset_member(nid, gev.incarnation + 1)
+                continue
+            log.info(
+                "master: node %d confirmed dead by gossip (incarnation %d)",
+                nid, gev.incarnation,
+            )
+            _GOSSIP_EXPULSIONS.inc()
+            self.monitor.force_unreachable(nid, now)
+            out.extend(self.grid.member_unreachable(nid))
+            self.unreachable.add(nid)
+            self._gossip_roster()
+            self._digest_static = None
+            expelled = True
+        return out, expelled
 
     @property
     def rounds_completed(self) -> int:
@@ -1177,6 +1457,12 @@ class NodeProcess:
         self.transport.set_prefix_route(
             "ckpt", lambda nid: self._endpoints.get(nid)
         )
+        self.transport.set_prefix_route("gossip", self._gossip_peer_endpoint)
+        # SWIM gossip membership (control/gossip.py): built at Welcome
+        # when the config arms it — this node then probes peers instead
+        # of heartbeating into the master's phi hub
+        self.gossip: gsp.GossipState | None = None
+        self._gossip_agent: gsp.GossipAgent | None = None
         self._heartbeat_task: asyncio.Task | None = None
         self._join_task: asyncio.Task | None = None
         self._welcomed = asyncio.Event()
@@ -1244,12 +1530,18 @@ class NodeProcess:
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
+        if self._gossip_agent is not None:
+            # stop probing (and answering) on the way out: lingering acks
+            # from a leaver would keep vouching for a dead membership
+            self._gossip_agent.cancel()
         if self.node_id is not None:
             await self.transport.send(
                 Envelope("master", cl.LeaveCluster(self.node_id))
             )
 
     async def stop(self) -> None:
+        if self._gossip_agent is not None:
+            await self._gossip_agent.stop()
         for attr in ("_heartbeat_task", "_join_task", "_rejoin_task"):
             task = getattr(self, attr)
             if task is not None:
@@ -1276,14 +1568,25 @@ class NodeProcess:
         # dict lookup: this resolver runs per outgoing chunk on the data path
         return self._endpoints.get(worker_id // self.config.master.dimensions)
 
+    def _gossip_peer_endpoint(self, node_id: int) -> cl.Endpoint | None:
+        if node_id < 0:
+            # the master's ring address follows the leader this node
+            # believes in (self.seed is repointed by the failover walk)
+            return self.seed
+        return self._endpoints.get(node_id)
+
     # -- cluster protocol ------------------------------------------------------
+
+    #: master-bound destinations for the loss-detection counter (the
+    #: gossip ring's master address fails exactly when the master does)
+    _MASTER_DESTS = ("master", gsp.gossip_addr(gsp.MASTER_ID))
 
     def _on_send_ok(self, ep: cl.Endpoint, env: Envelope) -> None:
         # rejoin triggers on CONSECUTIVE master-send failures: a transient
         # blip must not accumulate forever toward a spurious cluster-wide
         # rejoin (the master rarely sends anything back in steady state, so
         # resetting only on inbound traffic would never clear the counter)
-        if env.dest == "master":
+        if env.dest in self._MASTER_DESTS:
             self._master_send_failures = 0
 
     def _on_send_error(self, ep: cl.Endpoint, env: Envelope) -> None:
@@ -1291,9 +1594,20 @@ class NodeProcess:
             # a lost replication push must be re-pushed next round, not
             # dedup-skipped forever (statetransfer.note_send_failure)
             self.state.note_send_failure(env)
-        if env.dest != "master" or not self._welcomed.is_set() or self._left:
+        if (
+            env.dest not in self._MASTER_DESTS
+            or not self._welcomed.is_set()
+            or self._left
+        ):
             return
         self._master_send_failures += 1
+        if self.gossip is not None:
+            # decentralized membership: our own failed sends are ONE
+            # vantage point — the master may be fine behind a bad direct
+            # link (indirect probes still vouch for it). The walk is
+            # triggered by the ring's CONFIRMED verdict on gossip:-1
+            # (_on_gossip_events), never by direct loss alone.
+            return
         if (
             self._master_send_failures >= self.rejoin_after_failures
             and not self._rejoining
@@ -1428,6 +1742,12 @@ class NodeProcess:
             self.standbys = [
                 cl.Endpoint(h, p) for h, p in msg.standbys
             ]
+            if self.gossip is not None:
+                # the book is the authoritative roster: expelled members
+                # leave the ring, admitted ones get fresh ALIVE records
+                self.gossip.set_members(
+                    set(self._endpoints) | {gsp.MASTER_ID}
+                )
             return []
         if isinstance(msg, st.AdvertSolicit):
             # a (replacement) master wants to know what this disk holds —
@@ -1572,14 +1892,128 @@ class NodeProcess:
             # master's holder map (wiped of our old incarnation's entries)
             # re-learns what actually survived on this disk
             out.extend(self._advert_envelopes())
-        interval = self.config.master.heartbeat_interval_s
-        self._heartbeat_task = observed_task(
-            run_periodic(interval, self._send_heartbeat),
-            name=f"node-{msg.node_id}-heartbeat",
-        )
+        if self.config.gossip.enabled:
+            # decentralized membership: NO hub heartbeat loop — this node
+            # joins the probe ring instead (the master is member -1). A
+            # node welcomed WITHOUT the section (a legacy master) lands in
+            # the else-branch and heartbeats exactly as before — the
+            # negotiate-down contract, pinned in tests/test_gossip.py.
+            self._start_gossip(msg.node_id)
+        else:
+            if self._gossip_agent is not None:
+                # re-welcomed by a gossip-DISABLED master (an operator-
+                # restarted replacement without --gossip): the old probe
+                # loop must die with the old cluster, or it would keep
+                # probing a stale roster, eventually confirm the OLD
+                # master dead, and walk this healthily-attached node
+                # away from the live one
+                self._gossip_agent.cancel()
+                self._gossip_agent = None
+                self.gossip = None
+            interval = self.config.master.heartbeat_interval_s
+            self._heartbeat_task = observed_task(
+                run_periodic(interval, self._send_heartbeat),
+                name=f"node-{msg.node_id}-heartbeat",
+            )
         self._welcomed.set()
         log.info("node %d welcomed (dims=%d)", msg.node_id, dims)
         return out
+
+    # -- gossip membership (RESILIENCE.md "Tier 6") ----------------------------
+
+    def _start_gossip(self, node_id: int) -> None:
+        """(Re)build this node's ring identity under the welcomed id. A
+        rejoin re-welcome cancels the old probe loop first — a superseded
+        identity must not keep answering probes under a stale address."""
+        if self._gossip_agent is not None:
+            self._gossip_agent.cancel()
+        ep = self.transport.endpoint
+        self.gossip = gsp.GossipState(
+            node_id,
+            self.incarnation,
+            self.config.gossip,
+            host=ep.host,
+            port=ep.port,
+        )
+        # roster: everyone in the current address book plus the master;
+        # refreshed on every AddressBook broadcast
+        self.gossip.set_members(set(self._endpoints) | {gsp.MASTER_ID})
+        self._gossip_agent = gsp.GossipAgent(
+            self.transport,
+            self.gossip,
+            clock=time.monotonic,
+            # a node mid-rejoin (or shutting down) must go quiet: its
+            # probes would carry a stale incarnation and its acks would
+            # vouch for an identity it has abandoned
+            gate=lambda: self._welcomed.is_set()
+            and not self._shutdown.is_set(),
+            on_message=self._on_gossip_leader_ping,
+            on_events=self._on_gossip_events,
+        )
+        self._gossip_agent.start()
+
+    def _on_gossip_leader_ping(self, msg) -> None:
+        """Leadership discovery through the ring: a promoted standby joins
+        the ring as member -1 under its bumped epoch and PROBES us from
+        its own endpoint. Without this hook those pings would keep our
+        master record ALIVE (so the confirmed-dead walk never fires)
+        while our master-bound traffic — acks included — still flowed to
+        the DEAD seed: the promoted master would read our silence as
+        death and expel the whole cluster. A master ping from a NEW
+        endpoint at >= the incarnation we know repoints the master route
+        and re-runs the join handshake there (the same walk a confirmed
+        death starts, aimed by the ring instead of cycling candidates);
+        a deposed zombie's lower incarnation cannot steal the route."""
+        if (
+            not isinstance(msg, gsp.Ping)
+            or msg.sender != gsp.MASTER_ID
+            or msg.port <= 0
+        ):
+            return None
+        ep = cl.Endpoint(msg.host, msg.port)
+        if ep == self.seed:
+            return None
+        rec = self.gossip.members.get(gsp.MASTER_ID) if self.gossip else None
+        if rec is not None and msg.incarnation < rec.incarnation:
+            return None  # stale leader identity: ignore
+        log.info(
+            "node %s: master ring identity moved to %s (incarnation %d) "
+            "-> re-join",
+            self.node_id, ep, msg.incarnation,
+        )
+        self._point_master(ep)
+        if (
+            self._welcomed.is_set()
+            and not self._rejoining
+            and not self._left
+        ):
+            self._rejoining = True
+            self._rejoin_task = observed_task(
+                self._rejoin_master(), name="node-rejoin"
+            )
+        return None
+
+    def _on_gossip_events(self, events: list[gsp.GossipEvent]) -> None:
+        """Subscriber drain: the only verdict a NODE acts on is the ring
+        confirming the MASTER dead — that (not direct send loss) starts
+        the standby walk, so a bad direct link to the leader can no
+        longer make a healthy node abandon its membership."""
+        for ev in events:
+            if (
+                ev.node_id == gsp.MASTER_ID
+                and ev.status == gsp.DEAD
+                and self._welcomed.is_set()
+                and not self._rejoining
+                and not self._left
+            ):
+                log.info(
+                    "node %s: gossip confirmed the master dead -> re-join",
+                    self.node_id,
+                )
+                self._rejoining = True
+                self._rejoin_task = observed_task(
+                    self._rejoin_master(), name="node-rejoin"
+                )
 
     # -- peer state transfer ---------------------------------------------------
 

@@ -22,6 +22,7 @@ Faults are compiled from a spec string (see :func:`parse_spec`)::
 
     drop:p=0.05;delay:ms=20;corrupt:p=0.01
     partition:groups=m+0|1+2,at=round10,heal=5s
+    partition:from=1+2,to=m,at=8s,heal=8s   (one-directional)
     stall:node=1,at=3s,for=2s;crash:node=2,at=round8
 
 Determinism: every probabilistic decision draws from a per-fault
@@ -136,6 +137,12 @@ class FaultSpec:
     delay_ms: float = 0.0
     jitter_ms: float = 0.0
     groups: tuple[frozenset[int], ...] = ()
+    # one-directional partition (`partition:from=m,to=1`): sends FROM a
+    # member of `src` TO a member of `dst` fail; the reverse direction
+    # flows — the asymmetric-loss case that makes a hub misjudge N nodes
+    # from one congested link (gossip's indirect probes route around it)
+    src: frozenset[int] = frozenset()
+    dst: frozenset[int] = frozenset()
     node: int | None = None
     at: tuple[str, float] = ("time", 0.0)
     until: tuple[str, float] | None = None  # heal= / for= (absolute or span)
@@ -196,6 +203,18 @@ def parse_spec(spec: str) -> list[FaultSpec]:
                     raise ValueError(
                         f"partition needs >= 2 groups, got {v!r}"
                     )
+            elif k in ("from", "to") and name == "partition":
+                members = frozenset(
+                    _parse_role(m, f"partition {k} member")
+                    for m in v.split("+")
+                    if m
+                )
+                if not members:
+                    raise ValueError(f"partition: empty {k}= member list")
+                if k == "from":
+                    f.src = members
+                else:
+                    f.dst = members
             elif k == "node" and name in ("stall", "crash", "delay"):
                 # delay:node=K is the STAGED STRAGGLER (RESILIENCE.md
                 # "Tier 5"): one process's sends run late while its
@@ -211,8 +230,18 @@ def parse_spec(spec: str) -> list[FaultSpec]:
                 f.until = _parse_when(v, f"{name} for")
             else:
                 raise ValueError(f"{name}: unknown param {k!r}")
-        if name == "partition" and not f.groups:
-            raise ValueError("partition requires groups=")
+        if name == "partition":
+            if f.groups and (f.src or f.dst):
+                raise ValueError(
+                    "partition: groups= and from=/to= are mutually "
+                    "exclusive (symmetric vs one-directional form)"
+                )
+            if bool(f.src) != bool(f.dst):
+                raise ValueError(
+                    "partition: from= and to= must be given together"
+                )
+            if not f.groups and not f.src:
+                raise ValueError("partition requires groups= or from=/to=")
         if name in ("stall", "crash") and f.node is None:
             raise ValueError(f"{name} requires node=")
         # crash:node=m is allowed since the master-HA PR: a real
@@ -341,7 +370,10 @@ class ChaosInjector:
         if suffix.lstrip("-").isdigit():
             if prefix == "worker":
                 return int(suffix) // self.dims
-            if prefix == "node":
+            if prefix in ("node", "gossip"):
+                # gossip endpoints use the same role id space (the master
+                # is gossip:-1 == MASTER_ROLE), so partitions/stalls cut
+                # membership traffic exactly like round traffic
                 return int(suffix)
         return None
 
@@ -426,6 +458,18 @@ class ChaosInjector:
             if name == "partition":
                 if not self._window_active(f, now):
                     continue
+                if f.src:
+                    # one-directional form: only the src -> dst direction
+                    # is cut (the acks/replies flow back fine — the
+                    # asymmetric-loss case a hub detector cannot tell
+                    # from death)
+                    theirs = self._dest_role(env.dest)
+                    if self.role not in f.src or theirs not in f.dst:
+                        continue
+                    self._log("partition", env, oneway=True, peer=theirs)
+                    act.fail = True
+                    hit = True
+                    break  # the direction is down; nothing else applies
                 mine = self._group_of(f.groups, self.role)
                 theirs = self._group_of(f.groups, self._dest_role(env.dest))
                 if mine is None or theirs is None or mine == theirs:

@@ -194,6 +194,18 @@ class HeartbeatMonitor:
         self.states.pop(node_id, None)
         return None
 
+    def force_unreachable(self, node_id: int, now: float) -> MembershipEvent | None:
+        """Subscriber entry point for an EXTERNAL failure verdict — the
+        SWIM gossip layer's confirmed-dead (control/gossip.py): with
+        decentralized membership this monitor no longer judges liveness
+        itself for gossip-speaking members, it only keeps the same
+        edge-triggered event contract the GridMaster consumes. Returns
+        the UNREACHABLE edge, or None when the node was already down."""
+        self.detector.remove(node_id)
+        if self.states.get(node_id) is not MemberState.UP:
+            return None
+        return self._transition(node_id, MemberState.UNREACHABLE, now)
+
     def poll(self, now: float) -> list[MembershipEvent]:
         """Detect silent nodes; returns newly-unreachable events."""
         events = []

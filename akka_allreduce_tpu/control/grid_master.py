@@ -166,7 +166,24 @@ class GridMaster:
         dims = self.config.dimensions
         lines: list[list[int]] = []  # each entry: worker ids of one line
         if dims == 1:
-            lines.append([dim_worker_id(n, 0, 1) for n in nodes])
+            # sharded round scheduling (RESILIENCE.md "Tier 6"): split the
+            # membership into up to line_shards contiguous lines, each
+            # owning a worker subset and running its own round sequence —
+            # round fan-out stops being one LineMaster's job. Every
+            # reorganization re-shards from the CURRENT view, so shards
+            # track membership exactly like the 2D grid's rows/columns.
+            shards = max(1, min(self.config.line_shards, len(nodes)))
+            base, extra = divmod(len(nodes), shards)
+            start = 0
+            for s in range(shards):
+                size = base + (1 if s < extra else 0)
+                lines.append(
+                    [
+                        dim_worker_id(n, 0, 1)
+                        for n in nodes[start : start + size]
+                    ]
+                )
+                start += size
         elif dims == 2:
             rows, cols = grid_factors(len(nodes))
             grid = [nodes[r * cols : (r + 1) * cols] for r in range(rows)]

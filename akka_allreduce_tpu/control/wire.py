@@ -27,6 +27,7 @@ _log = logging.getLogger(__name__)
 
 from akka_allreduce_tpu import native
 from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.control import gossip as gp
 from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.obs import metrics as _obs_metrics
 from akka_allreduce_tpu.protocol import (
@@ -71,6 +72,12 @@ _TAGS: dict[type, int] = {
     cl.StandbyRegister: 21,
     cl.StateDigest: 22,
     st.AdvertSolicit: 23,
+    # SWIM gossip membership (control/gossip.py, RESILIENCE.md "Tier 6"):
+    # direct probe, indirect-probe request, and the (possibly relayed)
+    # acknowledgement — each piggybacking a bounded membership digest
+    gp.Ping: 24,
+    gp.PingReq: 25,
+    gp.Ack: 26,
 }
 
 _U16 = struct.Struct("<H")
@@ -99,6 +106,30 @@ def _unpack_str32(buf: memoryview, off: int) -> tuple[str, int]:
     (n,) = _U32.unpack_from(buf, off)
     off += 4
     return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+
+_DIGEST_ENTRY = struct.Struct("<iqB")
+
+
+def _pack_gossip_digest(digest) -> bytes:
+    """``[u16 n]`` + per entry ``[i32 node_id][i64 incarnation][u8 status]``
+    — the bounded membership digest on tags 24-26."""
+    parts = [_U16.pack(len(digest))]
+    for nid, inc, status in digest:
+        parts.append(_DIGEST_ENTRY.pack(nid, inc, status))
+    return b"".join(parts)
+
+
+def _unpack_gossip_digest(
+    buf: memoryview, off: int
+) -> tuple[tuple[tuple[int, int, int], ...], int]:
+    (n,) = _U16.unpack_from(buf, off)
+    off += 2
+    out = []
+    for _ in range(n):
+        out.append(_DIGEST_ENTRY.unpack_from(buf, off))
+        off += _DIGEST_ENTRY.size
+    return tuple(out), off
 
 
 def _unpack_endpoints(
@@ -493,6 +524,26 @@ def _encode_parts(msg: Any, mode: str = "f32") -> list:
         ]
     if tag == 23:
         return [head, _pack_str(msg.reason)]
+    if tag == 24:
+        return [
+            head,
+            struct.pack("<iqI", msg.sender, msg.incarnation, msg.seq),
+            _pack_str(msg.host),
+            _U16.pack(msg.port),
+            _pack_gossip_digest(msg.digest),
+        ]
+    if tag == 25:
+        return [
+            head,
+            struct.pack("<iiI", msg.sender, msg.target, msg.seq),
+            _pack_gossip_digest(msg.digest),
+        ]
+    if tag == 26:
+        return [
+            head,
+            struct.pack("<iqI", msg.sender, msg.incarnation, msg.seq),
+            _pack_gossip_digest(msg.digest),
+        ]
     raise AssertionError(f"unhandled tag {tag}")
 
 
@@ -610,6 +661,20 @@ def decode(data: bytes | memoryview) -> Any:
     if tag == 23:
         reason, _ = _unpack_str(buf, off)
         return st.AdvertSolicit(reason)
+    if tag == 24:
+        sender, incarnation, seq = struct.unpack_from("<iqI", buf, off)
+        host, off = _unpack_str(buf, off + 16)
+        (port,) = _U16.unpack_from(buf, off)
+        digest, _ = _unpack_gossip_digest(buf, off + 2)
+        return gp.Ping(sender, incarnation, seq, host, port, digest)
+    if tag == 25:
+        sender, target, seq = struct.unpack_from("<iiI", buf, off)
+        digest, _ = _unpack_gossip_digest(buf, off + 12)
+        return gp.PingReq(sender, target, seq, digest)
+    if tag == 26:
+        sender, incarnation, seq = struct.unpack_from("<iqI", buf, off)
+        digest, _ = _unpack_gossip_digest(buf, off + 16)
+        return gp.Ack(sender, incarnation, seq, digest)
     raise ValueError(f"unknown wire tag {tag}")
 
 

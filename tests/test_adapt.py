@@ -195,6 +195,61 @@ def test_noise_counter_deltas_are_degrade_pressure_with_hysteresis():
     assert ctl2.level == 0
 
 
+def test_bandwidth_imbalance_is_degrade_pressure_with_its_own_bar():
+    """PR-9's per-endpoint bandwidth gauges as a straggler-evidence arm
+    (ROADMAP item 4's follow-on): an endpoint whose per-window byte delta
+    falls below ``bw_degrade_ratio`` of the MEDIAN endpoint's reads as
+    pressure; restores need the ratio back above DOUBLE the bar."""
+
+    def bw_drive(ctl, rounds, bw, start=0):
+        out = []
+        for r in range(start, start + rounds):
+            pol = ctl.observe_round(r, {1: 0}, {}, None, bandwidth=dict(bw))
+            if pol is not None:
+                out.append(pol)
+        return out
+
+    ctl = make_ctl(bw_degrade_ratio=0.25, min_dwell=4)
+    # balanced window: everyone moved ~1MB since the zero watermark
+    base = {"a:1": 1e6, "b:1": 1.1e6, "c:1": 0.9e6}
+    assert bw_drive(ctl, 4, base) == []
+    assert ctl.level == 0
+    # endpoint a crawls: +10KB vs the median's +1MB (ratio 0.01 < 0.25)
+    skewed = {"a:1": 1.01e6, "b:1": 2.1e6, "c:1": 1.9e6}
+    assert bw_drive(ctl, 4, skewed, start=4) != []
+    assert ctl.level == 1
+    assert ctl.decisions[-1]["why"] == ["bandwidth"]
+    # recovery to 0.3x the median: above the degrade bar but below the
+    # restore bar (2 x 0.25 = 0.5) — the hysteresis gap holds the level
+    partial = {"a:1": 1.31e6, "b:1": 3.1e6, "c:1": 2.9e6}
+    assert bw_drive(ctl, 4, partial, start=8) == []
+    assert ctl.level == 1
+    # fully balanced again (ratio 1.0 >= 0.5): restore goes through
+    healed = {"a:1": 2.31e6, "b:1": 4.1e6, "c:1": 3.9e6}
+    assert bw_drive(ctl, 4, healed, start=12) != []
+    assert ctl.level == 0
+    # thin evidence is inert: two endpoints have no median to stand
+    # against, and a quiet (zero-delta) window indicts nobody
+    ctl2 = make_ctl(bw_degrade_ratio=0.25)
+    assert bw_drive(ctl2, 8, {"a:1": 1e6, "b:1": 100.0}) == []
+    assert ctl2.level == 0
+    ctl2b = make_ctl(bw_degrade_ratio=0.25)
+    assert bw_drive(ctl2b, 4, base) == []
+    # identical snapshot again: every delta 0, median 0 -> arm inert
+    assert bw_drive(ctl2b, 4, base, start=4) == []
+    assert ctl2b.level == 0
+    # the default (0) disables the arm entirely
+    ctl3 = make_ctl()
+    assert bw_drive(ctl3, 8, skewed) == []
+    assert ctl3.level == 0
+    # the watermark rides the failover digest like the counter watermarks
+    d = ctl.digest()
+    assert d["bw"] == {k: float(v) for k, v in healed.items()}
+    ctl4 = make_ctl(bw_degrade_ratio=0.25)
+    ctl4.restore(d)
+    assert ctl4._last_bw == d["bw"]
+
+
 def test_decision_log_is_deterministic():
     """Same evidence sequence => byte-identical decision log (the chaos
     event log's determinism contract applied to decisions)."""
@@ -535,3 +590,29 @@ def test_chaos_adapt_drill_subprocess(tmp_path):
         e["policy"].startswith("int8") for e in summary["adapt_events"]
     )
     assert all(v <= summary["err_budget"] for v in summary["max_err"].values())
+
+
+def test_bandwidth_first_seen_endpoint_is_not_a_straggler():
+    """An endpoint with no prior watermark (a peer that joined mid-
+    window) carries only partial-window bytes — it must be watermark-
+    seeded and judged from the NEXT window, never read as pressure."""
+    ctl = make_ctl(bw_degrade_ratio=0.25, min_dwell=4)
+
+    def bw_drive(rounds, bw, start):
+        out = []
+        for r in range(start, start + rounds):
+            pol = ctl.observe_round(r, {1: 0}, {}, None, bandwidth=dict(bw))
+            if pol is not None:
+                out.append(pol)
+        return out
+
+    base = {"a:1": 1e6, "b:1": 1.1e6, "c:1": 0.9e6}
+    assert bw_drive(4, base, 0) == []  # window 1 seeds the watermarks
+    # node d joins 90% through window 2: tiny partial-window bytes
+    joined = {k: v * 2 for k, v in base.items()} | {"d:1": 0.1e6}
+    assert bw_drive(4, joined, 4) == []
+    assert ctl.level == 0, "fresh endpoint read as a straggler"
+    # from window 3 on, d is judged like everyone: balanced -> quiet
+    settled = {k: v + 1e6 for k, v in joined.items()}
+    assert bw_drive(4, settled, 8) == []
+    assert ctl.level == 0

@@ -203,14 +203,17 @@ def test_membership_schedule_is_deterministic_and_keeps_a_survivor():
 def test_chaos_introduces_no_new_wire_tags():
     """Design pin (and the WIRE001 satellite): chaos configuration rides
     Welcome's config JSON — chaos itself contributes ZERO wire tags. The
-    full surface is now 1-23 (14-20 are PR 6's peer state transfer; 21-23
+    full surface is now 1-26 (14-20 are PR 6's peer state transfer; 21-23
     are the master-HA failover tags — StandbyRegister/StateDigest in
-    control/cluster.py, AdvertSolicit in control/statetransfer.py — every
-    one round-tripped in test_wire_roundtrip.py); a new chaos control
-    message must update this test, the codec arms, and a dispatch site
-    together (WIRE001 enforces the rest)."""
-    assert sorted(wire._TAGS.values()) == list(range(1, 24))
+    control/cluster.py, AdvertSolicit in control/statetransfer.py; 24-26
+    are SWIM gossip membership's Ping/PingReq/Ack, module-owned by
+    control/gossip.py — every one round-tripped in
+    test_wire_roundtrip.py); a new chaos control message must update this
+    test, the codec arms, and a dispatch site together (WIRE001 enforces
+    the rest)."""
+    assert sorted(wire._TAGS.values()) == list(range(1, 27))
     from akka_allreduce_tpu.control import chaos as chaos_mod
+    from akka_allreduce_tpu.control import gossip as gossip_mod
     from akka_allreduce_tpu.control import statetransfer as st_mod
 
     for cls in wire._TAGS:
@@ -218,6 +221,14 @@ def test_chaos_introduces_no_new_wire_tags():
     assert sum(
         1 for cls in wire._TAGS if cls.__module__ == st_mod.__name__
     ) == 8
+    # the gossip tag range is MODULE-OWNED: exactly tags 24-26, all from
+    # control/gossip.py, and nothing else in that module is tagged
+    gossip_tags = sorted(
+        tag
+        for cls, tag in wire._TAGS.items()
+        if cls.__module__ == gossip_mod.__name__
+    )
+    assert gossip_tags == [24, 25, 26]
     cfg = AllreduceConfig(chaos=ChaosConfig(seed=9, spec="drop:p=0.5"))
     roundtrip = AllreduceConfig.from_json(cfg.to_json())
     assert roundtrip.chaos == ChaosConfig(seed=9, spec="drop:p=0.5")

@@ -1,0 +1,938 @@
+"""SWIM gossip membership (control/gossip.py, RESILIENCE.md "Tier 6").
+
+The deterministic core of the acceptance criteria lives here, cheap enough
+for tier-1 because ``GossipState`` is a clock-free seeded state machine:
+
+- **64-node sims** drive every member's state machine over an in-process
+  message fabric with per-role :class:`ChaosInjector`\\ s (the REAL chaos
+  grammar — including the new one-directional ``partition:from=,to=``
+  form), on a purely logical clock;
+- a seeded **asymmetric partition of the master's own inbound links**
+  produces ZERO expulsions of healthy nodes (indirect probes route
+  around the bad links), while a **truly-dead node** is confirmed within
+  a pinned probe-period bound;
+- **refutation**: a slandered-but-alive node bumps its incarnation and
+  the suspicion dies cluster-wide before the confirm timer fires;
+- **determinism**: same seed, same fabric -> identical event sequences
+  and byte-identical chaos event logs;
+- **negotiate-down**, both directions: a node welcomed WITHOUT gossip
+  heartbeats exactly as before (no gossip frames, no gossip tags on the
+  wire — the legacy hub wire stays byte-identical), and a gossip-enabled
+  master keeps a hub-heartbeating legacy member alive via the phi
+  detector (the ring's inevitable slander of it is ignored).
+
+The real-subprocess end of the same story is ``make chaos-gossip``
+(tests/test_chaos_gossip_drill below runs its fixed seed in tier-1).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    GossipConfig,
+    LineMasterConfig,
+    MasterConfig,
+    MetaDataConfig,
+    ThresholdConfig,
+)
+from akka_allreduce_tpu.control import gossip as gsp
+from akka_allreduce_tpu.control.chaos import ChaosInjector
+from akka_allreduce_tpu.control.envelope import Envelope
+from akka_allreduce_tpu.control.gossip import (
+    ALIVE,
+    DEAD,
+    MASTER_ID,
+    SUSPECT,
+    Ack,
+    GossipState,
+    Ping,
+    PingReq,
+)
+
+INTERVAL = 0.5
+
+
+def make_config(**kw) -> GossipConfig:
+    base = dict(
+        enabled=True,
+        probe_interval_s=INTERVAL,
+        probe_timeout_s=0.15,
+        indirect=3,
+        suspicion_periods=4,
+        seed=7,
+    )
+    base.update(kw)
+    return GossipConfig(**base)
+
+
+class Fabric:
+    """Synchronous message fabric driving N member state machines on a
+    logical clock, with an optional per-role chaos injector compiled from
+    the REAL spec grammar (each role gets its own injector, exactly like
+    each OS process does over TCP)."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        *,
+        config: GossipConfig | None = None,
+        chaos_spec: str = "",
+        chaos_seed: int = 99,
+    ) -> None:
+        self.now = 0.0
+        cfg = config or make_config()
+        self.states: dict[int, GossipState] = {
+            MASTER_ID: GossipState(MASTER_ID, 1, cfg)
+        }
+        for i in range(n_nodes):
+            # distinct incarnations, like distinct processes
+            self.states[i] = GossipState(i, 1000 + i, cfg)
+        roster = set(self.states)
+        for st in self.states.values():
+            st.set_members(roster)  # set_members drops the self id
+        self.dead: set[int] = set()  # roles whose process is gone
+        self.injectors: dict[int, ChaosInjector] = {}
+        if chaos_spec:
+            for role in self.states:
+                self.injectors[role] = ChaosInjector(
+                    chaos_seed, chaos_spec, role=role,
+                    clock=lambda: self.now, t0=0.0,
+                )
+
+    def deliver(self, sender: int, envelopes: list[Envelope]) -> None:
+        for env in envelopes:
+            inj = self.injectors.get(sender)
+            if inj is not None:
+                act = inj.plan_send(env)
+                if act is not None and (act.drop or act.fail):
+                    continue  # the fabric's only mechanics: loss
+            target = int(env.dest.rpartition(":")[2])
+            st = self.states.get(target)
+            if st is None or target in self.dead:
+                continue
+            self.deliver(target, st.handle(env.msg, self.now))
+
+    def step(self, dt: float = 0.1) -> None:
+        self.now += dt
+        for role in sorted(self.states):
+            if role in self.dead:
+                continue
+            self.deliver(role, self.states[role].tick(self.now))
+
+    def run(self, seconds: float, dt: float = 0.1) -> None:
+        for _ in range(int(seconds / dt)):
+            self.step(dt)
+
+    @property
+    def master(self) -> GossipState:
+        return self.states[MASTER_ID]
+
+
+# --- the acceptance sims ------------------------------------------------------
+
+
+def test_asymmetric_partition_of_master_inbound_expels_nobody():
+    """64 nodes; a seeded ONE-DIRECTIONAL partition cuts nodes 1..8's
+    sends TO the master (their acks and pings vanish — the congested
+    master-side link). A hub detector would read all 8 as dead; the ring
+    must expel NOBODY: the master's direct probes escalate to ping-reqs
+    and the other nodes' relayed acks keep vouching."""
+    fab = Fabric(
+        64,
+        chaos_spec="partition:from=1+2+3+4+5+6+7+8,to=m,at=1s,heal=10000s",
+    )
+    fab.run(40.0)
+    dead_events = [
+        ev for ev in fab.master.poll_events() if ev.status == DEAD
+    ]
+    assert dead_events == [], f"healthy nodes expelled: {dead_events}"
+    for nid in range(64):
+        assert fab.master.status_of(nid) != DEAD, nid
+    # the win was earned through the indirect path, not through silence:
+    # the master escalated to ping-reqs and the ring kept probing
+    assert fab.master.indirect_sent > 0
+    assert (
+        sum(st.probes_sent for st in fab.states.values()) > 64
+    ), "the ring never probed"
+
+
+def test_truly_dead_node_confirmed_within_pinned_bound():
+    """A member that stops answering IS confirmed dead — detection still
+    works, it just takes more than one vantage point to convict. The
+    bound is pinned in probe periods: first probe + period-end suspicion
+    + the suspicion window + dissemination slack."""
+    cfg = make_config()
+    fab = Fabric(64, config=cfg)
+    fab.run(3.0)  # settle
+    victim = 17
+    fab.dead.add(victim)
+    died_at = fab.now
+    confirmed_at = None
+    for _ in range(600):
+        fab.step(0.1)
+        if fab.master.status_of(victim) == DEAD:
+            confirmed_at = fab.now
+            break
+    assert confirmed_at is not None, "dead node never confirmed"
+    bound = (cfg.suspicion_periods + 6) * cfg.probe_interval_s
+    assert confirmed_at - died_at <= bound, (
+        f"confirmed after {confirmed_at - died_at:.2f}s "
+        f"(bound {bound:.2f}s)"
+    )
+    # and the master's event stream carries the edge exactly once
+    dead_events = [
+        ev
+        for ev in fab.master.poll_events()
+        if ev.status == DEAD and ev.node_id == victim
+    ]
+    assert len(dead_events) == 1
+
+
+def test_refutation_beats_slander():
+    """A suspicion spread about a LIVE node is refuted by its incarnation
+    bump before the confirm timer fires: the slandered node never goes
+    DEAD anywhere, and its refutation is visible in its counters."""
+    fab = Fabric(8)
+    fab.run(2.0)
+    victim = 3
+    inc = fab.states[victim].incarnation
+    # slander arrives at the MASTER as a digest on ordinary ack traffic
+    fab.deliver(
+        5,
+        [
+            Envelope(
+                gsp.gossip_addr(MASTER_ID),
+                Ack(5, 1005, 10_000, ((victim, inc, SUSPECT),)),
+            )
+        ],
+    )
+    assert fab.master.status_of(victim) == SUSPECT
+    fab.run(6.0)  # well past the suspicion window
+    assert fab.states[victim].refutations >= 1
+    assert fab.states[victim].incarnation > inc
+    assert fab.master.status_of(victim) == ALIVE
+    for st in fab.states.values():
+        events = [
+            ev
+            for ev in st.poll_events()
+            if ev.node_id == victim and ev.status == DEAD
+        ]
+        assert events == [], "slander was confirmed somewhere"
+
+
+def test_sim_is_deterministic_including_chaos_log():
+    """Same seed + same fabric -> byte-identical chaos event logs and
+    identical membership judgements (the chaos determinism contract
+    extended to the new one-directional partition form)."""
+
+    def run():
+        fab = Fabric(
+            16,
+            chaos_spec="partition:from=1+2,to=m,at=1s,heal=10000s;"
+            "drop:p=0.02",
+            chaos_seed=424,
+        )
+        fab.run(12.0)
+        logs = {
+            role: inj.event_log_jsonl()
+            for role, inj in sorted(fab.injectors.items())
+        }
+        view = {
+            nid: fab.master.status_of(nid) for nid in range(16)
+        }
+        stats = tuple(
+            (st.probes_sent, st.suspicions, st.confirms)
+            for _, st in sorted(fab.states.items())
+        )
+        return logs, view, stats
+
+    a, b = run(), run()
+    assert a == b
+    # and the one-way form actually fired (the log carries its marker)
+    assert any('"oneway": true' in log for log in a[0].values())
+
+
+def test_oneway_partition_grammar_validation():
+    from akka_allreduce_tpu.control.chaos import parse_spec
+
+    faults = parse_spec("partition:from=m+0,to=1+2,at=2s,heal=3s")
+    assert faults[0].src == frozenset({-1, 0})
+    assert faults[0].dst == frozenset({1, 2})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_spec("partition:groups=m+0|1,from=0,to=m")
+    with pytest.raises(ValueError, match="together"):
+        parse_spec("partition:from=0")
+    with pytest.raises(ValueError, match="groups= or from=/to="):
+        parse_spec("partition:at=2s")
+
+
+def test_oneway_partition_is_directional():
+    """from=1,to=m cuts ONLY node 1's master-bound sends; the reverse
+    direction (and node 1's peer traffic) flows."""
+    inj = ChaosInjector(
+        5, "partition:from=1,to=m", role=1, clock=lambda: 10.0, t0=0.0
+    )
+    blocked = inj.plan_send(Envelope("master", object()))
+    assert blocked is not None and blocked.fail
+    blocked2 = inj.plan_send(Envelope(gsp.gossip_addr(MASTER_ID), object()))
+    assert blocked2 is not None and blocked2.fail
+    assert inj.plan_send(Envelope("gossip:2", object())) is None
+    # the master's own injector lets master->1 through (reverse direction)
+    inj_m = ChaosInjector(
+        5, "partition:from=1,to=m", role=-1, clock=lambda: 10.0, t0=0.0
+    )
+    assert inj_m.plan_send(Envelope("gossip:1", object())) is None
+    assert inj_m.plan_send(Envelope("node:1", object())) is None
+
+
+# --- protocol units -----------------------------------------------------------
+
+
+def test_ping_ack_direct_probe_roundtrip():
+    cfg = make_config()
+    a = GossipState(0, 100, cfg)
+    b = GossipState(1, 101, cfg)
+    for st in (a, b):
+        st.set_members({0, 1})
+    out = a.tick(1.0)
+    assert len(out) == 1 and isinstance(out[0].msg, Ping)
+    assert out[0].dest == "gossip:1"
+    (ack_env,) = b.handle(out[0].msg, 1.0)
+    assert isinstance(ack_env.msg, Ack) and ack_env.dest == "gossip:0"
+    a.handle(ack_env.msg, 1.1)
+    assert not a._pending  # probe satisfied
+    a.tick(1.2)
+    assert a.suspicions == 0
+
+
+def test_missed_ack_escalates_to_ping_req_then_suspect():
+    cfg = make_config()
+    a = GossipState(0, 100, cfg)
+    a.set_members({1, 2, 3, 4})
+    out = a.tick(1.0)
+    assert len(out) == 1  # direct probe at someone
+    target = int(out[0].dest.rpartition(":")[2])
+    # no ack: at the direct deadline the ping-reqs fan out to K others
+    out2 = a.tick(1.0 + cfg.probe_timeout_s)
+    reqs = [e for e in out2 if isinstance(e.msg, PingReq)]
+    assert len(reqs) == cfg.indirect
+    assert all(e.msg.target == target for e in reqs)
+    assert target not in {int(e.dest.rpartition(":")[2]) for e in reqs}
+    # still nothing by the period end: SUSPECT, not dead
+    a.tick(1.0 + cfg.probe_interval_s)
+    assert a.status_of(target) == SUSPECT
+    assert a.suspicions == 1 and a.confirms == 0
+    # unrefuted suspicion confirms after the window
+    a.tick(1.0 + cfg.probe_interval_s + cfg.suspicion_window_s)
+    assert a.status_of(target) == DEAD
+    events = a.poll_events()
+    assert [ev.status for ev in events if ev.node_id == target] == [
+        SUSPECT,
+        DEAD,
+    ]
+
+
+def test_relay_forwards_ack_under_origin_seq():
+    """The PingReq relay leg: C pings B on A's behalf and re-issues B's
+    ack to A under A's seq — A's pending probe is satisfied by an ack it
+    could never have received directly."""
+    cfg = make_config()
+    a, b, c = (GossipState(i, 100 + i, cfg) for i in range(3))
+    for st in (a, b, c):
+        st.set_members({0, 1, 2})
+    (relay_ping,) = c.handle(PingReq(0, 1, 77), 1.0)
+    assert isinstance(relay_ping.msg, Ping) and relay_ping.dest == "gossip:1"
+    (ack_to_c,) = b.handle(relay_ping.msg, 1.0)
+    outs = c.handle(ack_to_c.msg, 1.1)
+    fwd = [e for e in outs if isinstance(e.msg, Ack)]
+    assert len(fwd) == 1 and fwd[0].dest == "gossip:0"
+    assert fwd[0].msg.seq == 77 and fwd[0].msg.sender == 1
+    # A holds a pending probe of B under seq 77: the relayed ack clears it
+    a._pending[77] = gsp._Probe(1, 0.5, 0.65, 1.0)
+    a.handle(fwd[0].msg, 1.2)
+    assert 77 not in a._pending
+
+
+def test_digest_precedence_rules():
+    cfg = make_config()
+    st = GossipState(0, 100, cfg)
+    st.set_members({1})
+    rec = st.members[1]
+    st._absorb(((1, 5, ALIVE),), 1.0)
+    assert rec.incarnation == 5 and rec.status == ALIVE
+    # equal-incarnation suspect beats alive
+    st._absorb(((1, 5, SUSPECT),), 1.0)
+    assert rec.status == SUSPECT
+    # stale alive does NOT clear it; same-inc alive does not either
+    st._absorb(((1, 4, ALIVE),), 1.0)
+    st._absorb(((1, 5, ALIVE),), 1.0)
+    assert rec.status == SUSPECT
+    # higher-incarnation alive (the refutation) does
+    st._absorb(((1, 6, ALIVE),), 1.0)
+    assert rec.status == ALIVE and rec.incarnation == 6
+    # dead is terminal per incarnation...
+    st._absorb(((1, 6, DEAD),), 1.0)
+    assert rec.status == DEAD
+    st._absorb(((1, 6, ALIVE),), 1.0)
+    assert rec.status == DEAD
+    # ...but a strictly newer incarnation revives (rejoin vouched upstream)
+    st._absorb(((1, 7, ALIVE),), 1.0)
+    assert rec.status == ALIVE and rec.incarnation == 7
+
+
+def test_first_hand_evidence_clears_local_suspicion_without_spread():
+    cfg = make_config()
+    st = GossipState(0, 100, cfg)
+    st.set_members({1, 2})
+    st._absorb(((1, 5, SUSPECT),), 1.0)
+    assert st.status_of(1) == SUSPECT
+    st.handle(Ping(1, 5, 9), 1.5)  # the suspect itself talks to us
+    assert st.status_of(1) == ALIVE
+    # the amnesty is local-only: the record's spread budget is spent, so
+    # our digests do not gossip an alive claim we cannot win with
+    digest = st._digest()
+    assert all(entry[0] != 1 for entry in digest)
+
+
+def test_digest_is_bounded_and_spread_budgeted():
+    cfg = make_config(digest_max=5)
+    st = GossipState(0, 100, cfg)
+    st.set_members(range(1, 40))
+    # 39 members x ~3·log2(40) spread budget, 5 entries per digest
+    for _ in range(200):
+        assert len(st._digest()) <= 5
+    # every entry's budget is eventually spent: steady state = empty digest
+    assert st._digest() == ()
+
+
+def test_roster_is_master_authoritative():
+    """Rumors about ids outside the roster are ignored, and set_members
+    add/drop follows the book."""
+    st = GossipState(0, 100, make_config())
+    st.set_members({1, 2})
+    st._absorb(((9, 3, DEAD),), 1.0)
+    assert st.status_of(9) is None
+    st.set_members({1, 2, 9})
+    assert st.status_of(9) == ALIVE
+    st.set_members({1})
+    assert st.status_of(2) is None and st.status_of(9) is None
+    # reset_member revives a DEAD record for a vouched rejoin
+    st._absorb(((1, 200, DEAD),), 1.0)
+    assert st.status_of(1) == DEAD
+    st.reset_member(1, 201)
+    assert st.status_of(1) == ALIVE and st.members[1].incarnation == 201
+
+
+def test_digest_state_roundtrips_through_restore():
+    st = GossipState(MASTER_ID, 1, make_config())
+    st.set_members({0, 1, 2})
+    st._absorb(((1, 7, SUSPECT), (2, 9, DEAD)), 4.0)
+    st.poll_events()
+    replicated = json.loads(json.dumps(st.digest_state()))
+    st2 = GossipState(MASTER_ID, 2, make_config())
+    st2.set_members({0, 1, 2})
+    st2.restore_state(replicated)
+    assert st2.status_of(1) == SUSPECT and st2.members[1].incarnation == 7
+    assert st2.status_of(2) == DEAD
+    # inherited suspicions restart their clock at takeover (no instant
+    # confirm from a clockless digest)
+    assert st2.members[1].suspect_at is None
+
+
+# --- negotiate-down pins (both directions) ------------------------------------
+
+
+def _cluster_config(**gossip_kw) -> AllreduceConfig:
+    return AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        line_master=LineMasterConfig(round_window=2, max_rounds=4),
+        master=MasterConfig(node_num=1, heartbeat_interval_s=0.2),
+        gossip=GossipConfig(**gossip_kw) if gossip_kw else GossipConfig(),
+    )
+
+
+def test_gossip_disabled_is_the_legacy_hub_byte_for_byte():
+    """Direction 1: a cluster left at the default speaks the PR-9 wire —
+    no gossip section behavior, no gossip tags in any frame it would
+    send, and the Heartbeat frame bytes are pinned against a frozen
+    golden (the hub-heartbeat fallback stays byte-identical)."""
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control import wire
+
+    cfg = _cluster_config()
+    assert not cfg.gossip.enabled
+    # config JSON round-trips WITHOUT the section too (a legacy master's
+    # Welcome parses on a gossip-aware node, landing on the defaults)
+    raw = json.loads(cfg.to_json())
+    raw.pop("gossip")
+    old_style = AllreduceConfig.from_json(json.dumps(raw))
+    assert old_style.gossip == GossipConfig()
+    # frozen golden: the hub heartbeat's exact wire bytes (tag 9). If
+    # this pin ever breaks, a legacy peer cannot heartbeat this cluster.
+    hb = cl.Heartbeat(3, 77, "10.0.0.9", 7171)
+    assert wire.encode(hb).hex() == (
+        "09030000004d00000000000000080031302e302e302e39031c"
+    )
+
+
+def test_node_without_gossip_heartbeats_master_with_gossip_survives():
+    """Both directions over the REAL transport: (a) a node welcomed with
+    gossip disabled runs the hub heartbeat loop and no gossip agent;
+    (b) a gossip-enabled master keeps a hub-heartbeating legacy member
+    alive via the phi detector — the ring's slander of the never-acking
+    member is ignored (it never goes unreachable while heartbeats flow).
+    """
+    import asyncio
+
+    asyncio.run(_negotiate_down_body())
+
+
+async def _negotiate_down_body():
+    import asyncio
+
+    import numpy as np
+
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess, NodeProcess
+    from akka_allreduce_tpu.protocol import AllReduceInput
+
+    # (a) disabled -> hub heartbeats, no agent
+    cfg = _cluster_config()
+    master = MasterProcess(cfg, port=0)
+    ep = await master.start()
+    assert master.gossip is None
+    payload = np.zeros(256, dtype=np.float32)
+    node = NodeProcess(ep, lambda r: AllReduceInput(payload), lambda o: None, port=0)
+    await node.start()
+    await node.wait_welcomed()
+    assert node.gossip is None and node._heartbeat_task is not None
+    await node.stop()
+    await master.stop()
+
+    # (b) gossip master + a LEGACY member that only hub-heartbeats
+    from akka_allreduce_tpu.control import cluster as cl
+
+    cfg2 = AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        line_master=LineMasterConfig(round_window=2, max_rounds=-1),
+        master=MasterConfig(node_num=2, heartbeat_interval_s=0.1),
+        gossip=GossipConfig(
+            enabled=True, probe_interval_s=0.1, probe_timeout_s=0.03,
+            suspicion_periods=3,
+        ),
+    )
+    master2 = MasterProcess(cfg2, port=0)
+    ep2 = await master2.start()
+    assert master2.gossip is not None
+    # the legacy member: joins + heartbeats through the raw protocol,
+    # never registers a gossip handler (an old binary)
+    from akka_allreduce_tpu.control.remote import RemoteTransport
+
+    legacy = RemoteTransport("127.0.0.1", 0)
+    legacy.set_route("master", ep2)
+    legacy_ep = await legacy.start()
+    welcomed = asyncio.Event()
+    nid_box = {}
+
+    def on_client(msg):
+        if isinstance(msg, cl.Welcome):
+            nid_box["nid"] = msg.node_id
+            welcomed.set()
+        return []
+
+    legacy.register("client", on_client)
+    legacy.register_prefix("node", lambda _nid, m: [])
+    legacy.register_prefix("worker", lambda _wid, m: [])
+    await legacy.send(
+        Envelope(
+            "master",
+            cl.JoinCluster(legacy_ep.host, legacy_ep.port, -1, 555),
+        )
+    )
+    await asyncio.wait_for(welcomed.wait(), 5)
+    nid = nid_box["nid"]
+    for _ in range(25):  # ~2.5s: far past the ring's suspicion window
+        await legacy.send(
+            Envelope(
+                "master",
+                cl.Heartbeat(nid, 555, legacy_ep.host, legacy_ep.port),
+            )
+        )
+        await asyncio.sleep(0.1)
+    assert nid in master2._hub_speakers
+    assert nid not in master2.unreachable, (
+        "gossip slander expelled a hub-heartbeating legacy member"
+    )
+    await legacy.stop()
+    await master2.stop()
+
+
+# --- sharded LineMasters ------------------------------------------------------
+
+
+def test_line_shards_partition_dims1_membership():
+    from akka_allreduce_tpu.control.grid_master import GridMaster
+
+    grid = GridMaster(
+        ThresholdConfig(1.0, 1.0, 1.0),
+        MasterConfig(node_num=8, dimensions=1, line_shards=3),
+    )
+    out = []
+    for nid in range(8):
+        out.extend(grid.member_up(nid))
+    assert len(grid.line_masters) == 3
+    sizes = sorted(
+        len(lm.worker_ids) for lm in grid.line_masters.values()
+    )
+    assert sizes == [2, 3, 3]
+    # every worker owned by exactly one line
+    owned = sorted(
+        w for lm in grid.line_masters.values() for w in lm.worker_ids
+    )
+    assert owned == list(range(8))
+    # each line prepared ITS workers only
+    for env in out:
+        assert env.dest.startswith("worker:")
+        wid = int(env.dest.rpartition(":")[2])
+        assert wid in grid.line_masters[env.msg.line_id].worker_ids
+    # losing a member re-shards from the current view
+    grid.member_unreachable(5)
+    owned = sorted(
+        w for lm in grid.line_masters.values() for w in lm.worker_ids
+    )
+    assert owned == [0, 1, 2, 3, 4, 6, 7]
+    assert len(grid.line_masters) == 3
+
+
+def test_line_shards_validation():
+    with pytest.raises(ValueError, match="line_shards"):
+        MasterConfig(line_shards=0)
+    with pytest.raises(ValueError, match="dimensions=1"):
+        MasterConfig(dimensions=2, line_shards=2)
+    # more shards than nodes degrades to one line per node
+    from akka_allreduce_tpu.control.grid_master import GridMaster
+
+    grid = GridMaster(
+        ThresholdConfig(1.0, 1.0, 1.0),
+        MasterConfig(node_num=2, dimensions=1, line_shards=8),
+    )
+    grid.member_up(0)
+    grid.member_up(1)
+    assert len(grid.line_masters) == 2
+
+
+def test_gossip_config_validation():
+    with pytest.raises(ValueError, match="probe_timeout_s"):
+        GossipConfig(probe_timeout_s=0.5, probe_interval_s=0.5)
+    with pytest.raises(ValueError, match="suspicion_periods"):
+        GossipConfig(suspicion_periods=0)
+    with pytest.raises(ValueError, match="digest_max"):
+        GossipConfig(digest_max=0)
+    cfg = GossipConfig(probe_interval_s=2.0, suspicion_periods=3)
+    assert cfg.suspicion_window_s == 6.0
+
+
+# --- the fixed-seed subprocess drill (make chaos-gossip) ----------------------
+
+
+def test_chaos_gossip_drill_subprocess(tmp_path):
+    """The acceptance drill as a tier-1 test: real OS processes, a seeded
+    one-way partition of one node's master-bound sends (zero expulsions,
+    rounds keep completing), then a real SIGKILL that gossip must detect.
+    Defaults == ``make chaos-gossip``'s fixed seed; only out-dir differs."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "akka_allreduce_tpu", "chaos-gossip",
+            "--seed", "1234", "--out-dir", str(tmp_path / "run"),
+        ],
+        cwd=root, env=env, capture_output=True, text=True, timeout=420,
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, proc.stderr[-2000:]
+    summary = json.loads(lines[-1])
+    assert proc.returncode == 0, summary
+    assert summary["failures"] == [], summary
+    assert summary["false_expulsions"] == 0
+    assert summary["kill_detected"] is True
+    assert summary["gossip"]["gossip.expulsions"] == 1
+    assert summary["gossip"]["gossip.acks_relayed"] >= 1
+    assert summary["master_done"] is True
+
+
+# --- failover: leadership discovery through the ring --------------------------
+
+
+def test_leader_ping_from_new_endpoint_repoints_and_zombie_cannot_steal():
+    """Unit guards of the node's leadership-discovery hook: a master ring
+    ping from a NEW endpoint at >= the known incarnation repoints the
+    master route; a deposed zombie's lower incarnation cannot steal it.
+    (Regression: without this hook, a promoted standby's ring pings kept
+    nodes' master record ALIVE while their acks still flowed to the dead
+    seed — the promoted master read the silence as death and expelled
+    the whole cluster.)"""
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control.bootstrap import NodeProcess
+
+    seed = cl.Endpoint("127.0.0.1", 7000)
+    node = NodeProcess(seed, lambda r: None, lambda o: None)
+    node.gossip = GossipState(0, 100, make_config())
+    node.gossip.set_members({MASTER_ID, 1})
+    node.gossip.members[MASTER_ID].incarnation = 1  # the old leader's epoch
+    # same endpoint: no-op
+    node._on_gossip_leader_ping(
+        Ping(MASTER_ID, 1, 5, seed.host, seed.port)
+    )
+    assert node.seed == seed
+    # zombie at a LOWER incarnation than we know: route stays
+    node.gossip.members[MASTER_ID].incarnation = 2
+    node._on_gossip_leader_ping(Ping(MASTER_ID, 1, 5, "127.0.0.1", 7001))
+    assert node.seed == seed
+    # promoted leader at a higher incarnation: follow
+    node._on_gossip_leader_ping(Ping(MASTER_ID, 3, 5, "127.0.0.1", 7002))
+    assert node.seed == cl.Endpoint("127.0.0.1", 7002)
+    # non-master / portless pings never move the route
+    node._on_gossip_leader_ping(Ping(1, 99, 5, "127.0.0.1", 7003))
+    node._on_gossip_leader_ping(Ping(MASTER_ID, 9, 5, "", 0))
+    assert node.seed == cl.Endpoint("127.0.0.1", 7002)
+
+
+def test_failover_under_gossip_resumes_rounds_on_promoted_master():
+    """End to end over real TCP, in one loop: leader + warm standby + 2
+    gossip nodes; the leader dies; the standby takes over and the nodes
+    — steered by the ring (confirmed-dead walk or the promoted master's
+    own pings) — re-join it and rounds RESUME under epoch 2."""
+    import asyncio
+
+    asyncio.run(_failover_under_gossip_body())
+
+
+async def _failover_under_gossip_body():
+    import asyncio
+    import time as _time
+
+    import numpy as np
+
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess, NodeProcess
+    from akka_allreduce_tpu.protocol import AllReduceInput
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=512, max_chunk_size=256),
+        line_master=LineMasterConfig(round_window=2, max_rounds=-1),
+        master=MasterConfig(node_num=2, heartbeat_interval_s=0.1),
+        gossip=GossipConfig(
+            enabled=True, probe_interval_s=0.2, probe_timeout_s=0.06,
+            suspicion_periods=3,
+        ),
+    )
+    master = MasterProcess(cfg, port=0)
+    ep = await master.start()
+    standby = MasterProcess(cfg, port=0, standby_of=ep)
+    await standby.start()
+    payload = np.ones(512, dtype=np.float32)
+    nodes = []
+    for _ in range(2):
+        n = NodeProcess(
+            ep, lambda r: AllReduceInput(payload), lambda o: None, port=0
+        )
+        await n.start()
+        nodes.append(n)
+    for n in nodes:
+        await n.wait_welcomed()
+    await asyncio.sleep(1.0)
+    await master.stop()  # the leader dies mid-run
+    deadline = _time.monotonic() + 45
+    while _time.monotonic() < deadline:
+        if (
+            standby._took_over
+            and len(standby.grid.nodes) == 2
+            and not standby.unreachable
+            and all(
+                lm.total_completed > 0
+                for lm in standby.grid.line_masters.values()
+            )
+            and standby.grid.line_masters
+        ):
+            break
+        await asyncio.sleep(0.2)
+    try:
+        assert standby._took_over, "standby never took over"
+        assert len(standby.grid.nodes) == 2 and not standby.unreachable, (
+            standby.grid.nodes, standby.unreachable,
+        )
+        assert standby.grid.line_masters and all(
+            lm.total_completed > 0
+            for lm in standby.grid.line_masters.values()
+        ), "no rounds completed under the promoted master"
+        assert standby.epoch > 1
+    finally:
+        for n in nodes:
+            await n.stop()
+        await standby.stop()
+
+
+def test_expelled_but_alive_member_is_healed_by_its_own_gossip():
+    """The ring edition of the hub's resumed-heartbeat re-line: a member
+    expelled on a transient freeze keeps gossiping; its next frame at the
+    master re-admits it (regression: without this, gossip expulsion was a
+    one-way door — the record left the roster with the membership, so no
+    vouch could ever fire for it)."""
+    import numpy as np
+
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        line_master=LineMasterConfig(round_window=2, max_rounds=-1),
+        master=MasterConfig(node_num=2, heartbeat_interval_s=0.2),
+        gossip=GossipConfig(enabled=True, probe_interval_s=0.2,
+                            probe_timeout_s=0.06),
+    )
+    clock = {"now": 100.0}
+    master = MasterProcess(cfg, port=0, clock=lambda: clock["now"])
+    from akka_allreduce_tpu.control import cluster as cl
+
+    # admit two members synchronously (no transport needed for this path)
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.1", 7001, -1, 11))
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.2", 7002, -1, 12))
+    assert master.grid.nodes == {0, 1}
+    # gossip confirms member 1 dead (a freeze): the subscriber expels it
+    master.gossip._absorb(((1, 12, gsp.DEAD),), clock["now"])
+    clock["now"] += 10.0  # far past the admission-grace window
+    out, expelled = master._consume_gossip(clock["now"])
+    assert expelled and 1 in master.unreachable
+    assert master.gossip.status_of(1) is None  # dropped from the roster
+    # ...and then the member thaws and pings the master: re-admitted
+    replies = master._on_gossip_msg(Ping(1, 12, 5, "10.0.0.2", 7002))
+    assert replies, "no heal envelopes for the expelled-but-alive member"
+    assert 1 not in master.unreachable
+    assert master.gossip.status_of(1) == ALIVE
+    assert 1 in master.grid.nodes
+
+
+def test_stale_incarnation_frames_are_not_liveness_evidence():
+    """Zombie guard, ring edition (the hub's heartbeat path had exactly
+    this): a stale-incarnation predecessor's frames must not clear
+    suspicion of the id's CURRENT holder — or a dead rejoiner could be
+    vouched alive by its own ghost forever."""
+    st = GossipState(0, 100, make_config())
+    st.set_members({1})
+    st.reset_member(1, 500)  # the current holder's incarnation
+    st._absorb(((1, 500, SUSPECT),), 1.0)
+    assert st.status_of(1) == SUSPECT
+    # the ghost (incarnation 400) talks: NOT evidence for the holder
+    st.handle(Ping(1, 400, 9), 1.5)
+    assert st.status_of(1) == SUSPECT
+    # the holder itself talks: cleared
+    st.handle(Ping(1, 500, 10), 1.6)
+    assert st.status_of(1) == ALIVE
+
+
+def test_master_replies_shutdown_to_superseded_zombie_gossip():
+    """Master-side zombie guard: gossip frames from a superseded
+    incarnation get the same Shutdown('superseded') the hub's heartbeat
+    path sent, and never heal/vouch anything."""
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        master=MasterConfig(node_num=1, heartbeat_interval_s=0.2),
+        gossip=GossipConfig(enabled=True, probe_interval_s=0.2,
+                            probe_timeout_s=0.06),
+    )
+    master = MasterProcess(cfg, port=0, clock=lambda: 100.0)
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.1", 7001, -1, 11))
+    # the old holder is expelled (a live member's identity is protected
+    # from takeover), then the id is reclaimed from a NEW endpoint: the
+    # old process (inc 11) becomes the remembered superseded ghost
+    master.grid.member_unreachable(0)
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.2", 7002, 0, 22))
+    assert master._incarnations[0] == 22
+    assert master._superseded[0] == (11, cl.Endpoint("10.0.0.1", 7001))
+    out = master._on_gossip_msg(Ping(0, 11, 5, "10.0.0.1", 7001))
+    assert out and isinstance(out[0].msg, cl.Shutdown)
+    assert out[0].msg.reason == "superseded"
+    # the current holder's frames pass the guard (no reply needed)
+    assert master._on_gossip_msg(Ping(0, 22, 6, "10.0.0.2", 7002)) is None
+
+
+def test_relay_entries_expire_with_the_probe_period():
+    """A relay whose target never acks (the PingReq case par excellence)
+    must not leak bookkeeping forever."""
+    cfg = make_config()
+    st = GossipState(2, 102, cfg)
+    st.set_members({0, 1})
+    st.handle(PingReq(0, 1, 77), 1.0)
+    assert len(st._relays) == 1
+    st.tick(1.0 + cfg.probe_interval_s + 0.01)
+    assert st._relays == {}
+
+
+def test_refuted_then_expelled_member_still_heals():
+    """The holder's GOSSIP incarnation legitimately drifts above its
+    CLUSTER incarnation with every slander refutation; the master's
+    zombie guard must compare strictly-below (a `!=` once locked a
+    refuted-then-expelled healthy node out of the heal arm forever)."""
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        master=MasterConfig(node_num=2, heartbeat_interval_s=0.2),
+        gossip=GossipConfig(enabled=True, probe_interval_s=0.2,
+                            probe_timeout_s=0.06),
+    )
+    master = MasterProcess(cfg, port=0, clock=lambda: 100.0)
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.1", 7001, -1, 700))
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.2", 7002, -1, 800))
+    # node 0 is slandered, refutes TWICE (gossip inc 702 > cluster 700),
+    # but the refutations lose the race: expelled anyway
+    master.gossip._absorb(((0, 702, gsp.DEAD),), 100.0)
+    master._consume_gossip(100.0 + 10.0)
+    assert 0 in master.unreachable
+    # its post-heal frames carry the DRIFTED incarnation: must re-admit
+    out = master._on_gossip_msg(Ping(0, 702, 9, "10.0.0.1", 7001))
+    assert out, "refuted-then-expelled node was not healed"
+    assert 0 not in master.unreachable and 0 in master.grid.nodes
+
+
+def test_stale_dead_event_refuted_before_poll_does_not_expel():
+    """A refutation that lands between the ring's confirm and the
+    master's next poll makes the queued DEAD verdict stale: acting on it
+    would expel a node the ring no longer believes dead — and under the
+    asymmetric partition no direct frame could ever heal it back."""
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    cfg = AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        master=MasterConfig(node_num=2, heartbeat_interval_s=0.2),
+        gossip=GossipConfig(enabled=True, probe_interval_s=0.2,
+                            probe_timeout_s=0.06),
+    )
+    master = MasterProcess(cfg, port=0, clock=lambda: 100.0)
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.1", 7001, -1, 700))
+    master._on_cluster_msg(cl.JoinCluster("10.0.0.2", 7002, -1, 800))
+    # confirm queues the DEAD event...
+    master.gossip._absorb(((0, 700, gsp.DEAD),), 100.0)
+    # ...but the refutation lands BEFORE the next poll drains it
+    master.gossip._absorb(((0, 701, ALIVE),), 100.1)
+    out, expelled = master._consume_gossip(110.0)
+    assert not expelled and 0 not in master.unreachable
+    assert 0 in master.grid.nodes

@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 from akka_allreduce_tpu.control import cluster as cl
+from akka_allreduce_tpu.control import gossip as gp
 from akka_allreduce_tpu.control import statetransfer as st
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.obs.trace import TraceContext
@@ -48,6 +49,14 @@ _STANDBYS = (("10.0.0.3", 9001), ("10.0.0.4", 9002))
 _DIGEST_STATE = (
     '{"book": [[0, "10.0.0.1", 7070]], "incarnations": {"0": 5},'
     ' "round": {"next": 12, "completed": 9, "config_id": 3}}'
+)
+
+# the gossip tags' piggybacked membership digest: alive/suspect/dead
+# entries, 64-bit incarnations, and the master's -1 id all present
+_GOSSIP_DIGEST = (
+    (1, 0x5000012345, gp.ALIVE),
+    (-1, 7, gp.SUSPECT),
+    (9, 0x7FFF_FFFF_FFFF, gp.DEAD),
 )
 
 # the RoundPolicy trailing field on tags 1/5 (control/adapt.py): a
@@ -91,6 +100,14 @@ _SAMPLES = {
     cl.StandbyRegister: cl.StandbyRegister("10.0.0.3", 9001),
     cl.StateDigest: cl.StateDigest(6, 1234, "10.0.0.1", 7070, _DIGEST_STATE),
     st.AdvertSolicit: st.AdvertSolicit("manifest-miss"),
+    # SWIM gossip membership (tags 24-26): every field non-default, a
+    # multi-entry digest covering all three status bytes and the master's
+    # negative member id, so a dropped entry field cannot round-trip by luck
+    gp.Ping: gp.Ping(
+        3, 0x5000012345, 41, "10.0.0.9", 7171, _GOSSIP_DIGEST
+    ),
+    gp.PingReq: gp.PingReq(2, 5, 42, _GOSSIP_DIGEST),
+    gp.Ack: gp.Ack(5, 0x5000054321, 43, _GOSSIP_DIGEST),
 }
 
 
@@ -397,3 +414,40 @@ def test_trace_trailer_cost_is_constant():
     plain = wire.encode_frame("w", msg)
     traced = wire.encode_frame("w", msg, trace=_TCTX)
     assert len(traced) - len(plain) == wire._TRACE_LEN == 25
+
+
+# --- gossip tags (24-26): truncation + empty-digest arms ----------------------
+
+
+@pytest.mark.parametrize(
+    "msg_type", [gp.Ping, gp.PingReq, gp.Ack],
+    ids=["ping", "ping_req", "ack"],
+)
+def test_gossip_truncation_is_rejected(msg_type):
+    """A gossip frame cut anywhere inside its digest (or fixed header)
+    must raise out of decode — the transport's undecodable-drop path
+    catches it; it must never yield a silently-shorter digest."""
+    data = wire.encode(_SAMPLES[msg_type])
+    for cut in (3, len(data) // 2, len(data) - 3):
+        with pytest.raises(Exception):
+            wire.decode(data[:cut])
+
+
+@pytest.mark.parametrize(
+    "msg_type", [gp.Ping, gp.PingReq, gp.Ack],
+    ids=["ping", "ping_req", "ack"],
+)
+def test_gossip_empty_digest_roundtrips(msg_type):
+    """Steady state: the piggyback budget is spent and digests are empty
+    — the common-case frame must stay tiny and round-trip exactly."""
+    msg = _SAMPLES[msg_type]
+    bare = type(msg)(
+        **{
+            f: (() if f == "digest" else getattr(msg, f))
+            for f in vars(msg)
+        }
+    )
+    back = wire.decode(wire.encode(bare))
+    _assert_equal(bare, back)
+    assert back.digest == ()
+    assert len(wire.encode(bare)) < 48
