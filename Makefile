@@ -29,14 +29,17 @@ native:
 trace-demo:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu obs demo --out-dir trace_demo
 
-# deterministic host data-plane microbench (BENCHMARKS.md round 8): wire
-# codec throughput (encode+checksum / decode+verify) and the syscall-
+# deterministic host data-plane microbench (BENCHMARKS.md rounds 8-9):
+# wire codec throughput (encode+checksum / decode+verify), the syscall-
 # batching levers (one sendmsg per frame vs one sendmmsg per burst, plus
-# the recvmmsg mirror) over loopback — interleaved legs, JSON medians, so
-# the batch-path win is measurable even when the shared box is too noisy
-# for the pair-cluster A/B to resolve it.
+# the recvmmsg mirror) over loopback — interleaved legs, JSON medians —
+# and one record per data plane v3 lever: io_uring vs sendmmsg (or the
+# probe's fallback reason on a kernel without io_uring), the one-chunk-
+# round intra-chunk striping A/B over per-stream-paced drains, and the
+# congestion scheduler's deterministic shed/restore trajectory.
 bench-wire:
-	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu bench-wire --json
+	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu bench-wire --json \
+	  --uring --intra-chunk --congestion
 
 # fixed-seed 30-second chaos soak (RESILIENCE.md): real master + 3 node
 # processes under seeded drop/delay/corruption + a mid-run partition that
@@ -45,6 +48,7 @@ bench-wire:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m akka_allreduce_tpu chaos --seed 1234 \
 	  --duration 30 --nodes 3 --th 0.66 --streams 2 --gossip \
+	  --uring --intra-chunk 1048576 --congestion \
 	  --out-dir chaos_run \
 	  --spec "drop:p=0.05;delay:ms=10;corrupt:p=0.02;partition:groups=m+0+1|2,at=10s,heal=8s"
 
@@ -57,6 +61,7 @@ chaos:
 chaos-recover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
 	  chaos-recover --seed 1234 --streams 2 --gossip \
+	  --uring --intra-chunk 1048576 --congestion \
 	  --out-dir chaos_recover_run
 
 # fixed-seed master-kill failover drill (RESILIENCE.md "Tier 4"): a seeded
@@ -67,6 +72,7 @@ chaos-recover:
 chaos-failover:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
 	  chaos-failover --seed 1234 --streams 2 --gossip \
+	  --uring --intra-chunk 1048576 --congestion \
 	  --out-dir chaos_failover_run
 
 # fixed-seed adaptive-degradation drill (RESILIENCE.md "Tier 5"): a seeded
@@ -77,7 +83,8 @@ chaos-failover:
 # payloads, --uniform-check) must stay within the EF error budget.
 chaos-adapt:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-adapt --seed 1234 --streams 2 --gossip --out-dir chaos_adapt_run
+	  chaos-adapt --seed 1234 --streams 2 --gossip \
+	  --uring --intra-chunk 1048576 --congestion --out-dir chaos_adapt_run
 
 # fixed-seed decentralized-membership drill (RESILIENCE.md "Tier 6"): a
 # seeded ONE-DIRECTIONAL partition cuts one node's sends to the master
@@ -87,7 +94,8 @@ chaos-adapt:
 # confirmed dead by the ring and expelled.
 chaos-gossip:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
-	  chaos-gossip --seed 1234 --streams 2 --out-dir chaos_gossip_run
+	  chaos-gossip --seed 1234 --streams 2 \
+	  --uring --intra-chunk 1048576 --congestion --out-dir chaos_gossip_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -215,6 +215,28 @@ def _add_data_plane_flags(p: argparse.ArgumentParser) -> None:
         help="worker threads for INBOUND decode offload of >=4MB bodies "
         "(0 = auto: streams x endpoints, capped at 8)",
     )
+    # the data plane v3 levers (BENCHMARKS.md round 9) — each independently
+    # gated, defaulting off, riding Welcome like every knob above
+    p.add_argument(
+        "--uring", action="store_true",
+        help="drain sender-thread bursts through io_uring (one ring "
+        "submission per burst; runtime-probed — kernels without it fall "
+        "back to the sendmmsg/sendmsg path, byte-identical)",
+    )
+    p.add_argument(
+        "--intra-chunk", type=int, default=0, metavar="BYTES",
+        dest="intra_chunk",
+        help="split payload frames at/above this many encoded bytes into "
+        "sub-frames striped across the payload streams (needs --streams "
+        ">= 3 to actually split; 0 = off) — a one-chunk round stops "
+        "serializing onto one socket",
+    )
+    p.add_argument(
+        "--congestion", action="store_true",
+        help="congestion-aware stripe scheduling: per-stream drain "
+        "evidence shifts assignment weight away from a persistently slow "
+        "stream (deficit-weighted, hysteresis both edges)",
+    )
 
 
 def _add_sharded_compress_flag(p: argparse.ArgumentParser) -> None:
@@ -1154,6 +1176,9 @@ def _run_cluster_master(args) -> int:
         data_plane=DataPlaneConfig(
             streams=getattr(args, "streams", 1),
             pump_pool=getattr(args, "pump_pool", 0),
+            uring=getattr(args, "uring", False),
+            intra_chunk_min_bytes=getattr(args, "intra_chunk", 0),
+            congestion=getattr(args, "congestion", False),
         ),
         gossip=_gossip_config_from(args),
     )
@@ -1362,8 +1387,22 @@ def _cmd_cluster_node(argv: list[str]) -> int:
         )
         if args.state_dir:
             # the rejoin restore path: disk when it is current, else a
-            # parallel chunk pull from live peer holders (statetransfer)
-            rest = await node.restore_state()
+            # parallel chunk pull from live peer holders (statetransfer).
+            # give_up: rounds flush through THIS loop while the restore
+            # coroutine waits its turn — once a couple of save periods
+            # have gone by with the master still answering "nothing
+            # known", more blind patience only pushes the first
+            # checkpoint past an early seeded crash (the chaos-recover
+            # flake under load); an active chunk pull is never capped
+            flushes0 = state["flushes"]
+            # one save period of our own rounds: the whole pipeline behind
+            # the gate (save -> replicate -> peers verify -> advert) needs
+            # its own rounds of margin before a seeded early crash, so the
+            # blind window must not eat a second period
+            budget = max(1, args.state_every or 1)
+            rest = await node.restore_state(
+                give_up=lambda: state["flushes"] - flushes0 >= budget
+            )
             if rest is not None and rest.get("complete"):
                 try:
                     step, saved = node.state.store.load_state()
@@ -2591,6 +2630,41 @@ def _drill_gossip_args(args) -> list[str]:
     ]
 
 
+def _add_drill_lever_flags(p: argparse.ArgumentParser) -> None:
+    """Every chaos drill can arm the data plane v3 levers on its cluster
+    (the Makefile pins all three, like --streams 2 and --gossip): the
+    drills then prove their scenario survives the levered plane too. With
+    --streams 2 the intra-chunk split is inert by construction (one
+    payload stream — nothing to split across), but the knob distribution,
+    scheduler, and uring probe/fallback paths all run."""
+    p.add_argument(
+        "--uring", action="store_true",
+        help="arm io_uring burst submission on the drill's cluster",
+    )
+    p.add_argument(
+        "--intra-chunk", type=int, default=0, metavar="BYTES",
+        dest="intra_chunk",
+        help="arm intra-chunk striping at this byte bar (0 = off)",
+    )
+    p.add_argument(
+        "--congestion", action="store_true",
+        help="arm congestion-aware stripe scheduling",
+    )
+
+
+def _drill_lever_args(args) -> list[str]:
+    """Extra cluster-master CLI args arming the v3 levers for a drill."""
+    out: list[str] = []
+    if getattr(args, "uring", False):
+        out.append("--uring")
+    bar = getattr(args, "intra_chunk", 0)
+    if bar:
+        out += ["--intra-chunk", str(bar)]
+    if getattr(args, "congestion", False):
+        out.append("--congestion")
+    return out
+
+
 def _drill_jsonl_records(path):
     """Records of a (possibly live) metrics JSONL — the ONE torn-tolerant
     reader every drill scan goes through: blank lines and the in-progress
@@ -2657,6 +2731,25 @@ def _cmd_bench_wire(argv: list[str]) -> int:
     p.add_argument("--reps", type=int, default=9, help="interleaved reps/leg")
     p.add_argument("--json", action="store_true", help="print the JSON record")
     p.add_argument("--out", default=None, help="append the JSON record here")
+    # data plane v3 per-lever A/Bs (BENCHMARKS.md round 9): each flag runs
+    # its lever's leg and emits ONE extra JSON record, so `make bench-wire`
+    # reproduces every A/B in one command
+    p.add_argument(
+        "--uring", action="store_true",
+        help="A/B io_uring burst submission vs sendmmsg (or record the "
+        "runtime probe's fallback reason on a kernel without io_uring)",
+    )
+    p.add_argument(
+        "--intra-chunk", action="store_true", dest="intra_chunk",
+        help="A/B a ONE-chunk round (one giant frame) on one stream vs "
+        "split across payload streams, over per-stream-paced loopback "
+        "drains (the per-connection bandwidth-ceiling model)",
+    )
+    p.add_argument(
+        "--congestion", action="store_true",
+        help="run the stripe scheduler's shed/restore simulation under a "
+        "fake clock (deterministic: the record includes the replay check)",
+    )
     args = p.parse_args(argv)
 
     import json
@@ -2855,13 +2948,284 @@ def _cmd_bench_wire(argv: list[str]) -> int:
         "recv_loop_mbps": mbps(recv["recv_loop"]),
         "recvmmsg_mbps": mbps(recv["recvmmsg"]),
     }
-    line = json.dumps(record, sort_keys=True)
+    records = [record]
+    if args.uring:
+        records.append(_bench_wire_uring(args, frames_bytes, payload_bytes))
+    if args.intra_chunk:
+        records.append(_bench_wire_intra_chunk(args))
+    if args.congestion:
+        records.append(_bench_wire_congestion())
+    out_lines = [json.dumps(r, sort_keys=True) for r in records]
     if args.out:
         with open(args.out, "a") as f:
-            f.write(line + "\n")
+            for line in out_lines:
+                f.write(line + "\n")
     if args.json or not args.out:
-        print(line)
+        for line in out_lines:
+            print(line)
     return 0
+
+
+def _bench_wire_uring(args, frames_bytes, payload_bytes) -> dict:
+    """Lever (a): io_uring burst submission vs the sendmmsg batch — same
+    frame mix, same loopback drain, interleaved legs. On a kernel without
+    io_uring the record carries the probe's fallback reason instead of a
+    number: the lever's honest state on this box."""
+    import socket
+    import statistics
+    import threading
+
+    from akka_allreduce_tpu import native
+
+    rec: dict = {
+        "bench": "wire",
+        "lever": "uring",
+        "uring_available": native.uring_available(),
+        "uring_probe_reason": native.uring_probe_reason(),
+        "uring_mbps": None,
+        "sendmmsg_mbps": None,
+    }
+    if not native.uring_available() or not native.batch_send_available():
+        return rec
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    tx = socket.create_connection(srv.getsockname())
+    rx, _ = srv.accept()
+    srv.close()
+    tx.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    stop = threading.Event()
+
+    def drain() -> None:
+        sink = bytearray(1 << 20)
+        while not stop.is_set():
+            try:
+                if not rx.recv_into(sink):
+                    return
+            except OSError:
+                return
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    ring = native.UringRing()
+
+    def advance(frames: list, n: int) -> None:
+        while n and frames:
+            head = frames[0]
+            while n and head:
+                seg = head[0]
+                if n >= len(seg):
+                    n -= len(seg)
+                    head.pop(0)
+                else:
+                    head[0] = seg[n:]
+                    n = 0
+            if not head:
+                frames.pop(0)
+
+    def send_all(use_uring: bool) -> None:
+        frames = [[memoryview(f)] for f in frames_bytes]
+        while frames:
+            if use_uring:
+                flat = [v for fr in frames for v in fr]
+                try:
+                    n = ring.send(tx.fileno(), flat)
+                except BlockingIOError:
+                    continue
+            else:
+                n = native.batch_send(tx.fileno(), frames)
+            advance(frames, n)
+
+    times: dict[str, list[float]] = {"sendmmsg": [], "uring": []}
+    try:
+        for _ in range(args.reps):
+            for key, flag in (("sendmmsg", False), ("uring", True)):
+                t0 = time.perf_counter()
+                send_all(flag)
+                times[key].append(time.perf_counter() - t0)
+    finally:
+        ring.close()
+        stop.set()
+        tx.close()
+        rx.close()
+        drainer.join(timeout=2.0)
+    for key in times:
+        rec[f"{key}_mbps"] = round(
+            payload_bytes / statistics.median(times[key]) / 1e6, 1
+        )
+    rec["uring_ge_sendmmsg"] = rec["uring_mbps"] >= rec["sendmmsg_mbps"]
+    return rec
+
+
+def _bench_wire_intra_chunk(args) -> dict:
+    """Lever (b): a ONE-chunk round's bytes over one stream (what chunk-id
+    striping does to a single-tensor allreduce or a state-transfer frame)
+    vs split across 3 payload streams — over loopback connections whose
+    drains are PACED to a fixed per-stream rate, the model of the real
+    phenomenon (each TCP stream has a bandwidth ceiling; on loopback the
+    kernel would otherwise hide it). The bytes are a real encoded frame,
+    split at the same offsets the transport's splitter uses."""
+    import socket
+    import statistics
+    import threading
+
+    import numpy as np
+
+    from akka_allreduce_tpu.control import wire
+    from akka_allreduce_tpu.protocol import ScatterBlock
+
+    n_payload = 3  # streams=4
+    pace_mbps = 200.0  # per-stream drain ceiling
+    read_chunk = 256 << 10
+    value = np.random.default_rng(7).standard_normal(6_000_000).astype(
+        np.float32
+    )  # ~24 MB one-chunk frame
+    body = b"".join(
+        bytes(p) for p in wire.encode_frame_parts("worker:1", ScatterBlock(value, 0, 1, 0, 1))
+    )
+
+    def leg(n_streams: int) -> float:
+        frag = -(-len(body) // n_streams)
+        slices = [
+            body[i * frag : (i + 1) * frag] for i in range(n_streams)
+        ]
+        pairs = []
+        for _ in range(n_streams):
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            c = socket.create_connection(srv.getsockname())
+            a, _ = srv.accept()
+            srv.close()
+            pairs.append((c, a))
+        done = threading.Barrier(2 * n_streams + 1)
+
+        def write(sock, blob) -> None:
+            try:
+                sock.sendall(blob)
+            finally:
+                done.wait()
+
+        def drain(sock, want: int) -> None:
+            sink = bytearray(read_chunk)
+            got = 0
+            budget = time.perf_counter()
+            try:
+                while got < want:
+                    n = sock.recv_into(sink)
+                    if not n:
+                        break
+                    got += n
+                    # pace: this stream may not drain faster than the
+                    # per-stream ceiling — sleep off any surplus
+                    budget += n / (pace_mbps * 1e6)
+                    now = time.perf_counter()
+                    if budget > now:
+                        time.sleep(budget - now)
+            finally:
+                done.wait()
+
+        threads = []
+        t0 = time.perf_counter()
+        for (c, a), blob in zip(pairs, slices):
+            threads.append(
+                threading.Thread(target=write, args=(c, blob), daemon=True)
+            )
+            threads.append(
+                threading.Thread(
+                    target=drain, args=(a, len(blob)), daemon=True
+                )
+            )
+        for t in threads:
+            t.start()
+        done.wait()
+        dt = time.perf_counter() - t0
+        for c, a in pairs:
+            c.close()
+            a.close()
+        return dt
+
+    single: list[float] = []
+    striped: list[float] = []
+    for _ in range(max(3, args.reps // 3)):
+        single.append(leg(1))
+        striped.append(leg(n_payload))
+    s, m = statistics.median(single), statistics.median(striped)
+    return {
+        "bench": "wire",
+        "lever": "intra_chunk",
+        "model": f"per-stream drains paced at {pace_mbps:g} MB/s",
+        "frame_mb": round(len(body) / 1e6, 1),
+        "payload_streams": n_payload,
+        "single_stream_s": round(s, 4),
+        "striped_s": round(m, 4),
+        "speedup": round(s / m, 2),
+    }
+
+
+def _bench_wire_congestion() -> dict:
+    """Lever (c): the stripe scheduler's closed loop under a FAKE clock —
+    a 3-stream endpoint where stream 2 drains at 15% (the chaos ``delay``
+    shape), then heals. Deterministic by construction (no wall clock, no
+    RNG): the record carries a replay check and the windows-to-shed the
+    acceptance bar asks for."""
+    from akka_allreduce_tpu.control.stripes import StripeScheduler
+
+    degraded = 2
+    frame = 1 << 20
+
+    def run() -> tuple[list[float], dict]:
+        sched = StripeScheduler(3)
+        fair = 1.0 / 3.0
+        shares: list[float] = []
+        backlog = [0, 0, 0]  # the simulated sockets' unsent bytes
+        windows_to_half = None
+        restored_at = None
+        for w in range(40):
+            now = w * sched.window_s
+            for _ in range(12):
+                idx = sched.pick(frame, now)
+                backlog[idx] += frame
+            healed = w >= 20
+            for i in range(3):
+                # per-window drain capacity: healthy streams clear their
+                # queue (backlog included — a healed stream catches up),
+                # the degraded one moves 15% of a fair window
+                cap = (16 << 20) if (i != degraded or healed) else int(
+                    0.15 * (4 << 20)
+                )
+                sent = min(backlog[i], cap)
+                backlog[i] -= sent
+                sched.note_sent(i, sent, now)
+            share = sched.share(degraded)
+            shares.append(round(share, 4))
+            if windows_to_half is None and share <= fair / 2.0:
+                windows_to_half = w + 1
+            if (
+                windows_to_half is not None
+                and restored_at is None
+                and healed
+                and share >= fair * 0.9
+            ):
+                restored_at = w + 1
+        return shares, {
+            "windows_to_half_share": windows_to_half,
+            "restored_by_window": restored_at,
+            "final_weights": sched.snapshot()["weights"],
+            "sheds": sched.sheds,
+            "restores": sched.restores,
+        }
+
+    shares_a, rec = run()
+    shares_b, _ = run()
+    return {
+        "bench": "wire",
+        "lever": "congestion",
+        "degraded_stream": degraded,
+        "share_trajectory": shares_a[:12],
+        "deterministic": shares_a == shares_b,
+        **rec,
+    }
 
 
 def _cmd_chaos(argv: list[str]) -> int:
@@ -2902,6 +3266,7 @@ def _cmd_chaos(argv: list[str]) -> int:
     )
     p.add_argument("--out-dir", default="chaos_run")
     _add_drill_gossip_flags(p)
+    _add_drill_lever_flags(p)
     args = p.parse_args(argv)
     # fail fast on a malformed spec BEFORE spawning anything — a parse
     # error inside the master subprocess would surface as an opaque
@@ -2937,6 +3302,7 @@ def _cmd_chaos(argv: list[str]) -> int:
         "--chaos-seed", str(args.seed), "--chaos-spec", args.spec,
         "--chaos-log", master_log, "--metrics-out", metrics_path,
         *_drill_gossip_args(args),
+        *_drill_lever_args(args),
     )
     nodes = []
     t0 = time.perf_counter()
@@ -3128,6 +3494,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     p.add_argument("--state-every", type=int, default=5)
     p.add_argument("--out-dir", default="chaos_recover_run")
     _add_drill_gossip_flags(p)
+    _add_drill_lever_flags(p)
     args = p.parse_args(argv)
     if args.nodes < 3:
         p.error("need >= 3 nodes: the victim plus at least 2 replica holders")
@@ -3186,6 +3553,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--metrics-out", metrics_path,
         *_drill_gossip_args(args),
+        *_drill_lever_args(args),
     )
     nodes = []
     try:
@@ -3382,6 +3750,7 @@ def _cmd_chaos_gossip(argv: list[str]) -> int:
         "--streams", type=int, default=1,
         help="data-plane sockets per endpoint (distributed via Welcome)",
     )
+    _add_drill_lever_flags(p)
     p.add_argument("--out-dir", default="chaos_gossip_run")
     args = p.parse_args(argv)
     if args.nodes < 4:
@@ -3423,6 +3792,7 @@ def _cmd_chaos_gossip(argv: list[str]) -> int:
         "--chunk", str(args.chunk), "--th", str(args.th),
         "--heartbeat", str(args.heartbeat),
         "--streams", str(args.streams),
+        *_drill_lever_args(args),
         "--gossip", "--gossip-interval", str(args.gossip_interval),
         "--chaos-seed", str(args.seed), "--chaos-spec", spec,
         "--chaos-log", os.path.join(args.out_dir, "chaos-master.jsonl"),
@@ -3600,6 +3970,7 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
     p.add_argument("--state-every", type=int, default=5)
     p.add_argument("--out-dir", default="chaos_failover_run")
     _add_drill_gossip_flags(p)
+    _add_drill_lever_flags(p)
     args = p.parse_args(argv)
     if args.nodes < 3:
         p.error("need >= 3 nodes: a restore victim plus 2 replica holders")
@@ -3678,6 +4049,7 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
         "--chaos-log", os.path.join(args.out_dir, "chaos-leader.jsonl"),
         "--metrics-out", leader_metrics,
         *_drill_gossip_args(args),
+        *_drill_lever_args(args),
     )
     standby = None
     nodes = []
@@ -3739,6 +4111,20 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
             nodes[victim].wait()
             node_exits[victim] = nodes[victim].returncode
             shutil.rmtree(state_dirs[victim], ignore_errors=True)
+            # phase 4.5 — the chaos-recover deflake applied here too:
+            # respawn only after the PROMOTED master demonstrably expelled
+            # the victim (a reduced-membership round in its metrics). A
+            # join that races the detector reads the victim's id as a
+            # LIVE member and mints the reborn node a FRESH id with no
+            # checkpoint history — its restore then honestly reports
+            # 'none' while the replicas sit on live peers under the old
+            # id.
+            await_phase(
+                lambda: _drill_full_rounds(standby_metrics, args.nodes - 1)
+                >= 1,
+                "the promoted master's observed expulsion of the victim",
+            )
+        if not failures:
             reborn = spawn_node(standby_ep, victim)
             reborn_pump = pump(reborn, reborn_lines)
             await_phase(
@@ -3921,6 +4307,7 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
     p.add_argument("--adapt-lag", type=int, default=8)
     p.add_argument("--out-dir", default="chaos_adapt_run")
     _add_drill_gossip_flags(p)
+    _add_drill_lever_flags(p)
     args = p.parse_args(argv)
 
     import json
@@ -3990,6 +4377,7 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
         "--adapt-lag", str(args.adapt_lag),
         "--adapt-log", adapt_log,
         *_drill_gossip_args(args),
+        *_drill_lever_args(args),
     )
     nodes = []
     node_out: dict[int, str] = {}

@@ -200,10 +200,34 @@ class DataPlaneConfig:
     section, so the whole cluster agrees on one stream count — a cluster
     left at the ``streams=1`` default speaks the PR-8 wire byte for byte
     (the version-skew contract, pinned in tests/test_multistream.py).
+
+    Three further levers on the stream plane (BENCHMARKS.md round 9), each
+    independently flag-gated and defaulting OFF so a config from an older
+    master negotiates every one of them down:
+
+    - ``uring``: sender threads submit each batch through an io_uring ring
+      (one submission per burst — the next syscall step past ``sendmmsg``).
+      Runtime-probed like the batch syscalls: a kernel without io_uring
+      (ENOSYS, or gVisor/seccomp EPERM) silently falls back to the
+      sendmmsg/sendmsg path, byte-identical either way.
+    - ``intra_chunk_min_bytes``: payload frames at least this many encoded
+      bytes are SPLIT into sub-frames striped across the payload streams
+      (needs >= 2 of them, i.e. ``streams >= 3``, to actually split), so a
+      one-chunk round — single-tensor allreduce, the state-transfer restore
+      path — no longer serializes onto one stream. 0 disables; when set it
+      must be >= 65536 (finer splits cost more framing than they win).
+    - ``congestion``: stripe assignment (chunk striping AND sub-chunk
+      fragments) goes through a deficit-weighted scheduler fed by the
+      per-stream byte gauges, so a persistently slow stream sheds
+      assignment weight instead of gating every round
+      (control/stripes.py).
     """
 
     streams: int = 1
     pump_pool: int = 0
+    uring: bool = False
+    intra_chunk_min_bytes: int = 0
+    congestion: bool = False
 
     def __post_init__(self) -> None:
         if not 1 <= self.streams <= 16:
@@ -213,6 +237,13 @@ class DataPlaneConfig:
         if not 0 <= self.pump_pool <= 64:
             raise ValueError(
                 f"pump_pool must be in [0, 64], got {self.pump_pool}"
+            )
+        if self.intra_chunk_min_bytes != 0 and not (
+            65536 <= self.intra_chunk_min_bytes <= (1 << 31)
+        ):
+            raise ValueError(
+                "intra_chunk_min_bytes must be 0 (off) or in [65536, 2^31], "
+                f"got {self.intra_chunk_min_bytes}"
             )
 
 

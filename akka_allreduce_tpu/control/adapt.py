@@ -194,16 +194,17 @@ class AdaptiveController:
             d
             for k, v in bandwidth.items()
             if k in known and (d := max(0.0, float(v) - known[k])) > 0.0
-            # zero-delta endpoints are excluded: the transport's gauge
-            # rows are cumulative and never removed, so an expelled or
-            # departed peer's FROZEN row would otherwise read as
-            # permanent pressure (ratio 0 forever, restore never) — a
-            # silent endpoint is membership's problem (tiers 3/6); this
-            # arm judges links that are MOVING data, just too little.
-            # First-seen endpoints (no watermark yet) are excluded too:
-            # a peer that joined mid-window carries only partial-window
-            # bytes and would read as a spurious straggler — it gets its
-            # watermark seeded now and is judged from the next window
+            # zero-delta endpoints are excluded for the QUIET-WINDOW case
+            # only: a link that moved nothing this window indicts nobody
+            # (membership — tiers 3/6 — owns silent peers, and the
+            # transport now EVICTS an expelled peer's rows outright via
+            # forget_endpoint, so a dead peer's frozen row can no longer
+            # masquerade as one); this arm judges links that are MOVING
+            # data, just too little. First-seen endpoints (no watermark
+            # yet) are excluded too: a peer that joined mid-window
+            # carries only partial-window bytes and would read as a
+            # spurious straggler — it gets its watermark seeded now and
+            # is judged from the next window
         )
         self._last_bw = {k: float(v) for k, v in bandwidth.items()}
         if len(deltas) < 3:

@@ -172,8 +172,7 @@ class MasterProcess:
         self.transport = RemoteTransport(host, port)
         self.transport.wire_f16 = config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = config.master.retry
-        self.transport.streams = config.data_plane.streams
-        self.transport.pump_pool_size = config.data_plane.pump_pool
+        self.transport.configure_data_plane(config.data_plane)
         if config.chaos.enabled:
             self._arm_chaos()
         # peer checkpoint registry (statetransfer, RESILIENCE.md "Recovery"):
@@ -801,8 +800,7 @@ class MasterProcess:
         # these knobs
         self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = self.config.master.retry
-        self.transport.streams = self.config.data_plane.streams
-        self.transport.pump_pool_size = self.config.data_plane.pump_pool
+        self.transport.configure_data_plane(self.config.data_plane)
         if self.config.chaos.enabled and self.transport.chaos is None:
             self._arm_chaos()
             from akka_allreduce_tpu.control.chaos import MASTER_ROLE
@@ -1239,6 +1237,16 @@ class MasterProcess:
                 data_bytes=self.config.metadata.data_size * 4,
             )
 
+    def _forget_endpoint_rows(self, node_id: int) -> None:
+        """Membership just expelled ``node_id``: evict its per-endpoint
+        transport telemetry rows (tx/rx/stream/reconnect gauges are
+        otherwise cumulative forever — a dead peer's frozen row polluted
+        every later snapshot, and PR 10's bandwidth arm had to
+        special-case it). A re-joining process regrows rows from zero."""
+        ep = self.book.get(node_id)
+        if ep is not None:
+            self.transport.forget_endpoint(ep)
+
     def _gather_bandwidth(self) -> dict[str, float] | None:
         """Per-endpoint cumulative tx+rx bytes from PR-9's transport
         gauges, as visible to THIS process (in-process transports all
@@ -1294,6 +1302,7 @@ class MasterProcess:
                 # heartbeats resume, _on_heartbeat re-lines it without a new
                 # JoinCluster; a genuine restart re-joins explicitly.
                 self.unreachable.add(event.node_id)
+                self._forget_endpoint_rows(event.node_id)
                 self._digest_static = None  # membership changed
                 expelled = True
         if expelled:
@@ -1379,6 +1388,7 @@ class MasterProcess:
             self.monitor.force_unreachable(nid, now)
             out.extend(self.grid.member_unreachable(nid))
             self.unreachable.add(nid)
+            self._forget_endpoint_rows(nid)
             self._gossip_roster()
             self._digest_static = None
             expelled = True
@@ -1734,9 +1744,17 @@ class NodeProcess:
         self._master_send_failures = 0  # the master is talking to us
         if isinstance(msg, cl.AddressBook):
             self.book = msg
+            prev = self._endpoints
             self._endpoints = {
                 nid: cl.Endpoint(host, port) for nid, host, port in msg.entries
             }
+            # a peer the membership dropped (expulsion or leave) takes its
+            # per-endpoint transport telemetry rows with it — cumulative
+            # gauges must not carry dead peers forever (the master does
+            # the same at its expulsion sites)
+            live = set(self._endpoints.values())
+            for ep in set(prev.values()) - live:
+                self.transport.forget_endpoint(ep)
             # a standby registering mid-run reaches us here (Welcome only
             # covers the join); the walk order follows the leader's list
             self.standbys = [
@@ -1793,11 +1811,11 @@ class NodeProcess:
         # width (decode is stateless — the flag travels per frame)
         self.transport.wire_f16 = self.config.metadata.wire_dtype == "f16"
         self.transport.retry_policy = self.config.master.retry
-        # the data-plane shard count arrives the same way: connections made
+        # the data-plane knobs arrive the same way: connections made
         # BEFORE Welcome (the join itself) were legacy stream-0 links and
-        # stay valid; new payload senders stripe from here on
-        self.transport.streams = self.config.data_plane.streams
-        self.transport.pump_pool_size = self.config.data_plane.pump_pool
+        # stay valid; new payload senders stripe (and split, and schedule)
+        # from here on
+        self.transport.configure_data_plane(self.config.data_plane)
         self.node_id = msg.node_id
         dims = self.config.master.dimensions
         if self.config.chaos.enabled:
@@ -2101,16 +2119,37 @@ class NodeProcess:
             task.add_done_callback(self._replicate_tasks.discard)
         return stats
 
-    async def restore_state(self, *, rounds: int = 3) -> dict | None:
+    async def restore_state(
+        self, *, rounds: int = 3, give_up: Callable[[], bool] | None = None
+    ) -> dict | None:
         """The rejoin restore path (RESILIENCE.md "Recovery"): prefer the
         local disk when it already holds the newest known step; otherwise
         pull the manifest's chunks from live peer holders — per-chunk
         retry/failover, resumable across ``rounds`` attempts with a FRESH
         holder map each time (a partition heal mid-restore changes who is
         reachable). Returns restore stats (``source`` disk|peer) or None
-        when there is nothing to restore anywhere."""
+        when there is nothing to restore anywhere.
+
+        ``give_up`` is the caller's OWN-PROGRESS evidence for the blind
+        patience below: a callable answering True once the caller has
+        demonstrably moved on (the cluster-node role passes its flushed-
+        round count against a couple of save periods). Rounds completing
+        THROUGH this node prove the master is alive and scheduling — so
+        when the registry still answers "nothing known" while our rounds
+        race past the first save window, waiting longer only pushes the
+        first checkpoint further out (on a loaded box the restore
+        coroutine shares the event loop with round traffic, and each
+        manifest exchange can cost a second of queueing — patience that
+        outruns a seeded early crash was exactly the chaos-recover
+        failure mode). It caps ONLY the nothing-known patience: an active
+        chunk pull (holders known) is never abandoned by it."""
         if self.state is None or self._chunk_store is None:
             return None
+        hb_interval = (
+            self.config.master.heartbeat_interval_s
+            if self.config is not None
+            else 0.5
+        )
         t0 = time.perf_counter()
         reply = await self.state.request_manifest()
         latest = self._chunk_store.latest()
@@ -2128,28 +2167,56 @@ class NodeProcess:
             # and a long blind wait can push the first checkpoint past an
             # early failure). Silence (no answer at all) keeps the full
             # retry budget: that is a master still coming up.
-            interval = (
-                self.config.master.heartbeat_interval_s
-                if self.config is not None
-                else 0.5
-            )
+            interval = hb_interval
             explicit_misses = 1 if reply is not None else 0
             members_seen = len(self._endpoints)
-            for _ in range(max(1, rounds)):
-                if explicit_misses >= 3:
-                    break
-                await asyncio.sleep(interval)
-                if len(self._endpoints) != members_seen:
-                    # membership is still converging on the (replacement)
-                    # master — every rejoin may bring a holder's adverts,
-                    # so visible progress resets the miss budget
-                    members_seen = len(self._endpoints)
-                    explicit_misses = 0
-                reply = await self.state.request_manifest()
-                if reply is not None and reply.step >= 0:
-                    break
-                if reply is not None:
-                    explicit_misses += 1
+
+            async def _patient_ask() -> None:
+                nonlocal reply, explicit_misses, members_seen
+                for _ in range(max(1, rounds)):
+                    if explicit_misses >= 3:
+                        return
+                    await asyncio.sleep(interval)
+                    if len(self._endpoints) != members_seen:
+                        # membership is still converging on the
+                        # (replacement) master — every rejoin may bring a
+                        # holder's adverts, so visible progress resets
+                        # the miss budget
+                        members_seen = len(self._endpoints)
+                        explicit_misses = 0
+                    r = await self.state.request_manifest()
+                    if r is not None:
+                        reply = r
+                        if r.step >= 0:
+                            return
+                        explicit_misses += 1
+
+            if give_up is None:
+                await _patient_ask()
+            else:
+                # the caller's round progress bounds the blind window HARD
+                # — checked between iterations alone it loses to one slow
+                # exchange (the reply queues behind MB-scale round frames
+                # in OUR inbox; a single manifest round-trip measured ~10
+                # rounds of latency on a saturated box), so the whole
+                # patience phase races a cheap progress poll and is
+                # cancelled mid-await once rounds outrun it
+                ask = observed_task(
+                    _patient_ask(), name="restore-patience"
+                )
+                while not ask.done():
+                    # the cut needs BOTH kinds of evidence: our own rounds
+                    # outrunning the window AND at least one explicit
+                    # "nothing known" answer — pure silence is reply
+                    # LATENCY (a busy master, our own backlogged inbox),
+                    # and cutting on it alone would abandon peer state a
+                    # slow first reply was about to offer (seen against a
+                    # freshly promoted standby mid-failover)
+                    if explicit_misses >= 1 and give_up():
+                        ask.cancel()
+                        break
+                    await asyncio.sleep(0.05)
+                await asyncio.wait([ask])
         known_step = reply.step if reply is not None else -1
         if latest is not None and latest[0] >= known_step:
             stats = {
@@ -2167,6 +2234,13 @@ class NodeProcess:
         for attempt in range(max(1, rounds)):
             if not reply.holders:
                 break
+            if not any(h in self._endpoints for h in reply.holders):
+                # right after a (re)join the address book may still be in
+                # flight: every ``ckpt:<holder>`` send would drop no_route
+                # INSTANTLY, burning the whole per-chunk retry budget in
+                # microseconds — give the book one heartbeat to land
+                # before spending an attempt
+                await asyncio.sleep(hb_interval)
             stats = await self.state.restore_from_peers(
                 reply.step, reply.manifest_json, list(reply.holders)
             )

@@ -36,8 +36,10 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import errno as _errno
 import logging
 import random
+import select
 import socket
 import struct
 import sys
@@ -51,6 +53,7 @@ from akka_allreduce_tpu import native
 from akka_allreduce_tpu.config import RetryPolicy
 from akka_allreduce_tpu.control import wire
 from akka_allreduce_tpu.control.cluster import Endpoint
+from akka_allreduce_tpu.control.stripes import StripeScheduler
 from akka_allreduce_tpu.control.envelope import Envelope
 from akka_allreduce_tpu.obs import flight as _flight
 from akka_allreduce_tpu.obs import metrics as _metrics
@@ -120,6 +123,26 @@ _STRIPED_TYPES = (ScatterBlock, ReduceBlock)
 # reconnect dropped frames mid-stream (at-most-once absorbs the loss; the
 # counter makes it visible per process).
 _STREAM_SEQ_GAPS = _metrics.counter("transport.stream_seq_gaps")
+
+# io_uring submission accounting (OBSERVABILITY.md): ring submissions the
+# sender threads made, and runtime fallbacks — a kernel that probed fine but
+# rejects the first real submit (5.1/5.2) latches the transport back to the
+# sendmmsg/sendmsg path and counts it here, once.
+_URING_SUBMITS = _metrics.counter("uring.submits")
+_URING_FALLBACKS = _metrics.counter("uring.fallbacks")
+
+# Intra-chunk striping accounting: sub-chunk continuation frames sent, whole
+# frames reassembled from stripes, and assemblies evicted half-built (a
+# sender died mid-frame; bounded by _FRAG_ASM_MAX so a lossy peer cannot
+# grow assembly buffers forever).
+_FRAGS_SENT = _metrics.counter("transport.frags_sent")
+_FRAGS_REASSEMBLED = _metrics.counter("transport.frags_reassembled")
+_DROP_FRAG_STALE = _metrics.counter("transport.dropped.frag_stale")
+
+# In-flight fragment assemblies per transport: each holds one pooled
+# frame-sized buffer, so the cap bounds memory against a peer whose stripes
+# never complete (dead sender mid-frame, sustained loss).
+_FRAG_ASM_MAX = 32
 
 # Inbound payload bodies at least this big decode in a pump-pool thread;
 # smaller ones decode inline on the event loop. The crossover is where the
@@ -259,6 +282,7 @@ class _Frame:
 
     __slots__ = (
         "parts", "envs", "nbytes", "coalesced", "inflight", "encode_job",
+        "frag",
     )
 
     def __init__(self, parts: list | None, envs: list, nbytes: int, coalesced: bool) -> None:
@@ -271,6 +295,79 @@ class _Frame:
         # BufferError) and no backpressure drop (stream would desync)
         self.inflight = False
         self.encode_job: tuple | None = None
+        # intra-chunk stripe: (shared encode, frag_id, total_len, offset,
+        # length) — this frame carries bytes [offset, offset+length) of one
+        # split payload frame's body behind a continuation header
+        self.frag: tuple | None = None
+
+
+class _SharedEncode:
+    """One deferred encode shared by every stripe of a split payload frame.
+
+    The stripes ride DIFFERENT sender threads; whichever reaches its batch
+    first runs the encode + checksum (and any chaos corruption — applied to
+    the WHOLE frame once, so a corrupt fault hits the reassembled bytes
+    exactly as it would an unsplit frame) under the lock, and the rest
+    slice the same segment list. The payload views alias the engine's
+    memory — splitting adds framing bytes, never a payload copy.
+
+    NB every stripe's _Frame carries the SAME envelope, so per-send
+    accounting is per STRIPE for a split frame: on_send_ok fires up to
+    nstripes times, a lost stripe counts one drop, and a partially failed
+    split can emit both ok and error callbacks for one logical send.
+    Today's consumers are type-filtered (the statetransfer repair path
+    keys on ChunkData/ReplicaManifest, which never split; the rejoin
+    counter keys on master destinations), so the multiplicity on payload
+    frames is inert — a future consumer keying per-envelope semantics off
+    payload-frame callbacks must dedupe here first."""
+
+    __slots__ = ("lock", "env", "tctx", "mode", "act", "parts")
+
+    def __init__(self, env: Envelope, tctx, mode: str, act) -> None:
+        self.lock = threading.Lock()
+        self.env = env
+        self.tctx = tctx
+        self.mode = mode
+        self.act = act
+        self.parts: list | None = None
+
+    def ensure(self, transport: "RemoteTransport") -> tuple[list, float]:
+        """(encoded parts, encode seconds charged to THIS caller — zero
+        for every stripe after the first)."""
+        with self.lock:
+            if self.parts is not None:
+                return self.parts, 0.0
+            t0 = time.perf_counter()
+            parts = wire.encode_frame_parts(
+                self.env.dest, self.env.msg, wire=self.mode, trace=self.tctx
+            )
+            act = self.act
+            if act is not None and act.corrupt and transport.chaos is not None:
+                parts = transport.chaos.corrupt_frame_parts(parts, act)
+            self.parts = parts
+            return parts, time.perf_counter() - t0
+
+
+class _FragAssembly:
+    """One split frame mid-reassembly: a pooled frame-sized buffer the
+    stripes land in directly (each fragment recv_intos its own byte range
+    — no join copy ever happens), plus the received-byte watermark.
+
+    ``seen`` records each counted stripe's offset: a sender's partial-
+    batch reconnect RESENDS already-delivered stripes (identical bytes —
+    the shared encode is cached), and counting one twice would complete
+    the assembly with another stripe's range still unwritten. ``writers``
+    counts connections currently in direct-mode recv INTO this buffer, so
+    completion never pools a buffer a late duplicate is still writing."""
+
+    __slots__ = ("buf", "total", "got", "seen", "writers")
+
+    def __init__(self, buf: bytearray, total: int) -> None:
+        self.buf = buf
+        self.total = total
+        self.got = 0
+        self.seen: set[int] = set()
+        self.writers = 0
 
 
 class _Sender:
@@ -287,7 +384,7 @@ class _Sender:
     __slots__ = (
         "queue", "queued_bytes", "sock", "writer_task", "attempts",
         "waiters", "closed", "stream_id", "seq", "need_preamble",
-        "cond", "thread",
+        "cond", "thread", "uring",
     )
 
     def __init__(self, stream_id: int = 0) -> None:
@@ -315,6 +412,9 @@ class _Sender:
         self.stream_id = stream_id
         self.seq = 0
         self.need_preamble = False
+        # io_uring submission ring (DataPlaneConfig.uring): created and
+        # closed by the OWNING sender thread — rings are never shared
+        self.uring = None
 
     def close_sock(self) -> None:
         if self.sock is not None:
@@ -370,6 +470,13 @@ class _FrameReceiver(asyncio.BufferedProtocol):
         self._body: bytearray | None = None  # direct-mode target buffer
         self._need = 0
         self._got = 0
+        # direct mode lands bytes at [base+got, base+need) of _body: base
+        # stays 0 for whole frame bodies; a sub-chunk continuation frame
+        # (intra-chunk striping) sets it to the fragment's offset in the
+        # shared assembly buffer, with _frag_info = (key, assembly,
+        # fragment length) so completion can advance the reassembly
+        self._body_base = 0
+        self._frag_info: tuple | None = None
         self._transport: asyncio.Transport | None = None
         # multi-stream state: the first 4 bytes of a connection decide its
         # framing (STREAM_MAGIC's 0xFFFFFFFF prefix can never be a legal
@@ -412,6 +519,13 @@ class _FrameReceiver(asyncio.BufferedProtocol):
 
     def connection_lost(self, exc) -> None:
         self._owner._server_conns.discard(self._transport)
+        if self._frag_info is not None:
+            # a fragment died mid-direct-recv: release the write claim so
+            # the assembly's eventual completion can pool its buffer
+            self._frag_info[1].writers -= 1
+            self._frag_info = None
+            self._body = None
+            self._body_base = 0
         if self._rx_registered and self._peer_key is not None:
             n = self._owner._rx_streams.get(self._peer_key, 1) - 1
             if n <= 0:
@@ -427,7 +541,8 @@ class _FrameReceiver(asyncio.BufferedProtocol):
 
     def get_buffer(self, sizehint: int) -> memoryview:
         if self._body is not None:
-            return memoryview(self._body)[self._got : self._need]
+            base = self._body_base
+            return memoryview(self._body)[base + self._got : base + self._need]
         # the BufferedProtocol contract REQUIRES handing out this view: the
         # event loop recv_intos it and reports back via buffer_updated before
         # the ring is ever parsed or compacted, so the view cannot outlive a
@@ -448,7 +563,18 @@ class _FrameReceiver(asyncio.BufferedProtocol):
             if self._got < self._need:
                 return
             body, need = self._body, self._need
+            frag = self._frag_info
             self._body = None
+            self._body_base = 0
+            self._frag_info = None
+            if frag is not None:
+                # one stripe of a split frame finished: advance the shared
+                # assembly; the whole frame delivers when the last stripe
+                # (whichever stream it rode) completes the byte count
+                key, rec, offset, frag_len = frag
+                rec.writers -= 1
+                owner._frag_advance(self, key, rec, offset, frag_len)
+                return
             self._deliver(body, need, pooled=body)
             return
         self._rlen += nbytes
@@ -519,6 +645,32 @@ class _FrameReceiver(asyncio.BufferedProtocol):
                 _DROP_EMPTY.inc()
                 pos += hdr
                 continue
+            if self._stream_id >= 1 and length >= 2 and avail < hdr + 2:
+                # a payload-stream body's first two bytes decide its shape
+                # (0xFFFF = sub-chunk continuation, anything else a whole
+                # frame's dest-length prefix) — never enter direct mode
+                # before the peek, or a fragment's bytes would land in a
+                # whole-frame buffer and decode as garbage
+                break
+            if (
+                self._stream_id >= 1
+                # a real continuation frame is always longer than its
+                # header — the bound also keeps the 2-byte peek inside
+                # the guard above (a length-1 body would otherwise read
+                # one byte past what this frame owns)
+                and length > wire.FRAG_HDR_LEN
+                and ring[pos + hdr] == 0xFF
+                and ring[pos + hdr + 1] == 0xFF
+            ):
+                nxt = self._begin_fragment(ring, pos, avail, hdr, length)
+                if nxt == -2:
+                    return  # protocol error: connection closed
+                if nxt == -1:
+                    break  # continuation header straddles the recv: wait
+                pos = nxt
+                if self._body is not None:
+                    break  # fragment tail arrives in direct mode
+                continue
             if length > self._SMALL_BODY_MAX:
                 if hdr == 8:
                     self._check_seq(_U32.unpack_from(ring, pos + 4)[0])
@@ -570,6 +722,73 @@ class _FrameReceiver(asyncio.BufferedProtocol):
                 self._stream_id, self._peer_key, expect, seq,
             )
         self._owner._rx_seq_expect[key] = (seq + 1) & 0xFFFF_FFFF
+
+    def _begin_fragment(
+        self, ring: bytearray, pos: int, avail: int, hdr: int, length: int
+    ) -> int:
+        """Consume one sub-chunk continuation frame's header + whatever of
+        its bytes the ring already holds, landing them at the fragment's
+        offset in the shared assembly buffer. Returns the new parse
+        position; -1 = header incomplete (wait for more bytes, nothing
+        consumed); -2 = protocol error, connection closed. Leaves the
+        connection in direct mode (``_body`` set) when the fragment's tail
+        is still in flight."""
+        owner = self._owner
+        if avail - hdr < wire.FRAG_HDR_LEN:
+            return -1
+        try:
+            if length <= wire.FRAG_HDR_LEN:
+                raise ValueError(f"continuation frame of {length} bytes")
+            frag_id, total, offset = wire.parse_frag_header(
+                memoryview(ring)[pos + hdr : pos + hdr + wire.FRAG_HDR_LEN]
+            )
+            frag_len = length - wire.FRAG_HDR_LEN
+            if offset + frag_len > total:
+                raise ValueError("fragment overruns its frame body")
+            if total > owner.max_frame_bytes:
+                raise ValueError(f"reassembled frame of {total} bytes")
+        except ValueError as exc:
+            # a malformed continuation header means this stream's framing
+            # can no longer be trusted (an offset lie would corrupt a
+            # shared assembly buffer): drop the connection, like oversize
+            log.warning("bad continuation frame (%s); closing connection", exc)
+            owner.dropped += 1
+            _DROP_UNDECODABLE.inc()
+            assert self._transport is not None
+            self._transport.close()
+            return -2
+        self._check_seq(_U32.unpack_from(ring, pos + 4)[0])
+        rec = owner._frag_get((self._peer_key, frag_id), total)
+        if rec is None:
+            log.warning(
+                "continuation frame total mismatch from %s; closing",
+                self._peer_key,
+            )
+            owner.dropped += 1
+            _DROP_UNDECODABLE.inc()
+            assert self._transport is not None
+            self._transport.close()
+            return -2
+        body_off = pos + hdr + wire.FRAG_HDR_LEN
+        got = min(avail - hdr - wire.FRAG_HDR_LEN, frag_len)
+        if got:
+            rec.buf[offset : offset + got] = memoryview(ring)[
+                body_off : body_off + got
+            ]
+        if got == frag_len:
+            owner._frag_advance(
+                self, (self._peer_key, frag_id), rec, offset, frag_len
+            )
+            return body_off + got
+        # direct mode into the assembly buffer at the fragment's remaining
+        # range — by construction nothing can follow an incomplete body
+        self._body = rec.buf
+        self._body_base = offset
+        self._got = got
+        self._need = frag_len
+        rec.writers += 1
+        self._frag_info = ((self._peer_key, frag_id), rec, offset, frag_len)
+        return body_off + got
 
     def _deliver(self, buf, need: int, *, pooled: bytearray | None) -> None:
         owner = self._owner
@@ -679,6 +898,22 @@ class RemoteTransport:
         # checksum/sendmmsg (and inbound decode) into the pump pool.
         self.streams = 1
         self.pump_pool_size = 0  # 0 = auto (streams x endpoints, capped)
+        # data plane v3 levers (DataPlaneConfig, BENCHMARKS.md round 9),
+        # each defaulting OFF so a legacy config negotiates them down:
+        # io_uring burst submission in the sender threads (runtime-probed;
+        # _uring_off latches after the first kernel refusal), intra-chunk
+        # striping of payload frames at/above the byte bar, and the
+        # congestion-aware stripe scheduler (control/stripes.py)
+        self.uring = False
+        self.intra_chunk_min_bytes = 0
+        self.congestion = False
+        self._uring_off = False
+        self._stripe_sched: dict[Endpoint, StripeScheduler] = {}
+        # in-flight sub-chunk reassemblies, keyed (peer key, frag id) —
+        # loop-only (the receive path is loop-only), bounded by
+        # _FRAG_ASM_MAX
+        self._frag_asm: dict[tuple, _FragAssembly] = {}
+        self._next_frag_id = 0
         self._pool: concurrent.futures.ThreadPoolExecutor | None = None
         # the loop the transport runs on, captured at first stream send —
         # sender threads post their loop-side callbacks through it
@@ -709,6 +944,18 @@ class RemoteTransport:
         # the registry sees this transport's stage/drop totals at snapshot
         # time (pull-model collector — zero registry writes on the hot path)
         _live_transports.add(self)
+
+    def configure_data_plane(self, dp) -> None:
+        """Adopt a ``DataPlaneConfig`` (ctor / Welcome / standby takeover
+        — every site must arm the same knobs, so there is ONE of these):
+        stream count, pump pool, and the three v3 levers. A config from an
+        older master simply lacks the new fields' section and lands on the
+        defaults — every lever negotiates down."""
+        self.streams = dp.streams
+        self.pump_pool_size = dp.pump_pool
+        self.uring = dp.uring
+        self.intra_chunk_min_bytes = dp.intra_chunk_min_bytes
+        self.congestion = dp.congestion
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -801,6 +1048,8 @@ class RemoteTransport:
             await asyncio.gather(*writers, return_exceptions=True)
         self._senders.clear()
         self._recv_pool.clear()
+        self._frag_asm.clear()
+        self._stripe_sched.clear()
 
     # -- receive-buffer pool ----------------------------------------------------
 
@@ -837,6 +1086,123 @@ class RemoteTransport:
             return
         buf.append(last)
         self._recv_pool.append(buf)
+
+    # -- sub-chunk reassembly (intra-chunk striping) -----------------------------
+
+    def _frag_get(self, key: tuple, total: int) -> _FragAssembly | None:
+        """The assembly record for split frame ``key``, created on the
+        first stripe (one pooled frame-sized buffer every later stripe
+        lands in directly). None = the peer re-used a frag id with a
+        different total — a protocol error the caller treats like a bad
+        length prefix."""
+        rec = self._frag_asm.get(key)
+        if rec is not None:
+            return rec if rec.total == total else None
+        while len(self._frag_asm) >= _FRAG_ASM_MAX:
+            # bound memory against stripes that will never complete (a
+            # sender dead-lettered mid-frame): evict the OLDEST assembly —
+            # at-most-once absorbs the loss, the counter makes it visible.
+            # The buffer is DROPPED, never pooled: a connection may still
+            # be mid-recv into it (direct mode), and pooling it would hand
+            # the same bytearray to a second assembly — two writers, one
+            # buffer. The GC reclaims it once the last writer lets go.
+            self._frag_asm.pop(next(iter(self._frag_asm)))
+            self.dropped += 1
+            _DROP_FRAG_STALE.inc()
+        rec = _FragAssembly(self._acquire_recv_buf(total), total)
+        self._frag_asm[key] = rec
+        return rec
+
+    def _frag_advance(
+        self, conn: "_FrameReceiver", key: tuple, rec: _FragAssembly,
+        offset: int, frag_len: int,
+    ) -> None:
+        """One stripe of ``key`` fully landed: deliver the reassembled
+        frame once every body byte has (whichever stream carried the last
+        stripe delivers — stripe arrival order is free)."""
+        if offset in rec.seen:
+            return  # duplicate stripe (sender reconnect resend): the
+            # rewrite was byte-identical, the count must not move
+        rec.seen.add(offset)
+        rec.got += frag_len
+        if rec.got < rec.total:
+            return
+        # identity-guarded pop: ``rec`` may have been cap-evicted and its
+        # key since reused by a NEWER assembly — completing the orphan
+        # must not tear the replacement out of the table (the orphan's
+        # data is complete and correct, so it still delivers; a duplicate
+        # of the frame is at-most-once's bread and butter)
+        if self._frag_asm.get(key) is rec:
+            self._frag_asm.pop(key)
+        _FRAGS_REASSEMBLED.inc()
+        # pool the buffer only when NO connection is still direct-recving
+        # into it (a late duplicate stripe): pooling under a live writer
+        # would hand the next inbound frame a buffer that stripe keeps
+        # scribbling on
+        conn._deliver(
+            rec.buf, rec.total,
+            pooled=rec.buf if rec.writers == 0 else None,
+        )
+
+    # -- per-endpoint telemetry lifecycle ----------------------------------------
+
+    def forget_endpoint(self, ep: Endpoint) -> None:
+        """Evict every per-endpoint accounting row for ``ep`` — called when
+        MEMBERSHIP expels the peer, so the registry snapshot stops carrying
+        dead ``transport.endpoint.<host:port>.*`` rows forever (they are
+        otherwise cumulative: before this hook the adapt controller's
+        bandwidth arm had to special-case frozen rows as permanent
+        straggler pressure). A peer that re-joins regrows its rows from
+        zero, which is also the honest reading of a fresh process.
+
+        The peer's senders close too — an expelled endpoint is one this
+        process has stopped dialing, and a live sender would re-seed the
+        collector's row (its stream_count gauge) on the next snapshot.
+        Queued frames are DEAD-LETTERED, never silently cleared: the
+        at-most-once error callback per envelope is what lets higher
+        layers repair themselves (the state-transfer push dedup un-marks
+        a lost ChunkData on ``on_send_error`` and re-pushes next lap — a
+        silent drop here once wedged replication for a whole run when a
+        transient phi flap shrank the address book)."""
+        log.info("evicting endpoint %s (telemetry rows + senders)", ep)
+        for skey in [k for k in self._senders if k[0] == ep]:
+            snd = self._senders.pop(skey)
+            if snd.thread is not None:
+                # the sender THREAD owns its socket and its queue: flag it
+                # closed and let the thread dead-letter the leftovers and
+                # close the fd on its way out (draining from here would
+                # race the thread's in-flight batch bookkeeping, and
+                # closing the fd could yank it mid-syscall)
+                with snd.cond:
+                    snd.closed = True
+                    snd.cond.notify_all()
+                continue
+            # loop-task sender: cancel the writer FIRST (it is parked at an
+            # await and cannot resume before this method returns, so the
+            # cancellation lands at its await point — never inside its
+            # post-send queue bookkeeping), then _fail_sender drains with
+            # the full at-most-once accounting
+            task = snd.writer_task
+            if task is not None and not task.done():
+                task.cancel()
+            snd.closed = True
+            self._fail_sender(ep, snd, OSError("endpoint evicted"))
+        key = f"{ep.host}:{ep.port}"
+        with self._stats_lock:
+            self.endpoint_tx.pop(key, None)
+            self.endpoint_rx.pop(key, None)
+            self.endpoint_reconnects.pop(ep, None)
+            self.endpoint_backoff.pop(ep, None)
+        # loop-only structures (the receive path and the scheduler map are
+        # owned by the event loop this runs on)
+        self._rx_streams.pop(key, None)
+        self._stripe_sched.pop(ep, None)
+        for k in [k for k in self._rx_seq_expect if k[0] == key]:
+            del self._rx_seq_expect[k]
+        for k in [k for k in self._frag_asm if k[0] == key]:
+            # dropped, never pooled: a connection may still be mid-recv
+            # into the assembly (see _frag_get's eviction note)
+            self._frag_asm.pop(k)
 
     # -- pump pool (multi-stream data plane) ------------------------------------
 
@@ -1006,17 +1372,20 @@ class RemoteTransport:
         if act.duplicate:
             await self._send_wire(env, tctx)
 
-    def _stream_for(self, env: Envelope) -> int:
-        """Which stream of the peer endpoint carries this envelope: payload
-        frames stripe across streams 1..N-1 by chunk id (deterministic —
-        a chaos-delayed resend of the same chunk rides the same stream);
-        everything ordering-sensitive stays on stream 0."""
-        if self.streams <= 1:
-            return 0
-        msg = env.msg
-        if type(msg) in _STRIPED_TYPES:
-            return 1 + (msg.chunk_id % (self.streams - 1))
-        return 0
+    def _pick_stream(self, ep: Endpoint, env: Envelope, nbytes: int) -> int:
+        """Which payload stream of ``ep`` carries this frame: by chunk id
+        (deterministic — a chaos-delayed resend of the same chunk rides
+        the same stream), or through the endpoint's congestion-aware
+        :class:`StripeScheduler` when the lever is on — a persistently
+        slow stream then sheds assignment weight instead of gating every
+        round that owns a chunk on it."""
+        n_payload = self.streams - 1
+        if self.congestion and n_payload > 1:
+            sched = self._stripe_sched.get(ep)
+            if sched is None:
+                sched = self._stripe_sched[ep] = StripeScheduler(n_payload)
+            return 1 + sched.pick(nbytes, time.monotonic())
+        return 1 + (env.msg.chunk_id % n_payload)
 
     async def _send_wire(self, env: Envelope, tctx, *, chaos_act=None) -> None:
         if self._stopped:
@@ -1027,9 +1396,8 @@ class RemoteTransport:
             self.dropped += 1
             _DROP_NO_ROUTE.inc()
             return
-        stream = self._stream_for(env)
-        if stream:
-            await self._send_wire_stream(env, tctx, ep, stream, chaos_act)
+        if self.streams > 1 and type(env.msg) in _STRIPED_TYPES:
+            await self._send_wire_payload(env, tctx, ep, chaos_act)
             return
         t0 = time.perf_counter()
         parts = wire.encode_frame_parts(
@@ -1076,26 +1444,102 @@ class RemoteTransport:
         if sender.queued_bytes > self.write_buffer_high_water:
             await self._backpressure_wait(ep, sender, frame, loop)
 
-    async def _send_wire_stream(
-        self, env: Envelope, tctx, ep: Endpoint, stream: int, chaos_act
+    async def _send_wire_payload(
+        self, env: Envelope, tctx, ep: Endpoint, chaos_act
     ) -> None:
-        """Enqueue a payload frame on one of the endpoint's payload streams
-        with its encode DEFERRED to the stream's sender thread: the thread
-        runs encode + checksum + chaos corruption just before the batch
+        """Route a payload frame onto the endpoint's payload streams with
+        its encode DEFERRED to the sender thread(s): the thread runs
+        encode + checksum + chaos corruption just before the batch
         syscall, so peer A's codec work overlaps peer B's handler on the
         loop — and the enqueue here is the loop's ONLY involvement per
         frame (no per-batch executor round-trips). Backpressure is charged
-        NOW — ``wire.payload_frame_nbytes`` is exact without encoding."""
+        NOW — ``wire.payload_frame_nbytes`` is exact without encoding.
+
+        Frames whose encoded body reaches ``intra_chunk_min_bytes`` (and
+        the endpoint has >= 2 payload streams to split across) go through
+        the intra-chunk path instead: sub-frames striped across streams,
+        so a ONE-chunk round no longer serializes onto one socket."""
         mode = wire._wire_mode(self.wire_f16, env.wire)
-        # + 4: the per-stream seq header the sender thread stamps between
-        # the length prefix and the body ([u32 len][u32 seq][body])
         nbytes = wire.payload_frame_nbytes(
             env.dest, env.msg, mode, tctx is not None
-        ) + 4
-        frame = _Frame(None, [env], nbytes, False)
+        )
+        if (
+            self.intra_chunk_min_bytes
+            and self.streams >= 3
+            and nbytes >= self.intra_chunk_min_bytes
+        ):
+            await self._send_wire_striped(env, tctx, ep, chaos_act, mode, nbytes)
+            return
+        stream = self._pick_stream(ep, env, nbytes)
+        # + 4: the per-stream seq header the sender thread stamps between
+        # the length prefix and the body ([u32 len][u32 seq][body])
+        frame = _Frame(None, [env], nbytes + 4, False)
         frame.encode_job = (env, tctx, mode, chaos_act)
         loop = asyncio.get_running_loop()
-        self._loop = loop
+        sender = self._enqueue_stream_frame(ep, stream, frame)
+        if sender.queued_bytes > self.stream_write_buffer_high_water:
+            await self._backpressure_wait(ep, sender, frame, loop)
+
+    async def _send_wire_striped(
+        self, env: Envelope, tctx, ep: Endpoint, chaos_act, mode: str,
+        nbytes: int,
+    ) -> None:
+        """Intra-chunk striping: split ONE payload frame's encoded body
+        into sub-frames across the endpoint's payload streams. The encode
+        stays deferred and runs ONCE (``_SharedEncode`` — whichever sender
+        thread drains a stripe first pays it); each stripe is its own
+        ``[u32 len][u32 seq]`` frame wrapping a continuation header plus a
+        zero-copy slice of the shared body, and the receive side lands
+        every stripe at its offset in one pooled buffer — no join copy,
+        the PR-1 contract end to end."""
+        n_payload = self.streams - 1
+        body_len = nbytes - 4  # the u32 length prefix is per-stripe framing
+        # enough stripes to use the streams, but never stripes so small
+        # the continuation framing outweighs the parallelism (each stripe
+        # carries at least ~half the bar)
+        nstripes = min(
+            n_payload,
+            max(2, body_len // max(1, self.intra_chunk_min_bytes // 2)),
+        )
+        frag_sz = -(-body_len // nstripes)  # ceil
+        frag_id = self._next_frag_id
+        self._next_frag_id = (frag_id + 1) & 0xFFFF_FFFF
+        shared = _SharedEncode(env, tctx, mode, chaos_act)
+        loop = asyncio.get_running_loop()
+        sched = None
+        if self.congestion and n_payload > 1:
+            sched = self._stripe_sched.get(ep)
+            if sched is None:
+                sched = self._stripe_sched[ep] = StripeScheduler(n_payload)
+        now = time.monotonic()
+        pressured: dict[_Sender, _Frame] = {}
+        for i in range(nstripes):
+            offset = i * frag_sz
+            ln = min(frag_sz, body_len - offset)
+            if ln <= 0:
+                break
+            stream = (
+                1 + sched.pick(ln, now)
+                if sched is not None
+                else 1 + ((frag_id + i) % n_payload)
+            )
+            frame = _Frame(
+                None, [env], 4 + 4 + wire.FRAG_HDR_LEN + ln, False
+            )
+            frame.frag = (shared, frag_id, body_len, offset, ln)
+            sender = self._enqueue_stream_frame(ep, stream, frame)
+            _FRAGS_SENT.inc()
+            if sender.queued_bytes > self.stream_write_buffer_high_water:
+                pressured[sender] = frame
+        for sender, frame in pressured.items():
+            await self._backpressure_wait(ep, sender, frame, loop)
+
+    def _enqueue_stream_frame(
+        self, ep: Endpoint, stream: int, frame: _Frame
+    ) -> _Sender:
+        """Land ``frame`` on the (endpoint, stream) sender's queue, waking
+        (or starting) its dedicated thread."""
+        self._loop = asyncio.get_running_loop()
         while True:
             sender = self._senders.get((ep, stream))
             if sender is None or sender.closed:
@@ -1109,7 +1553,7 @@ class RemoteTransport:
                 if sender.closed:
                     continue  # lost the race: rebuild a fresh sender
                 sender.queue.append(frame)
-                sender.queued_bytes += nbytes
+                sender.queued_bytes += frame.nbytes
                 sender.cond.notify()
                 break
         if sender.thread is None:
@@ -1129,8 +1573,7 @@ class RemoteTransport:
                 daemon=True,
             )
             sender.thread.start()
-        if sender.queued_bytes > self.stream_write_buffer_high_water:
-            await self._backpressure_wait(ep, sender, frame, loop)
+        return sender
 
     async def _backpressure_wait(
         self, ep: Endpoint, sender: _Sender, frame: _Frame, loop
@@ -1166,6 +1609,7 @@ class RemoteTransport:
             except ValueError:
                 return  # completed/dropped while we timed out
             sender.queued_bytes -= frame.nbytes
+        self._note_stripe_dropped(ep, sender, frame.nbytes)
         for e in frame.envs:
             self.dropped += 1
             _DROP_BACKPRESSURE.inc()
@@ -1412,12 +1856,29 @@ class RemoteTransport:
             while True:
                 batch: list[_Frame] = []
                 batch_bytes = 0
+                evicted = False
                 with sender.cond:
                     while not sender.queue and not sender.closed:
                         # bounded wait: a lost wakeup degrades to a 1s poll
                         sender.cond.wait(timeout=_SEND_SLICE_S)
                     if sender.closed:
-                        return
+                        # closed from OUTSIDE the thread (endpoint
+                        # eviction) with frames still queued: they get the
+                        # full dead-letter accounting below — a silent
+                        # drop would leave senders (statetransfer's push
+                        # dedup above all) believing the frames arrived.
+                        # Teardown (_stopped) keeps the historical
+                        # silent-drop semantics: callbacks into a stopping
+                        # control plane help nobody.
+                        evicted = bool(sender.queue) and not self._stopped
+                        if not evicted:
+                            return
+                if evicted:
+                    self._dead_letter_stream(
+                        ep, sender, OSError("endpoint evicted")
+                    )
+                    return
+                with sender.cond:
                     for frame in sender.queue:
                         frame.inflight = True
                         batch.append(frame)
@@ -1431,6 +1892,14 @@ class RemoteTransport:
                     time.sleep(backoff)  # outside the stage-timing window
                     backoff = None
                     if sender.closed:
+                        # an evicted endpoint's sender is USUALLY here (its
+                        # sends were failing — that is why it got expelled):
+                        # the queue still gets the dead-letter accounting, a
+                        # silent exit would strand the frames unreported
+                        if sender.queue and not self._stopped:
+                            self._dead_letter_stream(
+                                ep, sender, OSError("endpoint evicted")
+                            )
                         return
                 if sender.sock is None:
                     try:
@@ -1464,6 +1933,15 @@ class RemoteTransport:
                     self.endpoint_tx[key] = (
                         self.endpoint_tx.get(key, 0) + sent
                     )
+                if self.congestion and sender.stream_id >= 1:
+                    # drain feedback for the congestion-aware scheduler: a
+                    # stream that stops moving its assigned bytes sheds
+                    # assignment weight (control/stripes.py)
+                    sched = self._stripe_sched.get(ep)
+                    if sched is not None:
+                        sched.note_sent(
+                            sender.stream_id - 1, sent, time.monotonic()
+                        )
                 sent_envs: list = []
                 with sender.cond:
                     for frame in batch:
@@ -1481,6 +1959,18 @@ class RemoteTransport:
             # control plane's failure accounting.
             self._dead_letter_stream(ep, sender, exc)
         finally:
+            ring, sender.uring = sender.uring, None
+            if ring is not None:  # the ring belongs to this thread
+                try:
+                    ring.close()
+                except Exception:  # pragma: no cover - teardown best effort
+                    pass
+            if sender.closed:
+                # a sender closed from outside (eviction, teardown) hands
+                # the fd close to THIS thread — the only place it is
+                # guaranteed out of any syscall (transport.stop() joins
+                # before its own close_sock pass, which then no-ops)
+                sender.close_sock()
             self._post_to_loop(sender.wake_waiters)
 
     def _stream_batch_sent(self, ep: Endpoint, sender: _Sender, envs: list) -> None:
@@ -1510,8 +2000,26 @@ class RemoteTransport:
         sender.close_sock()
         sender.attempts = 0
         self.endpoint_backoff[ep] = 0.0
+        self._note_stripe_dropped(
+            ep, sender, sum(f.nbytes for f in frames)
+        )
         envs = [env for frame in frames for env in frame.envs]
         self._post_to_loop(self._stream_dead_letter_cb, ep, sender, envs)
+
+    def _note_stripe_dropped(
+        self, ep: Endpoint, sender: _Sender, nbytes: int
+    ) -> None:
+        """Reconcile the congestion scheduler's backlog for frames dropped
+        UNSENT (dead-letter, backpressure withdrawal): phantom outstanding
+        bytes never produce a ``note_sent`` and would otherwise read as
+        permanent congestion, pinning the stream at the weight floor."""
+        if not nbytes or not self.congestion or sender.stream_id < 1:
+            return
+        sched = self._stripe_sched.get(ep)
+        if sched is not None:
+            sched.note_dropped(
+                sender.stream_id - 1, nbytes, time.monotonic()
+            )
 
     def _stream_dead_letter_cb(
         self, ep: Endpoint, sender: _Sender, envs: list
@@ -1587,6 +2095,27 @@ class RemoteTransport:
                 ]
             )
         for frame in batch:
+            seq_hdr = _U32.pack(sender.seq)
+            sender.seq = (sender.seq + 1) & 0xFFFF_FFFF
+            if frame.frag is not None:
+                # one stripe of a split frame: the shared encode runs once
+                # (whichever stripe's thread gets here first pays it), and
+                # this frame's views are a continuation header plus a
+                # zero-copy slice of the shared body
+                shared, frag_id, total, offset, ln = frame.frag
+                parts, enc_dt = shared.ensure(self)
+                enc += enc_dt
+                frames_views.append(
+                    [
+                        memoryview(_U32.pack(wire.FRAG_HDR_LEN + ln)),
+                        memoryview(seq_hdr),
+                        memoryview(
+                            wire.encode_frag_header(frag_id, total, offset)
+                        ),
+                        *wire.slice_parts(parts[1:], offset, offset + ln),
+                    ]
+                )
+                continue
             if frame.parts is None:
                 env, tctx, mode, act = frame.encode_job
                 t0 = time.perf_counter()
@@ -1601,8 +2130,6 @@ class RemoteTransport:
             # is parts[0]; the sequence is FRAMING, assigned per attempt
             # (a reconnect resets the receiver's expectation with the
             # connection, so retried frames re-number cleanly)
-            seq_hdr = _U32.pack(sender.seq)
-            sender.seq = (sender.seq + 1) & 0xFFFF_FFFF
             frames_views.append(
                 [
                     memoryview(frame.parts[0]),
@@ -1621,23 +2148,96 @@ class RemoteTransport:
         sender.need_preamble = False
         return sent
 
+    # kernel answers that latch io_uring OFF for the whole transport (a
+    # kernel that probed fine may still refuse the op — 5.1/5.2 without
+    # SENDMSG answer EINVAL, a policy change answers EPERM); everything
+    # else is an ordinary socket error for the retry path
+    _URING_DISABLE_ERRNOS = frozenset(
+        {_errno.ENOSYS, _errno.EINVAL, _errno.EOPNOTSUPP, _errno.EPERM}
+    )
+
+    def _uring_ring(self, sender: _Sender):
+        """THREAD: the sender's submission ring, created on first use —
+        None when the lever is off, the probe failed, or a prior submit
+        latched the transport back to the batch syscalls."""
+        if not self.uring or self._uring_off:
+            return None
+        if sender.uring is None:
+            try:
+                sender.uring = native.UringRing()
+            except RuntimeError as exc:
+                # check-and-set under the lock: N sender threads race to
+                # their first batch before any latch lands, and the
+                # fallback must count (and log) once per transport, not
+                # once per thread
+                with self._stats_lock:
+                    first = not self._uring_off
+                    self._uring_off = True
+                if first:
+                    _URING_FALLBACKS.inc()
+                    log.info(
+                        "io_uring unavailable (%s); staying on batch "
+                        "syscalls",
+                        exc,
+                    )
+                return None
+        return sender.uring
+
+    def _drop_uring(self, sender: _Sender) -> None:
+        """THREAD: the kernel refused a submit the probe promised — latch
+        the whole transport off io_uring (once) and fall back."""
+        with self._stats_lock:
+            first = not self._uring_off
+            self._uring_off = True
+        if first:
+            _URING_FALLBACKS.inc()
+            log.warning(
+                "io_uring submit refused; falling back to batch syscalls"
+            )
+        ring, sender.uring = sender.uring, None
+        if ring is not None:
+            ring.close()
+
     def _send_views_blocking(
         self, sender: _Sender, frames: list[list[memoryview]]
     ) -> int:
         """THREAD: push every byte of ``frames`` out, advancing across
         short writes; stalls are bounded like the event-loop writers — any
         progress resets a ``connect_timeout_s`` deadline, no progress past
-        it raises ``asyncio.TimeoutError`` for the writer's retry path."""
+        it raises ``asyncio.TimeoutError`` for the writer's retry path.
+
+        With the io_uring lever on, the whole burst goes through ONE ring
+        submission (a single SENDMSG op gathering every segment). The op
+        is submitted non-blocking — a stalled peer surfaces as EAGAIN and
+        parks in the bounded select below, never inside an uninterruptible
+        ring enter — so the teardown/deadline discipline is identical to
+        the batch-syscall path."""
         sock = sender.sock
         assert sock is not None
         use_native = native.batch_send_available()
+        ring = self._uring_ring(sender)
         deadline = time.monotonic() + self.connect_timeout_s
         total = 0
         while frames:
             if sender.closed:
                 raise OSError("sender closed during send")
             try:
-                if use_native:
+                if ring is not None:
+                    try:
+                        n = ring.send(
+                            sock.fileno(),
+                            [v for frame in frames for v in frame],
+                        )
+                        _URING_SUBMITS.inc()
+                    except BlockingIOError:
+                        raise
+                    except OSError as exc:
+                        if exc.errno in self._URING_DISABLE_ERRNOS:
+                            self._drop_uring(sender)
+                            ring = None
+                            continue
+                        raise
+                elif use_native:
                     n = native.batch_send(sock.fileno(), frames)
                 else:
                     n = sock.sendmsg(
@@ -1662,6 +2262,12 @@ class RemoteTransport:
                         frames.pop(0)
             elif time.monotonic() > deadline:
                 raise asyncio.TimeoutError("socket write stalled")
+            else:
+                # bounded wait for socket room: the blocking-socket paths
+                # already waited an SO_SNDTIMEO slice inside the syscall;
+                # the non-blocking uring submit parks here instead (same
+                # slice, same teardown re-check cadence)
+                select.select([], [sock], [], _SEND_SLICE_S)
         return total
 
     # -- receiving ----------------------------------------------------------------

@@ -858,6 +858,79 @@ def payload_frame_nbytes(
     )
 
 
+# -- sub-chunk continuation frames (intra-chunk striping) ----------------------
+#
+# With DataPlaneConfig.intra_chunk_min_bytes set, a payload frame whose
+# encoded body reaches the bar is SPLIT across the endpoint's payload
+# streams: each stripe carries ``[u32 len][u32 seq]`` framing (the ordinary
+# payload-stream framing) around a CONTINUATION body
+#   [u16 0xFFFF][u32 frag_id][u32 total_len][u32 offset][fragment bytes]
+# where 0xFFFF occupies the position of a normal body's dest-length prefix —
+# no real destination string is 65535 bytes (max_frame_bytes caps frames far
+# below the implied size), so one 2-byte peek disambiguates continuation
+# frames from whole-frame bodies on a payload stream. The receive side
+# lands every fragment DIRECTLY at its offset in one pooled frame-sized
+# buffer (no join copy — the PR-1 zero-copy contract holds: decode hands
+# out views into that buffer) and delivers the reassembled body when
+# ``total_len`` bytes have arrived, whatever order the stripes landed in.
+#
+# Version skew: continuation frames exist only on payload streams, whose
+# existence (and this lever's bar) a cluster negotiates via Welcome — a
+# legacy peer never opens a payload stream, so it can never meet one.
+
+FRAG_MARKER = 0xFFFF
+_FRAG_HDR = struct.Struct("<HIII")
+FRAG_HDR_LEN = _FRAG_HDR.size
+
+
+def encode_frag_header(frag_id: int, total_len: int, offset: int) -> bytes:
+    """Continuation header for one stripe of a split payload frame."""
+    return _FRAG_HDR.pack(
+        FRAG_MARKER, frag_id & 0xFFFF_FFFF, total_len, offset
+    )
+
+
+def parse_frag_header(
+    buf: bytes | memoryview,
+) -> tuple[int, int, int] | None:
+    """``(frag_id, total_len, offset)`` for a continuation body, or None
+    when ``buf`` holds fewer than :data:`FRAG_HDR_LEN` bytes (wait for
+    more). Raises ``ValueError`` when the marker does not match (the
+    caller peeked wrong) or the offset lies outside the total — a
+    malformed header must never become an out-of-bounds buffer write."""
+    if len(buf) < FRAG_HDR_LEN:
+        return None
+    marker, frag_id, total_len, offset = _FRAG_HDR.unpack_from(buf, 0)
+    if marker != FRAG_MARKER:
+        raise ValueError("not a continuation frame")
+    if offset >= total_len:
+        raise ValueError(
+            f"fragment offset {offset} outside body of {total_len} bytes"
+        )
+    return frag_id, total_len, offset
+
+
+def slice_parts(parts: list, start: int, end: int) -> list[memoryview]:
+    """Byte range ``[start, end)`` of a scatter-gather segment list as
+    views — no copy, so a stripe of a deferred-encoded frame reuses the
+    one shared encode's payload memory. ``parts`` are the BODY segments
+    (``encode_frame_parts(...)[1:]`` — the u32 length prefix is per-stripe
+    framing, not body bytes)."""
+    out: list[memoryview] = []
+    pos = 0
+    for p in parts:
+        mv = p if isinstance(p, memoryview) else memoryview(p)
+        n = len(mv)
+        if pos + n <= start or pos >= end:
+            pos += n
+            continue
+        lo = max(0, start - pos)
+        hi = min(n, end - pos)
+        out.append(mv[lo:hi])
+        pos += n
+    return out
+
+
 def decode_frame_body(body: bytes | memoryview) -> tuple[str, Any]:
     """Inverse of ``encode_frame`` minus the length prefix."""
     dest, msg, _ = decode_frame_body_ex(body)

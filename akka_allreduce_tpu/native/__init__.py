@@ -32,7 +32,7 @@ _SRC_PATHS = [
 ]
 _SRC_PATH = _SRC_PATHS[0]  # sentinel the build/test machinery stats
 
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 _lib = None
 _lock = threading.Lock()
@@ -130,6 +130,18 @@ def _bind(lib) -> None:
         ctypes.c_int, _u64p, _i64p, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.aw_recvmmsg.restype = ctypes.c_int64
+    lib.aw_have_uring.argtypes = []
+    lib.aw_have_uring.restype = ctypes.c_int
+    lib.aw_uring_probe_errno.argtypes = []
+    lib.aw_uring_probe_errno.restype = ctypes.c_int
+    lib.aw_uring_create.argtypes = [ctypes.c_int]
+    lib.aw_uring_create.restype = ctypes.c_void_p
+    lib.aw_uring_close.argtypes = [ctypes.c_void_p]
+    lib.aw_uring_close.restype = None
+    lib.aw_uring_sendmsg.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, _u64p, _i64p, ctypes.c_int32,
+    ]
+    lib.aw_uring_sendmsg.restype = ctypes.c_int64
 
 
 def _load(*, build_wait: bool = False, _retried: bool = False):
@@ -530,6 +542,103 @@ def batch_send(fd: int, frames: list[list], *, force_fallback: bool = False) -> 
             raise BlockingIOError(-n, os.strerror(-n))
         raise OSError(-n, os.strerror(-n))
     return n
+
+
+# -- io_uring submission (wire.cpp aw_uring_*) ---------------------------------
+#
+# The next syscall step past sendmmsg: one ring submission drains a whole
+# burst (a single SENDMSG op gathering every frame segment — the byte stream
+# cannot interleave). Runtime-probed like the batch syscalls; the probe's
+# REASON is exported so bench-wire records why a box fell back instead of
+# silently benching the wrong lever.
+
+
+def uring_available() -> bool:
+    """True iff the native library is loaded AND the running kernel's
+    io_uring probe passed (setup accepted + SENDMSG supported)."""
+    lib = _load()
+    return bool(lib is not None and lib.aw_have_uring())
+
+
+def uring_probe_reason() -> str:
+    """Why io_uring is (un)usable here: ``"ok"`` when the probe passed,
+    else a stable reason code — ``"no-native"`` (library not built),
+    ``"enosys"`` (pre-5.1 kernel), ``"eperm"`` (seccomp/gVisor policy),
+    ``"op-unsupported"`` (ring exists, SENDMSG does not), or
+    ``"errno:<n>"`` for anything else the setup syscall answered."""
+    import errno as _errno
+
+    lib = _load()
+    if lib is None:
+        return "no-native"
+    code = int(lib.aw_uring_probe_errno())
+    if code == 0:
+        return "ok"
+    if code == _errno.ENOSYS:
+        return "enosys"
+    if code == _errno.EPERM:
+        return "eperm"
+    if code == _errno.EOPNOTSUPP:
+        return "op-unsupported"
+    return f"errno:{code}"
+
+
+class UringRing:
+    """One sender thread's submission ring (never shared across threads).
+
+    ``send`` takes a FLAT list of buffer segments and moves them through
+    one ring submission; short counts are normal (the caller advances its
+    views and re-enters, exactly the ``batch_send`` contract). Raises
+    ``RuntimeError`` at construction when io_uring is unusable here —
+    callers probe :func:`uring_available` first and keep the
+    sendmmsg/sendmsg path as the fallback."""
+
+    __slots__ = ("_handle", "_lib")
+
+    def __init__(self, entries: int = 8) -> None:
+        lib = _load()
+        if lib is None or not lib.aw_have_uring():
+            raise RuntimeError(
+                f"io_uring unavailable ({uring_probe_reason()})"
+            )
+        handle = lib.aw_uring_create(entries)
+        if not handle:
+            raise RuntimeError("io_uring ring creation failed")
+        self._lib = lib
+        self._handle = handle
+
+    def send(self, fd: int, views: list) -> int:
+        """Send ``views`` (flat buffer segments) on connected stream
+        socket ``fd``; returns bytes moved, raises ``BlockingIOError`` /
+        ``OSError`` like :func:`batch_send`."""
+        import errno as _errno
+
+        bases, lens, _keep = _iovec_arrays(views)
+        n = int(
+            self._lib.aw_uring_sendmsg(
+                self._handle,
+                fd,
+                bases.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                lens.ctypes.data_as(_i64p),
+                len(views),
+            )
+        )
+        if n < 0:
+            if -n in (_errno.EAGAIN, _errno.EWOULDBLOCK):
+                raise BlockingIOError(-n, os.strerror(-n))
+            raise OSError(-n, os.strerror(-n))
+        return n
+
+    def close(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.aw_uring_close(handle)
+
+    def __del__(self) -> None:  # best-effort: rings also close with the fd
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
 
 
 def batch_recv(fd: int, bufs: list, *, force_fallback: bool = False) -> int:

@@ -64,6 +64,6 @@ void ar_expand_counts(const int32_t* chunk_counts, const int64_t* lengths,
 }
 
 // v3: wire.cpp (payload-frame pack/unpack + checksum) joined the library.
-int ar_abi_version() { return 4; }
+int ar_abi_version() { return 5; }
 
 }  // extern "C"

@@ -666,7 +666,16 @@ def test_chaos_gossip_drill_subprocess(tmp_path):
     assert summary["false_expulsions"] == 0
     assert summary["kill_detected"] is True
     assert summary["gossip"]["gossip.expulsions"] == 1
-    assert summary["gossip"]["gossip.acks_relayed"] >= 1
+    # the indirect path demonstrably ENGAGED at the master: every direct
+    # probe of the bad-link node during the window lost its ack (the cut
+    # is exactly victim->master), so the master must have escalated to
+    # ping-reqs. NB ``acks_relayed`` counts at the RELAY process, and the
+    # summary reads the MASTER's snapshot — the master's own relays for
+    # the victim can never complete (their return leg is the cut link),
+    # so that counter at the master is structurally load-dependent and
+    # was a flaky pin (0 on a quiet box, >=1 only when load-induced
+    # spurious ping-reqs happened to route an unrelated relay through it)
+    assert summary["gossip"]["gossip.indirect_probes"] >= 1
     assert summary["master_done"] is True
 
 

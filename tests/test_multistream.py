@@ -42,6 +42,29 @@ async def wait_until(pred, timeout: float = 20.0) -> None:
         await asyncio.sleep(0.005)
 
 
+async def wait_progress(
+    value, target: int, step_timeout: float = 120.0, cap: float = 360.0
+) -> None:
+    """Progress-gated wait (the chaos-recover deflake pattern): the
+    deadline refreshes whenever ``value()`` advances, so a run that is
+    merely SLOW under full-suite load on a saturated box keeps its budget,
+    while a genuine stall still fails within ``step_timeout``. ``cap``
+    bounds the whole wait regardless of progress."""
+    loop = asyncio.get_running_loop()
+    start = loop.time()
+    best = value()
+    deadline = start + step_timeout
+    while best < target:
+        now = loop.time()
+        if now > deadline or now - start > cap:
+            raise TimeoutError(f"progress stalled at {best}/{target}")
+        await asyncio.sleep(0.05)
+        cur = value()
+        if cur > best:
+            best = cur
+            deadline = loop.time() + step_timeout
+
+
 # --- preamble + config plumbing ----------------------------------------------
 
 
@@ -244,6 +267,286 @@ def test_stream_seq_gap_is_counted_not_fatal():
             reader2.close()
         finally:
             await rx.stop()
+
+    asyncio.run(run())
+
+
+# --- intra-chunk striping (data plane v3) -------------------------------------
+
+
+def _v3_transports(streams: int, bar: int = 65536, congestion: bool = False):
+    rx, tx = RemoteTransport(), RemoteTransport()
+    for t in (rx, tx):
+        t.streams = streams
+        t.intra_chunk_min_bytes = bar
+        t.congestion = congestion
+    return rx, tx
+
+
+def test_intra_chunk_split_and_reassembly():
+    """A one-chunk round's giant frame splits across every payload stream
+    and reassembles byte-identically — the state-transfer / single-tensor
+    case that used to serialize onto one socket."""
+
+    async def run():
+        from akka_allreduce_tpu.obs import metrics as obs_metrics
+
+        rx, tx = _v3_transports(4)
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        snap0 = obs_metrics.REGISTRY.snapshot()
+        try:
+            big = np.arange(1_000_000, dtype=np.float32)  # 4MB body
+            await tx.send(Envelope("sink", ScatterBlock(big, 0, 1, 0, 7)))
+            await wait_until(lambda: len(got) == 1)
+            np.testing.assert_array_equal(got[0].value, big)
+            # all three payload streams carried stripes
+            assert sorted(s for (_ep, s) in tx._senders) == [1, 2, 3]
+            snap = obs_metrics.REGISTRY.snapshot()
+            assert (
+                snap["transport.frags_sent"]
+                - snap0.get("transport.frags_sent", 0)
+                == 3
+            )
+            assert (
+                snap["transport.frags_reassembled"]
+                - snap0.get("transport.frags_reassembled", 0)
+                == 1
+            )
+            # seq continuity: each stream numbered its frames contiguously
+            # (one stripe each here), so the gap counter never moved
+            assert snap.get("transport.stream_seq_gaps", 0) == snap0.get(
+                "transport.stream_seq_gaps", 0
+            )
+            # no half-built assembly left behind
+            assert not rx._frag_asm
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_intra_chunk_reorder_across_streams_matches_streams1():
+    """Cross-stream reorder pin (ISSUE 13): stripes of MANY split frames
+    arriving out of order across streams — chaos reorder+delay above the
+    splitter — decode to the same payload bytes as the streams=1 leg."""
+    from akka_allreduce_tpu.control.chaos import ChaosInjector
+
+    def run_leg(streams: int) -> dict[int, bytes]:
+        async def run():
+            rx, tx = _v3_transports(streams)
+            tx.chaos = ChaosInjector(99, "reorder:p=0.5;delay:ms=5", role=0)
+            got: list = []
+            rx.register("sink", lambda m: got.append(m) or [])
+            ep = await rx.start()
+            await tx.start()
+            tx.set_route("sink", ep)
+            try:
+                rng = np.random.default_rng(5)
+                vals = [
+                    rng.standard_normal(40_000).astype(np.float32)
+                    for _ in range(8)
+                ]
+                for i, v in enumerate(vals):
+                    await tx.send(
+                        Envelope("sink", ScatterBlock(v, 0, 1, i, 1))
+                    )
+                await wait_until(lambda: len(got) == 8)
+                assert tx.chaos.counts().get("reorder", 0) > 0
+                return {
+                    m.chunk_id: np.asarray(m.value).tobytes() for m in got
+                }
+            finally:
+                await tx.stop()
+                await rx.stop()
+
+        return asyncio.run(run())
+
+    multi = run_leg(4)  # every 160KB frame splits into >= 2 stripes
+    single = run_leg(1)
+    assert multi == single
+
+
+def test_intra_chunk_inert_below_bar_and_with_one_payload_stream():
+    """Gating: frames under the bar never split, and streams=2 (one
+    payload stream — nothing to split across) never splits regardless."""
+
+    async def run():
+        from akka_allreduce_tpu.obs import metrics as obs_metrics
+
+        for streams, size in ((4, 2_000), (2, 1_000_000)):
+            rx, tx = _v3_transports(streams)
+            got: list = []
+            rx.register("sink", lambda m: got.append(m) or [])
+            ep = await rx.start()
+            await tx.start()
+            tx.set_route("sink", ep)
+            snap0 = obs_metrics.REGISTRY.snapshot()
+            try:
+                v = np.arange(size, dtype=np.float32)
+                await tx.send(Envelope("sink", ScatterBlock(v, 0, 1, 0, 1)))
+                await wait_until(lambda: len(got) == 1)
+                np.testing.assert_array_equal(got[0].value, v)
+                snap = obs_metrics.REGISTRY.snapshot()
+                assert snap.get("transport.frags_sent", 0) == snap0.get(
+                    "transport.frags_sent", 0
+                )
+            finally:
+                await tx.stop()
+                await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_congestion_scheduler_spreads_one_chunk_id():
+    """With the congestion lever on, repeated frames of ONE chunk id no
+    longer pin to one stream — the deficit scheduler spreads them (the
+    static chunk-id mapping would put every frame on the same socket)."""
+
+    async def run():
+        rx, tx = _v3_transports(4, bar=0, congestion=True)
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            v = np.arange(30_000, dtype=np.float32)
+            for r in range(9):
+                await tx.send(Envelope("sink", ScatterBlock(v, 0, 1, 0, r)))
+            await wait_until(lambda: len(got) == 9)
+            opened = sorted(s for (_ep, s) in tx._senders)
+            assert opened == [1, 2, 3]  # chunk-id mapping would open just [1]
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_uring_lever_falls_back_cleanly():
+    """The io_uring lever on a kernel without it (this container) latches
+    off after the probe and the plane keeps moving bytes — the runtime-
+    fallback contract; on a kernel WITH io_uring the same test exercises
+    the ring path."""
+
+    async def run():
+        from akka_allreduce_tpu.obs import metrics as obs_metrics
+
+        rx, tx = _v3_transports(2, bar=0)
+        tx.uring = True
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            v = np.arange(50_000, dtype=np.float32)
+            await tx.send(Envelope("sink", ScatterBlock(v, 0, 1, 0, 1)))
+            await wait_until(lambda: len(got) == 1)
+            np.testing.assert_array_equal(got[0].value, v)
+            snap = obs_metrics.REGISTRY.snapshot()
+            if native.uring_available():
+                assert snap.get("uring.submits", 0) > 0
+                assert not tx._uring_off
+            else:
+                assert tx._uring_off  # latched once, then batch syscalls
+                assert native.uring_probe_reason() != "ok"
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_forget_endpoint_evicts_telemetry_rows():
+    """Membership eviction satellite: forget_endpoint removes every
+    per-endpoint row (tx/rx/streams/seq expectations/scheduler), so an
+    expelled peer stops haunting registry snapshots."""
+
+    async def run():
+        from akka_allreduce_tpu.control.cluster import Endpoint
+        from akka_allreduce_tpu.obs import metrics as obs_metrics
+
+        rx, tx = _v3_transports(2, bar=0, congestion=True)
+        got: list = []
+        rx.register("sink", lambda m: got.append(m) or [])
+        ep = await rx.start()
+        await tx.start()
+        tx.set_route("sink", ep)
+        try:
+            v = np.arange(30_000, dtype=np.float32)
+            await tx.send(Envelope("sink", ScatterBlock(v, 0, 1, 0, 1)))
+            await wait_until(lambda: len(got) == 1)
+            txkey = f"{ep.host}:{ep.port}"
+            rxkey = f"{tx.endpoint.host}:{tx.endpoint.port}"
+            assert txkey in tx.endpoint_tx
+            assert rxkey in rx.endpoint_rx and rx._rx_streams
+            snap = obs_metrics.REGISTRY.snapshot()
+            assert f"transport.endpoint.{txkey}.tx_bytes" in snap
+            tx.forget_endpoint(Endpoint(ep.host, ep.port))
+            rx.forget_endpoint(Endpoint(tx.endpoint.host, tx.endpoint.port))
+            assert txkey not in tx.endpoint_tx
+            assert rxkey not in rx.endpoint_rx
+            assert not rx._rx_streams and not rx._rx_seq_expect
+            assert not tx._stripe_sched
+            snap = obs_metrics.REGISTRY.snapshot()
+            assert f"transport.endpoint.{txkey}.tx_bytes" not in snap
+        finally:
+            await tx.stop()
+            await rx.stop()
+
+    asyncio.run(run())
+
+
+def test_master_expulsion_evicts_endpoint_rows():
+    """The master's expulsion path calls the eviction hook: a phi-expelled
+    node's endpoint rows leave the transport."""
+
+    async def run():
+        cfg = AllreduceConfig(
+            metadata=MetaDataConfig(data_size=10_000, max_chunk_size=5_000),
+            line_master=LineMasterConfig(max_rounds=-1),
+            master=MasterConfig(
+                node_num=1,
+                heartbeat_interval_s=0.1,
+                heartbeat_timeout_s=1.0,
+            ),
+        )
+        master = MasterProcess(cfg, "127.0.0.1", 0)
+        ep = await master.start()
+        outs: list = []
+        node = NodeProcess(
+            ep,
+            lambda req: AllReduceInput(
+                np.ones(10_000, dtype=np.float32)
+            ),
+            outs.append,
+            "127.0.0.1",
+            0,
+        )
+        await node.start()
+        try:
+            nid = await node.wait_welcomed()
+            await wait_until(lambda: nid in master.book)
+            node_ep = master.book[nid]
+            key = f"{node_ep.host}:{node_ep.port}"
+            await wait_until(
+                lambda: key in master.transport.endpoint_tx
+            )
+            # stop the node abruptly (no LeaveCluster): phi expels it
+            await node.stop()
+            await wait_until(
+                lambda: nid in master.unreachable, timeout=30.0
+            )
+            assert key not in master.transport.endpoint_tx
+            assert key not in master.transport.endpoint_rx
+        finally:
+            await master.stop()
 
     asyncio.run(run())
 
@@ -489,9 +792,21 @@ def test_cluster_under_chaos_with_streams2():
             await node.start()
         try:
             await master.run_until_done()
-            # generous: chaos delay/drop under a saturated shared box can
-            # stretch rounds well past the quiet-box ~1s this takes
-            await wait_until(lambda: len(outs[0]) >= 5, timeout=180.0)
+            # progress-gated (the chaos-recover deflake pattern): under
+            # full-suite load on the 2-core box rounds still COMPLETE,
+            # just slowly — only an actual stall should fail, so the
+            # deadline refreshes per delivered output instead of racing
+            # one fixed budget against the box's load average. The bar is
+            # the budget reaching SOME worker's sink for every round, not
+            # both: chaos plus a load-stalled heartbeat can transiently
+            # phi-expel a node, and the master then legitimately completes
+            # a wedged round DEGRADED — without the expelled worker's
+            # flush (the PR-5 member_unreachable path), so demanding five
+            # outputs from BOTH nodes waits forever on correct behavior
+            await wait_progress(
+                lambda: max(len(outs[0]), len(outs[1])), 5
+            )
+            assert min(len(outs[0]), len(outs[1])) >= 3
             # chaos hit traffic on this plane (injector sits above striping)
             assert any(
                 n.transport.chaos is not None and n.transport.chaos.events

@@ -1,0 +1,159 @@
+"""Congestion-aware stripe scheduler (control/stripes.py, ISSUE 13).
+
+Pure state-machine tests under an explicit fake clock — the scheduler owns
+no clock (every entry point takes ``now``), so the same call sequence
+replays the same weights byte for byte, the determinism contract the
+bench-wire ``--congestion`` record also pins end to end.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from akka_allreduce_tpu.control.stripes import StripeScheduler
+
+MB = 1 << 20
+
+
+def _drive_window(sched: StripeScheduler, now: float, rates: list[float],
+                  backlog: list[int], frames: int = 12) -> float:
+    """One window: assign ``frames`` 1MB frames, drain each stream at its
+    ``rates`` fraction of (backlog + assignment), advance the clock."""
+    for _ in range(frames):
+        idx = sched.pick(MB, now)
+        backlog[idx] += MB
+    for i, rate in enumerate(rates):
+        cap = int((backlog[i]) * rate)
+        sent = min(backlog[i], cap)
+        backlog[i] -= sent
+        sched.note_sent(i, sent, now)
+    return now + sched.window_s
+
+
+def test_healthy_streams_split_evenly_and_keep_weight():
+    sched = StripeScheduler(3)
+    counts = [0, 0, 0]
+    for _ in range(30):
+        counts[sched.pick(MB, 0.0)] += 1
+    assert counts == [10, 10, 10]  # stride scheduling at equal weights
+    backlog = [10 * MB] * 3  # the warm-up picks above are still queued
+    now = 0.0
+    for _ in range(10):
+        now = _drive_window(sched, now, [1.0, 1.0, 1.0], backlog)
+    assert sched.weights == [1.0, 1.0, 1.0]
+    assert sched.sheds == 0 and sched.restores == 0
+
+
+def test_degraded_stream_sheds_half_its_share_within_bounded_windows():
+    """The acceptance bar: a persistently slow stream loses >= half its
+    assignment share within a bounded number of windows."""
+    sched = StripeScheduler(3)
+    fair = 1.0 / 3.0
+    backlog = [0, 0, 0]
+    now = 0.0
+    hit = None
+    for w in range(12):
+        now = _drive_window(sched, now, [1.0, 1.0, 0.15], backlog)
+        if hit is None and sched.share(2) <= fair / 2.0:
+            hit = w + 1
+    assert hit is not None and hit <= 8, hit
+    # the floor keeps evidence flowing: the shed stream still gets picks
+    assert sched.weights[2] >= StripeScheduler.MIN_WEIGHT
+    assert sched.weights[:2] == [1.0, 1.0]
+
+
+def test_single_slow_window_does_not_shed():
+    """Hysteresis: one bad window is noise, not congestion."""
+    sched = StripeScheduler(2)
+    backlog = [0, 0]
+    now = _drive_window(sched, 0.0, [1.0, 0.1], backlog)
+    now = _drive_window(sched, now, [1.0, 1.0], backlog)
+    now = _drive_window(sched, now, [1.0, 1.0], backlog)
+    assert sched.sheds == 0 and sched.weights == [1.0, 1.0]
+
+
+def test_heal_restores_weight_with_its_own_hysteresis():
+    sched = StripeScheduler(3)
+    backlog = [0, 0, 0]
+    now = 0.0
+    for _ in range(8):
+        now = _drive_window(sched, now, [1.0, 1.0, 0.15], backlog)
+    assert sched.weights[2] < 1.0 and sched.sheds > 0
+    for _ in range(12):
+        now = _drive_window(sched, now, [1.0, 1.0, 1.0], backlog)
+    assert sched.weights[2] == 1.0
+    assert sched.restores >= 1
+    assert backlog[2] == 0  # the healed stream drained its backlog
+
+
+def test_thin_evidence_is_inert():
+    """Idle (or near-idle) streams are never judged: windows below
+    MIN_EVIDENCE_BYTES advance nothing."""
+    sched = StripeScheduler(2)
+    now = 0.0
+    for w in range(6):
+        sched.pick(1024, now)  # tiny frames, far under the evidence bar
+        sched.note_sent(0, 0, now)
+        sched.note_sent(1, 0, now)
+        now += sched.window_s
+    assert sched.sheds == 0 and sched.weights == [1.0, 1.0]
+
+
+def test_same_sequence_same_weights():
+    """Determinism: the identical call sequence replays identical weights
+    and trajectories (no wall clock, no RNG anywhere inside)."""
+
+    def run() -> list[tuple]:
+        sched = StripeScheduler(3)
+        backlog = [0, 0, 0]
+        now = 0.0
+        trail = []
+        for w in range(20):
+            rates = [1.0, 1.0, 0.15 if w < 10 else 1.0]
+            now = _drive_window(sched, now, rates, backlog)
+            trail.append(tuple(sched.weights))
+        return trail
+
+    assert run() == run()
+
+
+def test_weighted_picks_follow_weights():
+    """After a shed, assignment follows the new weights: the slow stream
+    receives roughly its weight share of bytes, not a fair third."""
+    sched = StripeScheduler(2)
+    backlog = [0, 0]
+    now = 0.0
+    for _ in range(6):
+        now = _drive_window(sched, now, [1.0, 0.1], backlog)
+    assert sched.weights[1] < 1.0
+    counts = [0, 0]
+    for _ in range(100):
+        counts[sched.pick(MB, now)] += 1
+    expected = 100 * sched.weights[1] / sum(sched.weights)
+    assert counts[1] == pytest.approx(expected, abs=2)
+
+
+def test_rejects_zero_streams():
+    with pytest.raises(ValueError):
+        StripeScheduler(0)
+
+
+def test_dropped_bytes_do_not_pin_a_stream_slow():
+    """Reconciliation: frames dropped UNSENT (dead-letter, backpressure
+    withdrawal) leave the backlog via note_dropped — without it, one
+    dropped burst would read as permanent congestion and the stream could
+    never restore its weight."""
+    sched = StripeScheduler(2)
+    now = 0.0
+    # a burst assigned to stream 1 is dead-lettered wholesale
+    dropped = 0
+    for _ in range(8):
+        idx = sched.pick(MB, now)
+        if idx == 1:
+            dropped += MB
+    sched.note_dropped(1, dropped, now)
+    backlog = [0, 0]
+    for _ in range(8):  # healthy windows after the incident
+        now = _drive_window(sched, now, [1.0, 1.0], backlog)
+    assert sched.weights == [1.0, 1.0]
+    assert sched.sheds == 0

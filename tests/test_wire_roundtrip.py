@@ -451,3 +451,92 @@ def test_gossip_empty_digest_roundtrips(msg_type):
     _assert_equal(bare, back)
     assert back.digest == ()
     assert len(wire.encode(bare)) < 48
+
+
+# --- sub-chunk continuation frames (intra-chunk striping, ISSUE 13) -----------
+
+
+def _split_ranges(total: int, nstripes: int) -> list[tuple[int, int]]:
+    frag = -(-total // nstripes)
+    return [
+        (i * frag, min(frag, total - i * frag))
+        for i in range(nstripes)
+        if min(frag, total - i * frag) > 0
+    ]
+
+
+def test_frag_header_roundtrip_and_rejection():
+    hdr = wire.encode_frag_header(0xDEADBEEF, 1_000_000, 250_000)
+    assert len(hdr) == wire.FRAG_HDR_LEN
+    assert wire.parse_frag_header(hdr) == (0xDEADBEEF, 1_000_000, 250_000)
+    # truncation: fewer than FRAG_HDR_LEN bytes asks for more, never lies
+    for cut in range(wire.FRAG_HDR_LEN):
+        assert wire.parse_frag_header(hdr[:cut]) is None
+    # a non-marker prefix is the caller peeking wrong
+    with pytest.raises(ValueError):
+        wire.parse_frag_header(b"\x00\x00" + hdr[2:])
+    # an offset at/past the total could become an out-of-bounds write
+    with pytest.raises(ValueError):
+        wire.parse_frag_header(wire.encode_frag_header(1, 100, 100))
+    with pytest.raises(ValueError):
+        wire.parse_frag_header(wire.encode_frag_header(1, 100, 300))
+
+
+def test_slice_parts_covers_body_exactly():
+    """Slicing a scatter-gather segment list by byte ranges loses and
+    duplicates nothing, across segment boundaries and odd split points."""
+    value = np.arange(5_000, dtype=np.float32)
+    parts = wire.encode_frame_parts("worker:3", ScatterBlock(value, 1, 2, 3, 4))
+    body = b"".join(bytes(p) for p in parts[1:])  # parts[0] = length prefix
+    for nstripes in (1, 2, 3, 7):
+        rebuilt = bytearray(len(body))
+        for off, ln in _split_ranges(len(body), nstripes):
+            views = wire.slice_parts(parts[1:], off, off + ln)
+            assert sum(len(v) for v in views) == ln
+            rebuilt[off : off + ln] = b"".join(bytes(v) for v in views)
+        assert bytes(rebuilt) == body
+
+
+@pytest.mark.parametrize("trace", [None, TraceContext(11, 22, True)],
+                         ids=["plain", "traced"])
+def test_split_reassemble_byte_identity(trace):
+    """The whole intra-chunk contract at the wire level: a frame's body
+    split at the transport's offsets, reassembled at each fragment's
+    offset (out of order), decodes to the original message — trace
+    trailer included (it is body bytes like any other)."""
+    value = (np.arange(30_000, dtype=np.float32) - 1.5) * 0.25
+    msg = ReduceBlock(value, 2, 0, 1, 41, 3)
+    parts = wire.encode_frame_parts("worker:7", msg, trace=trace)
+    body_len = sum(len(p) for p in parts) - 4
+    asm = bytearray(body_len)
+    ranges = _split_ranges(body_len, 3)
+    for off, ln in reversed(ranges):  # stripes land out of order
+        hdr = wire.parse_frag_header(wire.encode_frag_header(9, body_len, off))
+        assert hdr == (9, body_len, off)
+        asm[off : off + ln] = b"".join(
+            bytes(v) for v in wire.slice_parts(parts[1:], off, off + ln)
+        )
+    dest, back, tctx = wire.decode_frame_body_ex(asm)
+    assert dest == "worker:7"
+    assert tctx == trace
+    assert type(back) is ReduceBlock and back.count == 3
+    np.testing.assert_array_equal(back.value, value)
+
+
+def test_reassembled_truncation_is_rejected():
+    """A reassembly that never completed (missing stripe = zero bytes in
+    the gap) must be refused by the payload checksum, not silently
+    decoded — the receive path only delivers on full byte count, and the
+    decode checksum backstops even that."""
+    value = np.arange(20_000, dtype=np.float32)
+    msg = ScatterBlock(value, 0, 1, 2, 3)
+    parts = wire.encode_frame_parts("worker:1", msg)
+    body_len = sum(len(p) for p in parts) - 4
+    asm = bytearray(body_len)  # zeros where the missing stripe would land
+    ranges = _split_ranges(body_len, 3)
+    for off, ln in ranges[:-1]:  # drop the last stripe
+        asm[off : off + ln] = b"".join(
+            bytes(v) for v in wire.slice_parts(parts[1:], off, off + ln)
+        )
+    with pytest.raises(ValueError):
+        wire.decode_frame_body_ex(asm)
