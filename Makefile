@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip
+.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip chaos-train
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -96,6 +96,21 @@ chaos-gossip:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
 	  chaos-gossip --seed 1234 --streams 2 \
 	  --uring --intra-chunk 1048576 --congestion --out-dir chaos_gossip_run
+
+# fixed-seed workload-resilience drill (RESILIENCE.md "Tier 7"): a real
+# 4-node cluster where every node drives an ElasticTrainer-wrapped REAL
+# pipeline-parallel trainer; a seeded chaos crash kills one node
+# mid-train-step, every survivor must RESTAGE the layer stack over the
+# surviving pipe axis (snapshot -> rebuild -> restore, no optimizer state
+# lost — the loss curve resumes inside the pinned band), rounds must keep
+# completing at the reduced membership, and the run must end gracefully.
+# tests/test_chaos_train.py runs the same drill's fastest (dp) arm in
+# tier-1.
+chaos-train:
+	JAX_PLATFORMS=cpu timeout -k 15 560 $(PYTHON) -m akka_allreduce_tpu \
+	  chaos-train --seed 1234 --family pipeline --streams 2 --gossip \
+	  --uring --intra-chunk 1048576 --congestion \
+	  --out-dir chaos_train_run
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -4506,6 +4506,510 @@ def _cmd_chaos_adapt(argv: list[str]) -> int:
     return 0 if not failures else 1
 
 
+def _cmd_chaos_train_node(argv: list[str]) -> int:
+    """Tier-7 node role (RESILIENCE.md "Tier 7 — workload resilience"):
+    one REAL trainer family, ElasticTrainer-wrapped, riding the TCP
+    cluster. The cluster's membership view drives the wrapper's
+    snapshot -> rebuild -> restore re-mesh between steps, and the
+    leader's RoundPolicy wire stamp drives the trainer's ICI compress
+    mode through the same factory rebuild path — the ``chaos-train``
+    drill spawns one of these per cluster node."""
+    p = argparse.ArgumentParser(
+        "chaos-train-node",
+        description="training node driving an ElasticTrainer-wrapped real "
+        "trainer; membership re-meshes and RoundPolicy compress changes "
+        "follow the cluster",
+    )
+    p.add_argument("--seed", required=True, help="master host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument(
+        "--node-id", type=int, required=True,
+        help="this node's id AND its device-group index (the drill "
+        "assigns 0..nodes-1 so every process re-meshes identically)",
+    )
+    p.add_argument(
+        "--nodes", type=int, required=True,
+        help="planned cluster size: the local virtual-device mesh is "
+        "partitioned into this many node device groups",
+    )
+    p.add_argument(
+        "--family", choices=("dp", "zero1", "fsdp", "pipeline"),
+        default="dp",
+        help="which real trainer family rides the elastic cycle "
+        "(train/zoo.py)",
+    )
+    p.add_argument("--model-seed", type=int, default=0)
+    p.add_argument("--elastic-rate", type=float, default=0.5)
+    p.add_argument(
+        "--min-nodes", type=int, default=1,
+        help="below this many live nodes the learner PAUSES (holds "
+        "position) instead of stepping — recovery resumes it",
+    )
+    p.add_argument(
+        "--max-steps", type=int, default=0,
+        help="0 = train until the master broadcasts Shutdown",
+    )
+    p.add_argument(
+        "--warmup-steps", type=int, default=8,
+        help="local steps taken BEFORE joining the cluster (compile + a "
+        "real loss trajectory first; rounds only start once every node "
+        "joined, so a round-triggered kill lands mid-training)",
+    )
+    p.add_argument("--metrics-out", default=None, help="per-step JSONL path")
+    p.add_argument("--chaos-log", default=None, metavar="FILE")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    # the drill is the operator here: opt into the old-jax shims BEFORE
+    # any mesh is built (a no-op on modern jax — see _jax_compat)
+    import akka_allreduce_tpu._jax_compat  # noqa: F401
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.control.cluster import Endpoint
+    from akka_allreduce_tpu.train import ElasticClusterNode
+    from akka_allreduce_tpu.train import zoo
+    from akka_allreduce_tpu.utils.metrics import MetricsLogger
+
+    per = zoo.devices_per_node(args.family)
+    devices = jax.devices()
+    if len(devices) < args.nodes * per:
+        raise SystemExit(
+            f"{args.family} needs {args.nodes * per} devices "
+            f"({per}/node), have {len(devices)}: set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={args.nodes * per}"
+        )
+    assignment = {
+        n: devices[n * per : (n + 1) * per] for n in range(args.nodes)
+    }
+    elastic = zoo.make_elastic(
+        args.family, assignment,
+        seed=args.model_seed, min_nodes=args.min_nodes,
+    )
+    ds = zoo.dataset_for(args.family)
+    step_seq = {"i": 0}
+
+    def batches(trainer):
+        # this node's OWN data shard: the seed offset folds the node id,
+        # the batch geometry follows the LIVE trainer (re-mesh aware)
+        step_seq["i"] += 1
+        return zoo.batch_for(
+            args.family, ds, elastic,
+            seed_offset=args.node_id * 100_003 + step_seq["i"],
+        )
+
+    logger = MetricsLogger(args.metrics_out) if args.metrics_out else None
+
+    def on_step(m) -> None:
+        if logger is None:
+            return
+        logger.log_event(
+            kind="train_step",
+            step=m.step,
+            loss=round(float(m.loss), 6),
+            contributors=float(m.contributors),
+            generation=elastic.generation,
+            members=list(elastic.member_nodes),
+            n_devices=elastic.n_devices,
+            compress=elastic.compress_mode or "full",
+            # pipeline restage evidence (the drill pins the gcd rule)
+            stages=getattr(elastic.trainer, "stages", None),
+        )
+
+    async def run() -> int:
+        cnode = ElasticClusterNode(
+            Endpoint.parse(args.seed),
+            elastic,
+            batches,
+            elastic_rate=args.elastic_rate,
+            host=args.host,
+            port=args.port,
+            preferred_node_id=args.node_id,
+            on_step=on_step,
+            # real OS process: the chaos `crash` fault may os._exit here
+            # (the drill's seeded mid-step node kill)
+            allow_crash=True,
+            chaos_log=args.chaos_log,
+        )
+        t0 = time.perf_counter()
+        steps = await cnode.run(
+            args.max_steps or None, warmup_steps=args.warmup_steps
+        )
+        dt = time.perf_counter() - t0
+        losses = cnode.losses
+        trend = (
+            f"loss {losses[0]:.4f} -> {losses[-1]:.4f}"
+            if losses
+            else "no steps taken"
+        )
+        print(
+            f"trained {steps} steps in {dt:.1f}s "
+            f"({cnode.rounds_applied} sync rounds applied) "
+            f"remeshes={cnode.remeshes} "
+            f"compress_changes={cnode.compress_changes} "
+            f"generation={elastic.generation} "
+            f"final_compress={elastic.compress_mode or 'full'}; {trend}",
+            flush=True,
+        )
+        return 0
+
+    rc = asyncio.run(run())
+    if logger is not None:
+        logger.close()
+    return rc
+
+
+def _cmd_chaos_train(argv: list[str]) -> int:
+    """Workload-resilience drill (RESILIENCE.md "Tier 7", ISSUE 14
+    acceptance): a real master + N ``chaos-train-node`` processes — each
+    driving an ElasticTrainer-wrapped REAL trainer of one family — run an
+    open-ended round budget; a SEEDED ``crash:node=K,at=roundN`` kills
+    one node mid-train-step. The drill asserts, from the processes' own
+    evidence: the crash was the injected one (exit 23); every survivor
+    re-meshed (snapshot -> rebuild over the survivors' devices ->
+    restore) and its loss trajectory RESUMED within the pinned band (the
+    restore lost no optimizer state); rounds kept completing at the
+    reduced membership (zero wedged rounds); and the run finished
+    gracefully. ``make chaos-train`` runs the fixed-seed pipeline arm —
+    the restage case."""
+    p = argparse.ArgumentParser(
+        "chaos-train",
+        description="seeded mid-step node kill under a real trainer "
+        "family; assert loss-curve continuity across the re-mesh, zero "
+        "wedged rounds, graceful completion",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument(
+        "--family", choices=("dp", "zero1", "fsdp", "pipeline"),
+        default="pipeline",
+    )
+    p.add_argument(
+        "--nodes", type=int, default=0,
+        help="cluster size (0 = family default: 4 for pipeline — enough "
+        "devices that a node loss RESTAGES the trunk — else 3)",
+    )
+    p.add_argument(
+        "--kill-at-round", type=int, default=30,
+        help="allreduce round at which the victim's seeded crash fires",
+    )
+    p.add_argument(
+        "--post-rounds", type=int, default=25,
+        help="survivor-membership rounds that must complete AFTER the "
+        "kill (the zero-wedged-rounds evidence)",
+    )
+    p.add_argument(
+        "--post-steps", type=int, default=6,
+        help="post-re-mesh train steps each survivor must log (the "
+        "loss-continuity sample)",
+    )
+    p.add_argument(
+        "--warmup-steps", type=int, default=8,
+        help="per-node local steps BEFORE joining (rounds, and so the "
+        "round-triggered kill, start only once every node joined — the "
+        "victim dies mid-training, not mid-compile)",
+    )
+    p.add_argument(
+        "--loss-band", type=float, default=0.35,
+        help="pinned continuity band: each survivor's median loss over "
+        "its first post-re-mesh steps must stay within (1 + band) x its "
+        "median over the last pre-kill steps (+0.05 absolute slack for "
+        "near-converged curves) — a restore that lost optimizer state "
+        "resets the curve and blows this bar",
+    )
+    p.add_argument("--phase-timeout", type=float, default=300.0)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.25)
+    p.add_argument("--chunk", type=int, default=16384)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome)",
+    )
+    p.add_argument(
+        "--adapt", action="store_true",
+        help="also run the leader's AdaptiveController (the ICI "
+        "compress-follows-policy plumbing is live either way; the "
+        "dedicated pin lives in tests/test_chaos_train.py)",
+    )
+    p.add_argument("--out-dir", default="chaos_train_run")
+    _add_drill_gossip_flags(p)
+    _add_drill_lever_flags(p)
+    args = p.parse_args(argv)
+
+    import json
+    import os
+    import re
+    import signal as _signal
+    import statistics
+    import subprocess
+
+    from akka_allreduce_tpu.control.chaos import parse_spec
+
+    nodes = args.nodes or (4 if args.family == "pipeline" else 3)
+    victim = nodes - 1
+    spec = f"crash:node={victim},at=round{args.kill_at_round}"
+    try:
+        parse_spec(spec)
+    except ValueError as e:
+        p.error(str(e))
+    os.makedirs(args.out_dir, exist_ok=True)
+    metrics_path = os.path.join(args.out_dir, "rounds.jsonl")
+    node_jsonl = {
+        k: os.path.join(args.out_dir, f"train-node{k}.jsonl")
+        for k in range(nodes)
+    }
+    for f in (metrics_path, *node_jsonl.values()):
+        if os.path.exists(f):
+            os.remove(f)  # MetricsLogger appends; one run per file
+
+    # size the cluster's data plane to the family model (the elastic-
+    # averaging payload IS the flat params) — built on one device, cheap;
+    # the parent opts into the old-jax shims exactly like the node role
+    import akka_allreduce_tpu._jax_compat  # noqa: F401
+    from akka_allreduce_tpu.train import zoo
+
+    size = zoo.family_param_count(args.family)
+    print(f"{args.family}: {size} params -> data_size {size}", flush=True)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # every node process simulates the SAME global device set locally
+        # (node k owns device group k), so their re-meshes agree
+        "XLA_FLAGS": "--xla_force_host_platform_device_count="
+        f"{nodes * zoo.devices_per_node(args.family)}",
+    }
+    spawn = _drill_spawn(env)
+
+    failures: list[str] = []
+    await_phase = _drill_phase_waiter(args.phase_timeout, failures)
+
+    def node_steps(k: int) -> list[dict]:
+        return [
+            r
+            for r in _drill_jsonl_records(node_jsonl[k])
+            if r.get("kind") == "train_step"
+        ]
+
+    def survivor_rounds() -> int:
+        return _drill_full_rounds(metrics_path, nodes - 1)
+
+    master = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(nodes),
+        "--rounds", "-1", "--size", str(size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
+        "--chaos-seed", str(args.seed), "--chaos-spec", spec,
+        "--chaos-log", os.path.join(args.out_dir, "chaos-master.jsonl"),
+        "--metrics-out", metrics_path,
+        *(["--adapt"] if args.adapt else []),
+        *_drill_gossip_args(args),
+        *_drill_lever_args(args),
+    )
+    procs: list = []
+    node_out: dict[int, str] = {}
+    master_done = False
+    victim_rc: int | None = None
+    try:
+        seed_ep = None
+        for line in master.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("master never reported its endpoint")
+        procs = [
+            spawn(
+                "chaos-train-node", "--seed", seed_ep,
+                "--node-id", str(k), "--nodes", str(nodes),
+                "--family", args.family,
+                "--warmup-steps", str(args.warmup_steps),
+                "--metrics-out", node_jsonl[k],
+                "--chaos-log",
+                os.path.join(args.out_dir, f"chaos-node{k}.jsonl"),
+            )
+            for k in range(nodes)
+        ]
+        # phase 1: every node trained its warm-up trajectory (these steps
+        # run BEFORE the join, so the round-triggered kill cannot fire
+        # until every node is genuinely training)
+        warm = max(1, args.warmup_steps)
+        await_phase(
+            lambda: all(len(node_steps(k)) >= warm for k in range(nodes)),
+            "every node's warm-up trajectory",
+        )
+        # phase 2: the seeded crash takes the victim down (exit 23)
+        if not failures:
+            await_phase(
+                lambda: procs[victim].poll() is not None,
+                f"the seeded crash of node {victim}",
+            )
+            victim_rc = procs[victim].poll()
+        # phase 3: every survivor re-meshed to the surviving membership
+        survivors = [k for k in range(nodes) if k != victim]
+        want = sorted(survivors)
+
+        def remeshed(k: int) -> bool:
+            return any(
+                r["generation"] >= 1 and r.get("members") == want
+                for r in node_steps(k)
+            )
+
+        if not failures:
+            await_phase(
+                lambda: all(remeshed(k) for k in survivors),
+                "every survivor's re-mesh to the surviving membership",
+            )
+        # phase 4: loss continuity sample + zero wedged rounds — the
+        # reduced membership keeps completing rounds AND steps
+        if not failures:
+
+            def post_steps(k: int) -> int:
+                return sum(
+                    1 for r in node_steps(k) if r["generation"] >= 1
+                )
+
+            target = survivor_rounds() + args.post_rounds
+            await_phase(
+                lambda: survivor_rounds() >= target
+                and all(
+                    post_steps(k) >= args.post_steps for k in survivors
+                ),
+                f"{args.post_rounds} survivor-membership rounds and "
+                f"{args.post_steps} post-re-mesh steps per survivor",
+            )
+        master.send_signal(_signal.SIGTERM)
+        try:
+            out, _ = master.communicate(timeout=60)
+            master_done = "master done" in out
+        except subprocess.TimeoutExpired:
+            failures.append("master did not shut down on SIGTERM")
+        for k, n in enumerate(procs):
+            try:
+                node_out[k], _ = n.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                n.kill()
+                node_out[k] = ""
+    finally:
+        for proc in [master, *procs]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # -- assertions over the collected evidence ------------------------------
+    if victim_rc is None:
+        victim_rc = procs[victim].poll() if procs else None
+    if victim_rc != 23:
+        failures.append(
+            f"victim exited {victim_rc}, not the chaos crash exit 23"
+        )
+    survivors = [k for k in range(nodes) if k != victim]
+    continuity: dict[int, dict] = {}
+    for k in survivors:
+        steps = node_steps(k)
+        pre = [r["loss"] for r in steps if r["generation"] == 0]
+        post = [r["loss"] for r in steps if r["generation"] >= 1]
+        if not pre or len(post) < args.post_steps:
+            failures.append(
+                f"node {k}: not enough steps for the continuity check "
+                f"(pre={len(pre)}, post={len(post)})"
+            )
+            continue
+        pre_med = statistics.median(pre[-args.post_steps:])
+        post_med = statistics.median(post[: args.post_steps])
+        bar = pre_med * (1.0 + args.loss_band) + 0.05
+        continuity[k] = {
+            "pre_median": round(pre_med, 4),
+            "post_median": round(post_med, 4),
+            "bar": round(bar, 4),
+        }
+        if not (post_med <= bar):
+            failures.append(
+                f"node {k}: post-re-mesh median loss {post_med:.4f} "
+                f"exceeds the continuity bar {bar:.4f} "
+                f"(pre-kill median {pre_med:.4f}, band {args.loss_band})"
+            )
+        if any(not np_isfinite(loss) for loss in pre + post):
+            failures.append(f"node {k}: non-finite loss in the trajectory")
+        if args.family == "pipeline":
+            # the restage rule, end to end: at the surviving membership
+            # the trunk must run at S' = gcd(live devices, n_layers)
+            # stages (train/zoo.py pins n_layers=4; a DP-only fallback
+            # would show stages == 1 here and is equally legal only when
+            # the gcd says so)
+            import math as _math
+
+            n_live = len(survivors) * 2  # zoo: 2 devices per node
+            want_pp = _math.gcd(n_live, 4)
+            at_survivors = [
+                r for r in steps if r.get("members") == sorted(survivors)
+            ]
+            bad = [
+                r["stages"] for r in at_survivors if r["stages"] != want_pp
+            ]
+            if not at_survivors:
+                failures.append(
+                    f"node {k}: no steps at the surviving membership"
+                )
+            elif bad:
+                failures.append(
+                    f"node {k}: restaged to {bad[0]} stages, expected "
+                    f"{want_pp} (gcd of {n_live} devices and 4 layers)"
+                )
+    summaries: dict[int, dict] = {}
+    for k in survivors:
+        out = node_out.get(k, "")
+        m = re.search(
+            r"trained (\d+) steps .*remeshes=(\d+) compress_changes=(\d+) "
+            r"generation=(\d+) final_compress=(\S+);",
+            out or "",
+        )
+        if not m:
+            failures.append(f"node {k} never reported its summary line")
+            continue
+        summaries[k] = {
+            "steps": int(m.group(1)),
+            "remeshes": int(m.group(2)),
+            "compress_changes": int(m.group(3)),
+            "generation": int(m.group(4)),
+            "final_compress": m.group(5),
+        }
+        if int(m.group(2)) < 1:
+            failures.append(f"node {k} reported zero re-meshes")
+    if not master_done:
+        failures.append("master did not finish cleanly")
+
+    summary = {
+        "seed": args.seed,
+        "family": args.family,
+        "spec": spec,
+        "nodes": nodes,
+        "victim": victim,
+        "victim_exit": victim_rc,
+        "survivor_rounds": survivor_rounds(),
+        "continuity": continuity,
+        "loss_band": args.loss_band,
+        "node_summaries": summaries,
+        "master_done": master_done,
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
+def np_isfinite(x) -> bool:
+    """math.isfinite over drill-JSON floats (no numpy import needed in
+    the drill parent's assertion path)."""
+    import math
+
+    try:
+        return math.isfinite(float(x))
+    except (TypeError, ValueError):
+        return False
+
+
 def _cmd_obs(argv: list[str]) -> int:
     """Observability toolbox: run the 2-process trace demo, inspect flight
     dumps, merge per-process Perfetto traces (OBSERVABILITY.md)."""
@@ -4695,6 +5199,8 @@ COMMANDS = {
     "chaos-failover": _cmd_chaos_failover,
     "chaos-adapt": _cmd_chaos_adapt,
     "chaos-gossip": _cmd_chaos_gossip,
+    "chaos-train": _cmd_chaos_train,
+    "chaos-train-node": _cmd_chaos_train_node,
 }
 
 

@@ -23,6 +23,9 @@ Shim semantics on old jax:
   codebase already carries for unchecked regions.
 - ``lax.pcast``: the varying-manual-axes *type* cast; with no VMA type
   system (and the static checker off) it is the identity on data.
+- ``lax.axis_size``: ``psum(1, axis)`` — the long-standing idiom; on a
+  constant it folds at trace time to the static size, which is what the
+  ZeRO-1 pad-shape arithmetic needs.
 """
 
 from __future__ import annotations
@@ -52,6 +55,13 @@ def _install() -> None:
             return x
 
         lax.pcast = pcast
+
+    if not hasattr(lax, "axis_size"):
+
+        def axis_size(axis_name):
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
 
 
 _install()

@@ -70,6 +70,14 @@ _DECISIONS = _metrics.counter("adapt.decisions")
 #: wire modes per degrade level past full fidelity (level 1, level 2)
 _WIRE_LADDER = ("f16", "int8")
 
+#: RoundPolicy wire stamp -> XLA-side trainer ``compress`` mode: the ONE
+#: mapping that closes the ICI half of the loop (train/elastic.py's
+#: ``apply_policy_wire``). The host wire's half-width float is f16; the
+#: ICI collectives' is bf16 (the MXU-native half) — same ladder step,
+#: per-plane dtype. "" (the default stamp) means inherit, i.e. the
+#: trainer's construction-time mode, NOT necessarily full fidelity.
+WIRE_TO_COMPRESS = {"f32": None, "f16": "bf16", "int8": "int8"}
+
 #: registry counters whose WINDOW DELTAS are degrade pressure / restore
 #: blockers — the master snapshots these and hands them to observe_round
 COUNTER_EVIDENCE = ("restarts", "reconnects", "drops", "reorgs")

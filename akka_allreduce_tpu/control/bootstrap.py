@@ -1499,6 +1499,21 @@ class NodeProcess:
         self.failover_walk_attempts = 3
         self.transport.on_send_error = self._on_send_error
         self.transport.on_send_ok = self._on_send_ok
+        # workload-resilience seam (RESILIENCE.md "Tier 7"): the trainer
+        # loop riding this node can FOLLOW the cluster — on_members fires
+        # with the AddressBook's live node ids after every membership
+        # change (event-loop context: keep it a cheap cell swap), and
+        # policy_wire() reads the newest RoundPolicy wire stamp the
+        # workers observed, so one leader controller can drive the
+        # trainer's ICI compression too
+        self.on_members: Callable[[tuple[int, ...]], None] | None = None
+        # the carried policy-wire observation: workers are rebuilt on
+        # every re-Welcome (fresh last_policy), but the leader's ladder
+        # level did not change just because WE re-joined — the last
+        # observed stamp bridges the gap until the new epoch's first
+        # Start re-stamps it (otherwise every re-mesh would flap the
+        # trainer to full fidelity and back, two spurious re-jits)
+        self._policy_wire = ""
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -1577,6 +1592,22 @@ class NodeProcess:
             return None
         # dict lookup: this resolver runs per outgoing chunk on the data path
         return self._endpoints.get(worker_id // self.config.master.dimensions)
+
+    def policy_wire(self) -> str:
+        """The newest RoundPolicy wire stamp this node's workers observed
+        ("" until any Start arrived, or when the leader runs full
+        fidelity). Survives worker rebuilds (re-Welcome / rejoin): the
+        carried value answers until the new epoch's first Start. Reads +
+        one reference write, GIL-atomic — safe to poll from a learner
+        thread (train/cluster.py's compress-follows-policy loop)."""
+        if self.node is not None and self.node.workers:
+            w = max(
+                self.node.workers.values(),
+                key=lambda w: w.last_policy_round,
+            )
+            if w.last_policy_round >= 0:
+                self._policy_wire = w.last_policy.wire
+        return self._policy_wire
 
     def _gossip_peer_endpoint(self, node_id: int) -> cl.Endpoint | None:
         if node_id < 0:
@@ -1766,6 +1797,8 @@ class NodeProcess:
                 self.gossip.set_members(
                     set(self._endpoints) | {gsp.MASTER_ID}
                 )
+            if self.on_members is not None:
+                self.on_members(msg.node_ids())
             return []
         if isinstance(msg, st.AdvertSolicit):
             # a (replacement) master wants to know what this disk holds —

@@ -133,6 +133,11 @@ class AddressBook:
     epoch: int = -1
     standbys: tuple[tuple[str, int], ...] = ()
 
+    def node_ids(self) -> tuple[int, ...]:
+        """The live membership this book describes, sorted — what the
+        node-side elastic cycle re-meshes to (RESILIENCE.md "Tier 7")."""
+        return tuple(sorted(nid for nid, _h, _p in self.entries))
+
     def __post_init__(self) -> None:
         object.__setattr__(
             self, "entries", tuple(tuple(e) for e in self.entries)

@@ -97,6 +97,13 @@ class AllreduceWorker:
         # this round's outgoing payload frames — every worker sees the
         # SAME stamp for a round id, so thresholds can never disagree
         self._policies: dict[int, RoundPolicy] = {}
+        # the newest Start's stamp (monotone by round id) — the ICI-side
+        # adaptive loop's observation point (RESILIENCE.md "Tier 7"): the
+        # trainer loop polls this to follow the leader's wire ladder; a
+        # DEFAULT stamp is recorded too, so a restore to full fidelity is
+        # just as visible as a degrade
+        self.last_policy: RoundPolicy = DEFAULT_POLICY
+        self.last_policy_round: int = -1
         # int8 wire-mode error feedback: per-(dest worker, chunk) residual
         # of the last quantized send, added into the next round's chunk —
         # the ring_ef_residual identity (comm/allreduce.py) with v=1: the
@@ -233,6 +240,11 @@ class AllreduceWorker:
             del self._policies[stale]
         out: list[Envelope] = []
         pol = msg.policy
+        if r >= self.last_policy_round:
+            # Start is authoritative for its round; an out-of-order OLDER
+            # Start (window overlap) must not regress the observation
+            self.last_policy = pol
+            self.last_policy_round = r
         if pol.is_default:
             # the Start's stamp is authoritative for its round id: drop a
             # Prepare-seeded policy it supersedes (the controller may have

@@ -35,6 +35,14 @@ class SoakReport:
     first_loss: float
     final_loss: float
     remesh_events: list  # [{step, kind, seconds, n_devices}]
+    # the re-mesh accounting SPLIT by provenance: `forced` re-meshes were
+    # scripted by the harness itself (the leader-failover schedule entry —
+    # membership unchanged, the cluster re-runs Prepare under a new
+    # epoch), `detected` ones came out of the failure detector (drop /
+    # rejoin edges). The old single trail conflated them, so a soak JSON
+    # could not say whether churn was injected or observed.
+    remeshes_forced: int
+    remeshes_detected: int
     # {at_step, restored_step, seconds, source: disk|peer, [pull]} — the
     # disk-vs-peer A/B is readable from this one record: `seconds` always
     # measures the SAME span (wipe-if-any + state fetch + trainer restore),
@@ -335,6 +343,13 @@ def run_soak(
                 }
             )
             reg.counter(f"soak.remesh.{kind}").inc()
+            # provenance split (pinned in test_soak): forced = the
+            # harness scripted it; detected = the phi detector found it
+            reg.counter(
+                "soak.remesh.forced"
+                if forced_kind
+                else "soak.remesh.detected"
+            ).inc()
             compile_steps.add(step)
             log(
                 f"step {step}: re-mesh ({kind}) -> "
@@ -457,6 +472,8 @@ def run_soak(
         first_loss=round(losses[0], 4),
         final_loss=round(losses[-1], 4),
         remesh_events=list(remesh_events.values),
+        remeshes_forced=reg.counter("soak.remesh.forced").value,
+        remeshes_detected=reg.counter("soak.remesh.detected").value,
         restore=restore_rec,
         replication=replication,
         adapt=(

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Callable, Iterator
 
 import numpy as np
@@ -43,8 +44,20 @@ class ElasticClusterNode:
 
     Args:
       seed: the master's endpoint.
-      trainer: a ``DPTrainer`` (typically over this node's local devices).
-      batches: iterator of ``(x, y)`` global batches for the LOCAL trainer.
+      trainer: a ``DPTrainer`` (typically over this node's local devices)
+        — or an :class:`~akka_allreduce_tpu.train.elastic.ElasticTrainer`,
+        which arms the tier-7 workload-resilience loop (RESILIENCE.md):
+        the CLUSTER's membership view (AddressBook deltas, fed by the phi
+        hub or SWIM gossip) drives the wrapper's snapshot -> rebuild ->
+        restore re-mesh between steps, and the leader's per-round
+        ``RoundPolicy`` wire stamp drives the trainer's ICI ``compress``
+        mode through the trainer-factory rebuild path — ONE controller
+        degrades both planes. Both applications run on the LEARNER
+        thread (a rebuild re-jits; the event loop keeps heartbeating).
+      batches: iterator of ``(x, y)`` global batches for the LOCAL
+        trainer, or a callable ``trainer -> (x, y) | None`` for elastic
+        trainers (the batch geometry follows the current mesh; None ends
+        training).
       elastic_rate: pull strength toward the group average (reference
         ``NodeConfig.elastic_rate``).
     """
@@ -53,25 +66,41 @@ class ElasticClusterNode:
         self,
         seed: Endpoint,
         trainer,
-        batches: Iterator,
+        batches: Iterator | Callable,
         *,
         elastic_rate: float = 0.5,
         host: str = "127.0.0.1",
         port: int = 0,
         preferred_node_id: int = -1,
         on_step: Callable[[object], None] | None = None,
+        allow_crash: bool = False,
+        chaos_log: str | None = None,
     ) -> None:
         self.trainer = trainer
         self.batches = batches
         self.on_step = on_step
+        # tier-7 plumbing is armed by capability, not type (duck-typed so
+        # this module stays importable without the elastic stack)
+        self._elastic = hasattr(trainer, "apply_membership")
         # Cross-thread hand-off cells; every access is one reference
         # read/swap (atomic under the GIL), never a held lock:
         #   _snapshot: latest weights, published by the learner thread,
         #              read by binder rounds on the event loop;
         #   _incoming: latest elastic-averaged weights, deposited by the
-        #              binder, consumed by the learner before its next step.
+        #              binder, consumed by the learner before its next step;
+        #   _members: latest AddressBook membership, deposited by the
+        #             event loop, applied by the learner before its next
+        #             step (a second change landing during a restore just
+        #             overwrites the cell — the learner re-meshes straight
+        #             to the NEWEST view, never through the stale one).
         self._snapshot: np.ndarray = trainer.get_flat_params()
         self._incoming: np.ndarray | None = None
+        self._members: tuple[int, ...] | None = None
+        self._last_wire = ""
+        self._policy_unsupported = False
+        self.remeshes = 0
+        self.compress_changes = 0
+        self.paused = False  # below min_nodes: waiting for a rejoin
         self.binder = ElasticAverageBinder(
             self._read_snapshot, self._deposit, elastic_rate
         )
@@ -82,7 +111,11 @@ class ElasticClusterNode:
             host,
             port,
             preferred_node_id=preferred_node_id,
+            allow_crash=allow_crash,
+            chaos_log=chaos_log,
         )
+        if self._elastic:
+            self.node.on_members = self._on_members
         self.losses: list[float] = []
 
     # -- binder seam (runs on the transport event loop; must never block) ------
@@ -93,13 +126,71 @@ class ElasticClusterNode:
     def _deposit(self, vec: np.ndarray) -> None:
         self._incoming = vec
 
+    def _on_members(self, members: tuple[int, ...]) -> None:
+        # event-loop context: one cell swap, the learner applies it
+        self._members = members
+
     # -- learner thread --------------------------------------------------------
 
-    def _train_one(self) -> bool:
+    def _apply_cluster_view(self) -> None:
+        """Fold the cluster's authoritative state into the local elastic
+        trainer (learner-thread context — re-jits must not block the
+        event loop): first the newest membership view, then the newest
+        policy wire stamp. Both go through the wrapper's trainer-factory
+        rebuild path, never a per-step retrace."""
+        members, self._members = self._members, None
+        if members is not None:
+            try:
+                if self.trainer.apply_membership(members):
+                    self.remeshes += 1
+            except RuntimeError as e:
+                # e.g. a book snapshot without any assigned node (a
+                # mid-rejoin view): keep stepping on the old mesh — the
+                # next book lands in the cell and is applied then
+                log.warning("membership %s not applied: %s", members, e)
+        wire = self.node.policy_wire()
+        if wire != self._last_wire and not self._policy_unsupported:
+            self._last_wire = wire
+            try:
+                if self.trainer.apply_policy_wire(wire):
+                    self.compress_changes += 1
+                    log.info(
+                        "policy wire %r -> ICI compress %s",
+                        wire, self.trainer.compress_mode,
+                    )
+            except RuntimeError as e:
+                # a factory without a `compress` kwarg has no rebuild path:
+                # keep training at the construction mode (degrade is the
+                # HOST wire's job then) — and stop re-trying every step
+                self._policy_unsupported = True
+                log.warning("policy wire %r not applied: %s", wire, e)
+
+    def _next_batch(self):
+        if callable(self.batches):
+            return self.batches(self.trainer)
         try:
-            x, y = next(self.batches)
+            return next(self.batches)
         except StopIteration:
-            return False
+            return None
+
+    def _train_one(self) -> str:
+        """One learner iteration: "stepped" (a real step ran), "paused"
+        (below min_nodes — held position), or "end" (batches ran out)."""
+        if self._elastic:
+            self._apply_cluster_view()
+            if self.trainer.n_nodes < self.trainer.min_nodes:
+                # degrade, don't wedge — and don't crash: hold position
+                # until the membership recovers (a rejoin re-grows the
+                # mesh through the same cell). The binder keeps answering
+                # rounds with the last snapshot meanwhile.
+                self.paused = True
+                time.sleep(0.2)
+                return "paused"
+            self.paused = False
+        batch = self._next_batch()
+        if batch is None:
+            return "end"
+        x, y = batch
         incoming, self._incoming = self._incoming, None
         if incoming is not None:
             self.trainer.set_flat_params(incoming)
@@ -108,13 +199,27 @@ class ElasticClusterNode:
         self.losses.append(m.loss)
         if self.on_step is not None:
             self.on_step(m)
-        return True
+        return "stepped"
 
     # -- lifecycle -------------------------------------------------------------
 
-    async def run(self, max_steps: int | None = None) -> int:
+    async def run(
+        self, max_steps: int | None = None, *, warmup_steps: int = 0
+    ) -> int:
         """Join the cluster, then train until the batches run out, ``max_steps``
-        is reached, or the master broadcasts Shutdown. Returns steps taken."""
+        is reached, or the master broadcasts Shutdown. Returns steps taken
+        (warm-up included).
+
+        ``warmup_steps`` run BEFORE the join: the learner compiles and
+        takes its first steps locally, so the node enters the sync fabric
+        with weights worth averaging — and a drill's round-triggered
+        faults (the master organizes, and rounds start, only once every
+        node joined) land on nodes that are genuinely mid-training."""
+        warmed = 0
+        for _ in range(warmup_steps):
+            if await asyncio.to_thread(self._train_one) != "stepped":
+                break
+            warmed += 1
         await self.node.start()
         node_id = await self.node.wait_welcomed()
         expected = self.node.config.metadata.data_size
@@ -130,7 +235,7 @@ class ElasticClusterNode:
             self.trainer.param_count,
             self.binder.elastic_rate,
         )
-        steps = 0
+        steps = warmed
         shutdown = observed_task(
             self.node.run_until_shutdown(), name="shutdown-watch"
         )
@@ -143,10 +248,17 @@ class ElasticClusterNode:
             while max_steps is None or steps < max_steps:
                 if max_steps is None and shutdown.done():
                     break
-                stepped = await asyncio.to_thread(self._train_one)
-                if not stepped:
+                outcome = await asyncio.to_thread(self._train_one)
+                if outcome == "end":
                     break
-                steps += 1
+                if outcome == "paused" and shutdown.done():
+                    # a bounded learner normally ignores Shutdown ("train
+                    # it to the end"), but a paused one cannot make
+                    # progress by definition — holding position past the
+                    # cluster's end would spin forever
+                    break
+                if outcome == "stepped":
+                    steps += 1
             if not shutdown.done():
                 # master still running rounds: depart gracefully so the
                 # remaining members re-line without detector latency

@@ -19,6 +19,7 @@ the phi-accrual detector; a silent node's devices leave the mesh at the next
 
 from __future__ import annotations
 
+import inspect
 import logging
 import math
 import time
@@ -27,16 +28,33 @@ from typing import Callable, Mapping, Sequence
 import jax
 import numpy as np
 
+from akka_allreduce_tpu.control.adapt import WIRE_TO_COMPRESS
 from akka_allreduce_tpu.control.failure import (
     HeartbeatMonitor,
     MembershipEvent,
     PhiAccrualFailureDetector,
 )
+from akka_allreduce_tpu.obs import flight as _flight
+from akka_allreduce_tpu.obs import metrics as _metrics
 from akka_allreduce_tpu.parallel.mesh import line_mesh
 from akka_allreduce_tpu.train.checkpoint import Snapshot
 from akka_allreduce_tpu.train.trainer import DPTrainer, TrainStepMetrics
 
 log = logging.getLogger(__name__)
+
+# elastic.* observability (OBSERVABILITY.md): every snapshot->rebuild->
+# restore cycle lands one histogram observation + one per-kind counter +
+# one `remesh` flight event; the compress gauge tracks the ICI ladder
+_REMESH_SECONDS = _metrics.histogram("elastic.remesh.seconds")
+_COMPRESS_LEVEL = _metrics.gauge("elastic.compress_level")
+
+#: trainer ``compress`` mode -> ICI degrade-ladder level (the gauge's
+#: unit, mirroring ``adapt.level`` on the host plane)
+COMPRESS_LEVELS = {None: 0, "bf16": 1, "int8": 2}
+
+#: sentinel: no compress override in force — rebuilds run the factory at
+#: its construction-time mode (``apply_policy_wire("")`` restores this)
+_INHERIT = object()
 
 
 class ElasticTrainer:
@@ -62,6 +80,13 @@ class ElasticTrainer:
       detector: phi-accrual detector (default: Akka-like threshold 8).
       min_nodes: below this many live nodes, ``train_step`` refuses to run
         (the reference's th_allreduce floor applied to membership).
+      fallback_mesh_factory: devices -> Mesh tried when
+        ``trainer_factory`` REFUSES the primary mesh on a re-mesh (raises)
+        — the degrade-not-wedge escape hatch (RESILIENCE.md "Tier 7"):
+        e.g. a pipeline factory pinned to a fixed stage count falls back
+        to the DP-only mesh instead of wedging the elastic cycle. The
+        built-in families never need it (their adaptive axes are
+        gcd-derived, so every live device count has a valid shape).
     """
 
     def __init__(
@@ -73,6 +98,7 @@ class ElasticTrainer:
         detector: PhiAccrualFailureDetector | None = None,
         min_nodes: int = 1,
         clock: Callable[[], float] = time.monotonic,
+        fallback_mesh_factory: Callable[..., jax.sharding.Mesh] | None = None,
     ) -> None:
         if not devices_by_node:
             raise ValueError("need at least one node")
@@ -81,17 +107,34 @@ class ElasticTrainer:
             int(k): list(v) for k, v in devices_by_node.items()
         }
         self.mesh_factory = mesh_factory
+        self.fallback_mesh_factory = fallback_mesh_factory
         self.min_nodes = min_nodes
         self.clock = clock
         self.monitor = HeartbeatMonitor(detector)
         self.generation = 0  # the config_id analog: bumps on every re-mesh
         self.remesh_events: list[MembershipEvent] = []
+        # ICI compress override (RESILIENCE.md "Tier 7" — compress follows
+        # policy): _INHERIT = run the factory at its construction mode;
+        # set_compress/apply_policy_wire swap it and rebuild through the
+        # SAME trainer-factory path every re-mesh uses
+        self._compress = _INHERIT
+        self._factory_takes_compress = (
+            "compress" in inspect.signature(trainer_factory).parameters
+        )
+        # optional mode -> mode map for families whose factory CLAMPS the
+        # request (e.g. ZeRO-1 has no int8 ring: int8 -> bf16). Applied
+        # BEFORE the changed-mode check, so a stamp the clamp maps onto
+        # the current mode does not trigger a no-op factory rebuild.
+        self.clamp_compress: Callable[[str | None], str | None] | None = None
 
         now = self.clock()
         for node_id in self.devices_by_node:
             self.monitor.heartbeat(node_id, now)
         self.member_nodes: tuple[int, ...] = tuple(self.monitor.members_up)
         self.trainer = self._build_trainer()
+        # the construction-time mode "" (inherit) restores to
+        self._base_compress = getattr(self.trainer, "compress", None)
+        _COMPRESS_LEVEL.set(COMPRESS_LEVELS.get(self.compress_mode, 0))
 
     # -- membership ----------------------------------------------------------
 
@@ -101,9 +144,60 @@ class ElasticTrainer:
             devs.extend(self.devices_by_node[node_id])
         return devs
 
-    def _build_trainer(self):
-        mesh = self.mesh_factory(devices=self._live_devices())
+    def _build_trainer(self, mesh_factory=None):
+        mesh = (mesh_factory or self.mesh_factory)(
+            devices=self._live_devices()
+        )
+        if self._factory_takes_compress and self._compress is not _INHERIT:
+            return self.trainer_factory(mesh, compress=self._compress)
         return self.trainer_factory(mesh)
+
+    def _rebuild(self, kind: str, old_members: tuple[int, ...]) -> None:
+        """The elastic cycle's core: snapshot -> rebuild over the CURRENT
+        ``member_nodes`` -> restore, transactionally — on a factory
+        refusal the fallback mesh is tried (degrade, not wedge), and if
+        everything fails ``member_nodes`` reverts so the OLD trainer stays
+        usable (its devices may be live; the caller decides what to do
+        with the raised error)."""
+        t0 = time.perf_counter()
+        snap = Snapshot.capture(self.trainer)
+        try:
+            trainer = self._build_trainer()
+        except Exception:
+            if self.fallback_mesh_factory is None:
+                self.member_nodes = old_members
+                raise
+            log.warning(
+                "re-mesh (%s): factory refused the %d-device mesh; "
+                "degrading to the fallback mesh",
+                kind, len(self._live_devices()), exc_info=True,
+            )
+            try:
+                trainer = self._build_trainer(self.fallback_mesh_factory)
+            except Exception:
+                self.member_nodes = old_members
+                raise
+        try:
+            snap.restore_into(trainer)
+        except Exception:
+            # the old trainer was never touched: keep it, and the old view
+            self.member_nodes = old_members
+            raise
+        self.trainer = trainer
+        self.generation += 1
+        dt = time.perf_counter() - t0
+        _REMESH_SECONDS.observe(dt)
+        _metrics.counter(f"elastic.remeshes.{kind}").inc()
+        _COMPRESS_LEVEL.set(COMPRESS_LEVELS.get(self.compress_mode, 0))
+        _flight.note(
+            "remesh",
+            cause=kind,
+            members_from=list(old_members),
+            members_to=list(self.member_nodes),
+            generation=self.generation,
+            n_devices=self.trainer.n_devices,
+            seconds=round(dt, 4),
+        )
 
     def heartbeat(self, node_id: int, now: float | None = None) -> None:
         """Record a node's heartbeat. An unknown node id is a late joiner."""
@@ -143,11 +237,49 @@ class ElasticTrainer:
             self.generation,
             self.generation + 1,
         )
-        snap = Snapshot.capture(self.trainer)
+        old = self.member_nodes
         self.member_nodes = live
-        self.generation += 1
-        self.trainer = self._build_trainer()
-        snap.restore_into(self.trainer)
+        self._rebuild("grow" if len(live) > len(old) else "shrink", old)
+        return True
+
+    def apply_membership(
+        self, live: Sequence[int], now: float | None = None
+    ) -> bool:
+        """Re-mesh to an EXTERNALLY-decided membership view (RESILIENCE.md
+        "Tier 7"): the TCP cluster's failure detector — phi hub or SWIM
+        gossip — already judged who is alive, so the in-process phi
+        monitor is bypassed as a *detector* and merely kept coherent (its
+        records mirror the applied view, so a later ``poll`` cannot
+        re-litigate the verdict). Node ids without a device assignment are
+        ignored (a cluster can admit more nodes than this trainer planned
+        devices for). Returns True when a re-mesh happened."""
+        now = self.clock() if now is None else now
+        known = sorted(
+            {int(n) for n in live} & set(self.devices_by_node)
+        )
+        if not known:
+            raise RuntimeError(
+                f"no live node in {sorted(set(map(int, live)))} has a "
+                "device assignment; cannot re-mesh"
+            )
+        for nid in known:
+            ev = self.monitor.heartbeat(nid, now)
+            if ev is not None:
+                self.remesh_events.append(ev)
+        for nid in set(self.member_nodes) - set(known):
+            ev = self.monitor.force_unreachable(nid, now)
+            if ev is not None:
+                self.remesh_events.append(ev)
+        target = tuple(known)
+        if target == self.member_nodes:
+            return False
+        old = self.member_nodes
+        log.info(
+            "re-mesh (membership): members %s -> %s (generation %d -> %d)",
+            old, target, self.generation, self.generation + 1,
+        )
+        self.member_nodes = target
+        self._rebuild("grow" if len(target) > len(old) else "shrink", old)
         return True
 
     def remesh(self, reason: str = "forced") -> bool:
@@ -162,10 +294,74 @@ class ElasticTrainer:
             "re-mesh (%s): members %s unchanged (generation %d -> %d)",
             reason, self.member_nodes, self.generation, self.generation + 1,
         )
-        snap = Snapshot.capture(self.trainer)
-        self.generation += 1
-        self.trainer = self._build_trainer()
-        snap.restore_into(self.trainer)
+        self._rebuild(reason, self.member_nodes)
+        return True
+
+    # -- ICI compress follows the RoundPolicy (the adaptive loop's far end) --
+
+    @property
+    def compress_mode(self) -> str | None:
+        """The LIVE trainer's ICI wire mode (None / "bf16" / "int8")."""
+        return getattr(self.trainer, "compress", None)
+
+    def set_compress(self, mode: str | None) -> bool:
+        """Switch the trainer's ICI gradient compression by REBUILDING it
+        through the trainer factory (snapshot -> factory(mesh,
+        compress=mode) -> restore) — a mode change re-jits once, exactly
+        like a membership re-mesh, never per step. Error-feedback state
+        crosses the rebuild inside the snapshot (``_restore_ef``: the
+        residual sum — what the collective is still owed — is preserved);
+        a restore OUT of a compressed mode into one without EF drops the
+        residual, mirroring the host worker's restore-out-of-int8 rule.
+        Returns True when a rebuild happened."""
+        return self._set_compress_override(mode)
+
+    def apply_policy_wire(self, wire: str) -> bool:
+        """Drive :meth:`set_compress` from a :class:`RoundPolicy` wire
+        stamp — the ICI half of the closed adaptive loop: one leader
+        controller degrades the host wire (per-frame f16/int8) AND, via
+        this seam, the XLA collectives of whatever trainer rides the
+        cluster. ``""`` (the default stamp) clears the override, i.e.
+        restores the factory's construction-time mode."""
+        wire = wire or ""
+        if wire == "":
+            return self._set_compress_override(_INHERIT)
+        if wire not in WIRE_TO_COMPRESS:
+            log.warning("unknown policy wire %r: keeping compress mode", wire)
+            return False
+        return self._set_compress_override(WIRE_TO_COMPRESS[wire])
+
+    def _set_compress_override(self, value) -> bool:
+        mode = self._base_compress if value is _INHERIT else value
+        if mode not in COMPRESS_LEVELS:
+            raise ValueError(
+                f"compress must be one of {sorted(COMPRESS_LEVELS, key=str)}, "
+                f"got {mode!r}"
+            )
+        if value is not _INHERIT and self.clamp_compress is not None:
+            clamped = self.clamp_compress(mode)
+            if clamped != mode:
+                log.info("compress %s clamped to %s", mode, clamped)
+                mode = value = clamped
+        if mode == self.compress_mode:
+            self._compress = value  # record intent; nothing to rebuild
+            return False
+        if not self._factory_takes_compress:
+            raise RuntimeError(
+                "this trainer_factory does not accept a `compress` kwarg; "
+                "a policy-driven mode change has no rebuild path"
+            )
+        old = self._compress
+        self._compress = value
+        try:
+            self._rebuild("compress", self.member_nodes)
+        except Exception:
+            self._compress = old
+            raise
+        log.info(
+            "compress level -> %s (generation %d)",
+            mode or "full", self.generation,
+        )
         return True
 
     # -- training ------------------------------------------------------------
@@ -177,6 +373,12 @@ class ElasticTrainer:
     @property
     def n_nodes(self) -> int:
         return len(self.member_nodes)
+
+    @property
+    def param_count(self) -> int:
+        """Logical model size — invariant across re-meshes by contract
+        (what the cluster's ``data_size`` is derived from)."""
+        return self.trainer.param_count
 
     def train_step(
         self, x: np.ndarray, y: np.ndarray, valid: Sequence[float] | None = None
@@ -194,6 +396,13 @@ class ElasticTrainer:
         from akka_allreduce_tpu.binder.api import flatten_pytree
 
         return flatten_pytree(self.trainer.gathered_params())[0]
+
+    def set_flat_params(self, vec: np.ndarray) -> None:
+        """Binder deposit seam: the elastic-averaging sink writes the
+        group average back into whatever trainer is live right now (the
+        flat LOGICAL layout is mesh-size-independent, so a deposit is
+        valid across re-meshes)."""
+        self.trainer.set_flat_params(vec)
 
 
 def adaptive_parallel_factor(n_devices: int, divides: int) -> int:
@@ -255,8 +464,11 @@ class ElasticMoETrainer(ElasticTrainer):
                 (n // ep, ep), ("data", "expert"), devices=devices
             )
 
-        def factory(mesh):
-            return MoETrainer(mesh, n_experts=n_experts, **trainer_kwargs)
+        def factory(mesh, compress=_INHERIT):
+            kw = dict(trainer_kwargs)
+            if compress is not _INHERIT:
+                kw["compress"] = compress
+            return MoETrainer(mesh, n_experts=n_experts, **kw)
 
         super().__init__(
             factory,
@@ -315,13 +527,24 @@ class ElasticPipelineTrainer(ElasticTrainer):
                 (n // pp, pp), ("data", "pipe"), devices=devices
             )
 
-        def factory(mesh):
+        def factory(mesh, compress=_INHERIT):
             pp = int(mesh.shape["pipe"])
+            kw = dict(trainer_kwargs)
+            if compress is not _INHERIT:
+                kw["compress"] = compress
             return PipelineLMTrainer(
                 mesh,
                 layers_per_stage=n_layers // pp,
                 microbatches=microbatches,
-                **trainer_kwargs,
+                **kw,
+            )
+
+        def dp_only_mesh(*, devices):
+            # one stage's worth (or an otherwise-refused shape) survives:
+            # the whole trunk runs on every device, data-parallel only —
+            # the restage rule's floor (RESILIENCE.md "Tier 7")
+            return jax.make_mesh(
+                (len(devices), 1), ("data", "pipe"), devices=devices
             )
 
         super().__init__(
@@ -331,6 +554,7 @@ class ElasticPipelineTrainer(ElasticTrainer):
             detector=detector,
             min_nodes=min_nodes,
             clock=clock,
+            fallback_mesh_factory=dp_only_mesh,
         )
 
 
@@ -366,8 +590,11 @@ class ElasticLongContextTrainer(ElasticTrainer):
                 (n // sp, sp), ("data", "seq"), devices=devices
             )
 
-        def factory(mesh):
-            return LongContextTrainer(mesh, seq_len=seq_len, **trainer_kwargs)
+        def factory(mesh, compress=_INHERIT):
+            kw = dict(trainer_kwargs)
+            if compress is not _INHERIT:
+                kw["compress"] = compress
+            return LongContextTrainer(mesh, seq_len=seq_len, **kw)
 
         super().__init__(
             factory,
@@ -399,9 +626,17 @@ class ElasticDPTrainer(ElasticTrainer):
     ) -> None:
         example = np.asarray(example_input)
 
-        def factory(mesh):
+        def factory(mesh, compress=_INHERIT):
+            kw = dict(trainer_kwargs)
+            if compress is not _INHERIT:
+                kw["compress"] = compress
+                if not compress:
+                    # EF needs a lossy wire: a policy restore to full
+                    # fidelity rebuilds without the residual (there is
+                    # nothing withheld to carry)
+                    kw.pop("error_feedback", None)
             return DPTrainer(
-                model, mesh, example_input=example, **trainer_kwargs
+                model, mesh, example_input=example, **kw
             )
 
         super().__init__(

@@ -839,9 +839,10 @@ class FSDPLMTrainer:
             "opt_state": opt_state,
         }
 
-    def restore_checkpoint_state(self, state: dict) -> None:
-        # checkpoints carry FULL (unsharded) trunk leaves, so restore
-        # reshards for THIS mesh's geometry — any (dp, sp, tp) combination
+    def _reshard_trunk(self, container: dict) -> dict:
+        """FULL (unsharded) trunk leaves -> this mesh's 1/(dp·sp[·tp])
+        storage shards — the mesh-size-independent restore step, shared by
+        checkpoint restore and the flat-params deposit seam."""
         n = self.dp * self.sp
 
         def reshard_leaf(full, tp_dim):
@@ -850,19 +851,48 @@ class FSDPLMTrainer:
                 return _shard_leaf(full, n)
             return _shard_leaf_tp(full, n, self.tp, tp_dim)
 
-        def reshard_trunk(container):
-            out = dict(container)
-            out["trunk"] = jax.tree.map(
-                reshard_leaf, container["trunk"], self._trunk_tp_dims
-            )
-            return out
+        out = dict(container)
+        out["trunk"] = jax.tree.map(
+            reshard_leaf, container["trunk"], self._trunk_tp_dims
+        )
+        return out
 
+    def restore_checkpoint_state(self, state: dict) -> None:
+        # checkpoints carry FULL (unsharded) trunk leaves, so restore
+        # reshards for THIS mesh's geometry — any (dp, sp, tp) combination
         self.params = self._place(
-            reshard_trunk(state["params"]), self._param_specs
+            self._reshard_trunk(state["params"]), self._param_specs
         )
         opt_state = jax.tree.map(
-            lambda t: reshard_trunk(t) if self._is_params_container(t) else t,
+            lambda t: (
+                self._reshard_trunk(t) if self._is_params_container(t) else t
+            ),
             state["opt_state"],
             is_leaf=self._is_params_container,
         )
         self.opt_state = self._place(opt_state, self._opt_specs)
+
+    # -- weights as a flat buffer (binder deposit seam) ----------------------
+
+    def get_flat_params(self) -> np.ndarray:
+        from akka_allreduce_tpu.binder.api import flatten_pytree
+
+        return flatten_pytree(self.gathered_params())[0]
+
+    def set_flat_params(self, vec: np.ndarray) -> None:
+        """Inverse of :meth:`get_flat_params`: a flat vector of the FULL
+        (unsharded) params unflattens and re-shards 1/(dp·sp[·tp]) onto
+        the current mesh. Optimizer state is untouched (the
+        elastic-averaging pull adjusts weights only)."""
+        from jax.flatten_util import ravel_pytree
+
+        full = self.gathered_params()
+        flat, unravel = ravel_pytree(full)
+        if vec.shape != flat.shape:
+            raise ValueError(
+                f"expected flat params of shape {flat.shape}, got {vec.shape}"
+            )
+        new_full = unravel(jnp.asarray(vec, jnp.float32))
+        self.params = self._place(
+            self._reshard_trunk(new_full), self._param_specs
+        )

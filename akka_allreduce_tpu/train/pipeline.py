@@ -992,3 +992,32 @@ class PipelineLMTrainer:
         from akka_allreduce_tpu.binder.api import flatten_pytree
 
         return flatten_pytree(self.logical_params())[0]
+
+    def set_flat_params(self, vec: np.ndarray) -> None:
+        """Inverse of :meth:`get_flat_params` (the binder's deposit seam):
+        a flat LOGICAL-order vector unflattens into the params tree, the
+        trunk re-permutes into this schedule's device-storage order, and
+        the leaves re-place onto the current mesh. Optimizer state is
+        untouched — the elastic-averaging pull adjusts weights only,
+        exactly like ``DPTrainer.set_flat_params``."""
+        from jax.flatten_util import ravel_pytree
+
+        host = self.logical_params()
+        flat, unravel = ravel_pytree(host)
+        if vec.shape != flat.shape:
+            raise ValueError(
+                f"expected flat params of shape {flat.shape}, got {vec.shape}"
+            )
+        logical = unravel(jnp.asarray(vec, jnp.float32))
+        stored = self._map_trunk_order(
+            jax.tree.map(np.asarray, logical), self._layer_perm
+        )
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        self.params = jax.device_put(
+            stored,
+            jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._param_specs,
+                is_leaf=is_spec,
+            ),
+        )

@@ -1,0 +1,258 @@
+"""The trainer zoo for workload-resilience drills (RESILIENCE.md "Tier 7").
+
+One registry of the real trainer families the ``chaos-train`` drill (and
+its tier-1 tests) can put through the elastic cycle: for each family a
+mesh-size-independent :class:`~akka_allreduce_tpu.train.elastic.ElasticTrainer`
+factory whose inner trainer factory takes a ``compress`` kwarg — so the
+SAME wrapper rides both halves of tier 7:
+
+- **membership re-meshes** (snapshot -> rebuild over the live devices ->
+  restore) driven by the TCP cluster's failure detector, and
+- **compress-follows-policy** rebuilds driven by the leader's
+  :class:`~akka_allreduce_tpu.protocol.RoundPolicy` wire stamp
+  (``ElasticTrainer.apply_policy_wire``).
+
+Shapes are drill-sized (tiny models, loopback CPU meshes): the point is
+the RESILIENCE machinery over the real step functions, not throughput —
+BENCHMARKS.md owns the flagship shapes.
+
+Family notes:
+
+- ``dp``: the config-5 workhorse (MLP + DPTrainer). Error feedback rides
+  every compressed mode, so a policy ladder walk exercises the residual
+  carry across factory rebuilds.
+- ``zero1``: sharded optimizer state (momentum) through the
+  mesh-size-independent checkpoint protocol. Its reduce-scatter has no
+  int8 ring, so an ``int8`` stamp degrades to the deepest mode the family
+  has (``bf16``) instead of refusing — degrade, not wedge.
+- ``fsdp``: params AND moments sharded 1/n; restage = re-shard.
+- ``pipeline``: the hard case — the trunk restages L/S' layers per stage
+  over the surviving ``pipe`` axis (gcd rule), falling back to a DP-only
+  mesh when only one stage's worth of devices survives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping, Sequence
+
+__all__ = [
+    "FAMILIES",
+    "batch_for",
+    "devices_per_node",
+    "family_param_count",
+    "make_elastic",
+]
+
+FAMILIES = ("dp", "zero1", "fsdp", "pipeline")
+
+#: virtual devices each cluster node contributes to the local mesh —
+#: pipeline gets 2 so a node loss RESTAGES (8 devs / 4 stages -> 6 devs /
+#: 2 stages) instead of only shrinking dp
+_DEVICES_PER_NODE = {"dp": 1, "zero1": 1, "fsdp": 1, "pipeline": 2}
+
+_PIPE_LAYERS = 4
+_PIPE_MICRO = 2
+_SEQ_LEN = 32
+_VOCAB = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class _Family:
+    make: Callable  # (devices_by_node, seed, clock, min_nodes) -> elastic
+    rows: Callable  # live trainer -> global batch rows (re-mesh aware)
+    dataset: Callable  # () -> dataset with .batches(rows, steps, seed_offset)
+
+
+def devices_per_node(family: str) -> int:
+    _require(family)
+    return _DEVICES_PER_NODE[family]
+
+
+def _require(family: str) -> None:
+    if family not in FAMILIES:
+        raise ValueError(f"family must be one of {FAMILIES}, got {family!r}")
+
+
+def _mnist():
+    from akka_allreduce_tpu.models import data
+
+    return data.mnist_like()
+
+
+def _lm():
+    from akka_allreduce_tpu.models import data
+
+    return data.lm_copy_task(_SEQ_LEN, vocab=_VOCAB)
+
+
+def _make_dp(devices_by_node, seed, clock, min_nodes):
+    import numpy as np
+
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.train.elastic import ElasticTrainer
+    from akka_allreduce_tpu.train.trainer import DPTrainer
+
+    model = MLP(hidden=(16,), classes=10)
+    ex = np.zeros((1, 28, 28, 1), np.float32)
+
+    def factory(mesh, compress=None):
+        return DPTrainer(
+            model,
+            mesh,
+            example_input=ex,
+            learning_rate=0.1,
+            seed=seed,
+            compress=compress,
+            # the residual carry is the family's EF story: active under
+            # every lossy mode, rebuilt across level changes via Snapshot
+            error_feedback=compress is not None,
+        )
+
+    return ElasticTrainer(
+        factory, devices_by_node, min_nodes=min_nodes, clock=clock
+    )
+
+
+def _make_zero1(devices_by_node, seed, clock, min_nodes):
+    import numpy as np
+    import optax
+
+    from akka_allreduce_tpu.models import MLP
+    from akka_allreduce_tpu.train.elastic import ElasticTrainer
+    from akka_allreduce_tpu.train.zero1 import Zero1DPTrainer
+
+    model = MLP(hidden=(16,), classes=10)
+    ex = np.zeros((1, 28, 28, 1), np.float32)
+
+    def factory(mesh, compress=None):
+        return Zero1DPTrainer(
+            model,
+            mesh,
+            example_input=ex,
+            # momentum makes the sharded moments REAL state: a re-mesh
+            # that dropped them would visibly bend the loss curve
+            optimizer=optax.sgd(0.1, momentum=0.9),
+            seed=seed,
+            compress=compress,
+            error_feedback=compress is not None,
+        )
+
+    e = ElasticTrainer(
+        factory, devices_by_node, min_nodes=min_nodes, clock=clock
+    )
+    # ZeRO-1's reduce-scatter has no int8 ring: the deepest stamp degrades
+    # to bf16 — the family's floor — instead of refusing. The clamp lives
+    # on the WRAPPER so an int8 stamp arriving while already at bf16 is a
+    # recognized no-op, not a full factory rebuild of the same trainer.
+    e.clamp_compress = lambda mode: "bf16" if mode else None
+    return e
+
+
+def _make_fsdp(devices_by_node, seed, clock, min_nodes):
+    import optax
+
+    from akka_allreduce_tpu.train.elastic import ElasticTrainer
+    from akka_allreduce_tpu.train.fsdp import FSDPLMTrainer
+
+    def factory(mesh, compress=None):
+        return FSDPLMTrainer(
+            mesh,
+            vocab=_VOCAB,
+            d_model=32,
+            n_heads=4,
+            n_layers=2,
+            seq_len=_SEQ_LEN,
+            optimizer=optax.adam(1e-2),
+            seed=seed,
+            compress=compress,
+        )
+
+    return ElasticTrainer(
+        factory, devices_by_node, min_nodes=min_nodes, clock=clock
+    )
+
+
+def _make_pipeline(devices_by_node, seed, clock, min_nodes):
+    from akka_allreduce_tpu.train.elastic import ElasticPipelineTrainer
+
+    return ElasticPipelineTrainer(
+        devices_by_node,
+        n_layers=_PIPE_LAYERS,
+        microbatches=_PIPE_MICRO,
+        vocab=_VOCAB,
+        d_model=32,
+        n_heads=2,
+        seq_len=_SEQ_LEN,
+        learning_rate=1e-2,
+        seed=seed,
+        # hand-scheduled 1F1B: grouped collectives, so bf16/int8 policy
+        # rebuilds exercise the compressed epilogue
+        schedule="1f1b",
+        min_nodes=min_nodes,
+        clock=clock,
+    )
+
+
+_REGISTRY: dict[str, _Family] = {
+    "dp": _Family(
+        make=_make_dp,
+        rows=lambda t: 4 * t.n_devices,
+        dataset=_mnist,
+    ),
+    "zero1": _Family(
+        make=_make_zero1,
+        rows=lambda t: 4 * t.n_devices,
+        dataset=_mnist,
+    ),
+    "fsdp": _Family(
+        make=_make_fsdp,
+        rows=lambda t: 2 * t.n_devices,
+        dataset=_lm,
+    ),
+    "pipeline": _Family(
+        make=_make_pipeline,
+        rows=lambda t: t.trainer.dp * _PIPE_MICRO,
+        dataset=_lm,
+    ),
+}
+
+
+def make_elastic(
+    family: str,
+    devices_by_node: Mapping[int, Sequence],
+    *,
+    seed: int = 0,
+    clock=None,
+    min_nodes: int = 1,
+):
+    """Build the family's ElasticTrainer over ``devices_by_node``."""
+    import time
+
+    _require(family)
+    return _REGISTRY[family].make(
+        devices_by_node, seed, clock or time.monotonic, min_nodes
+    )
+
+
+def dataset_for(family: str):
+    _require(family)
+    return _REGISTRY[family].dataset()
+
+
+def batch_for(family: str, dataset, elastic, seed_offset: int):
+    """One global batch sized for the LIVE trainer (re-mesh aware: the
+    row count follows the current dp extent)."""
+    _require(family)
+    rows = _REGISTRY[family].rows(elastic)
+    return next(iter(dataset.batches(rows, 1, seed_offset=seed_offset)))
+
+
+def family_param_count(family: str) -> int:
+    """The family model's (mesh-independent) parameter count — what sizes
+    the cluster's ``data_size``. Built on a single device; cheap."""
+    import jax
+
+    _require(family)
+    e = make_elastic(family, {0: [jax.devices()[0]]})
+    return int(e.trainer.param_count)

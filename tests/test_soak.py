@@ -144,6 +144,9 @@ def test_composed_trainer_soak(tmp_path):
     )
     kinds = [e["kind"] for e in report.remesh_events]
     assert kinds == ["drop", "rejoin"], report.remesh_events
+    # both re-meshes came out of the phi detector — the forced counter
+    # (scripted leader_failover) stays 0 on this scripted-drop run
+    assert (report.remeshes_forced, report.remeshes_detected) == (0, 2)
     assert report.generation == 2
     assert report.restore is not None
     assert report.restore["restored_step"] <= 30
@@ -156,3 +159,25 @@ def test_composed_trainer_soak(tmp_path):
     lines = (tmp_path / "soak.jsonl").read_text().strip().splitlines()
     assert len(lines) == 36 + 1
     assert "summary" in json.loads(lines[-1])
+
+
+def test_soak_remesh_split_forced_vs_detected():
+    """`soak --chaos`'s scripted leader_failover re-mesh counts as FORCED,
+    detector churn as DETECTED (ISSUE 14 satellite) — run in its own
+    interpreter with the _jax_compat shims opted in (the scenario needs a
+    real FSDP mesh; the tier-1 interpreter must not import the shims)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "elastic_zoo_worker.py")
+    proc = subprocess.run(
+        [sys.executable, worker, "soak_forced_split"],
+        cwd=root, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}"
+    )
+    assert "OK soak_forced_split" in proc.stdout
