@@ -3,7 +3,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip chaos-train
+.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip chaos-scale chaos-train
 
 # arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
 # (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
@@ -96,6 +96,20 @@ chaos-gossip:
 	JAX_PLATFORMS=cpu timeout -k 15 420 $(PYTHON) -m akka_allreduce_tpu \
 	  chaos-gossip --seed 1234 --streams 2 \
 	  --uring --intra-chunk 1048576 --congestion --out-dir chaos_gossip_run
+
+# fixed-seed pod-scale control-plane drill (RESILIENCE.md "Scale"): the
+# largest real-process grid this box runs — a 2x8 pod (16 nodes, ids
+# anchored to grid coordinates via --grid/--process-index) sharded into
+# 4 free-running LineMasters, plus a leader and a warm standby — through
+# a one-way partition (zero re-shards), a leader SIGKILL (epoch-2
+# takeover rebuilding the SAME shard layout, every shard resuming its
+# own sequence), and a node SIGKILL (only its coordinate-anchored shard
+# shrinks). The summary JSON also records the deterministic Fabric's
+# sim rate (the 256..1024-node sims' cost evidence). Exit 0/1.
+chaos-scale:
+	JAX_PLATFORMS=cpu timeout -k 15 480 $(PYTHON) -m akka_allreduce_tpu \
+	  chaos-scale --seed 1234 --grid 2x8 --line-shards 4 --streams 2 \
+	  --uring --intra-chunk 1048576 --congestion --out-dir chaos_scale_run
 
 # fixed-seed workload-resilience drill (RESILIENCE.md "Tier 7"): a real
 # 4-node cluster where every node drives an ElasticTrainer-wrapped REAL

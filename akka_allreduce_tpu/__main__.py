@@ -1092,6 +1092,13 @@ def _cmd_cluster_master(argv: list[str]) -> int:
         "up to N LineMasters, each owning (and reducing within) a "
         "contiguous worker subset (RESILIENCE.md 'Tier 6')",
     )
+    p.add_argument(
+        "--grid", default="", metavar="RxC",
+        help="pod-grid coordinate bootstrap (RESILIENCE.md 'Scale'): "
+        "anchor node ids to an RxC layout (nodes derive theirs from "
+        "--process-index / the pod env), so shard membership and dims-2 "
+        "row/column lines follow the pod layout instead of join order",
+    )
     p.add_argument("--metrics-out", default=None, help="per-round JSONL path")
     p.add_argument(
         "--round-deadline", type=float, default=0.0,
@@ -1144,6 +1151,11 @@ def _run_cluster_master(args) -> int:
         from akka_allreduce_tpu.control.chaos import parse_spec
 
         parse_spec(chaos_spec)
+    grid_rows = grid_cols = 0
+    if getattr(args, "grid", ""):
+        from akka_allreduce_tpu.control.pod import parse_grid
+
+        grid_rows, grid_cols = parse_grid(args.grid)
     cfg = AllreduceConfig(
         threshold=ThresholdConfig(args.th, args.th, args.th),
         metadata=MetaDataConfig(
@@ -1158,6 +1170,8 @@ def _run_cluster_master(args) -> int:
             node_num=args.nodes,
             dimensions=args.dims,
             line_shards=getattr(args, "line_shards", 1),
+            grid_rows=grid_rows,
+            grid_cols=grid_cols,
             heartbeat_interval_s=args.heartbeat,
             round_deadline_s=getattr(args, "round_deadline", 0.0),
             retry=RetryPolicy(
@@ -1252,6 +1266,19 @@ def _cmd_cluster_node(argv: list[str]) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--node-id", type=int, default=-1, help="-1 = master assigns")
+    p.add_argument(
+        "--grid", default="", metavar="RxC",
+        help="pod-grid coordinate bootstrap (RESILIENCE.md 'Scale'): "
+        "derive this node's id from its process index, row-major over "
+        "the RxC layout (SNIPPETS.md [2]'s multi-controller pattern — "
+        "process_index/local_devices as grid coordinates), so shard "
+        "membership follows the pod layout instead of join order",
+    )
+    p.add_argument(
+        "--process-index", type=int, default=-1,
+        help="this process's pod index for --grid (-1 = resolve from "
+        "AKKA_PROCESS_INDEX & friends, then a live jax.distributed)",
+    )
     p.add_argument("--data-seed", type=int, default=None, help="payload RNG seed")
     p.add_argument(
         "--metrics-out", default=None,
@@ -1292,6 +1319,28 @@ def _cmd_cluster_node(argv: list[str]) -> int:
     _add_obs_flags(p)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
+    if args.grid:
+        # grid-coordinate bootstrap: the node id IS the pod coordinate
+        # (row-major), never the join order — which is what anchors
+        # shard membership to the layout (control/pod.py)
+        from akka_allreduce_tpu.control import pod as _pod
+
+        rows, cols = _pod.parse_grid(args.grid)
+        idx = _pod.resolve_process_index(
+            args.process_index if args.process_index >= 0 else None
+        )
+        row, col = _pod.grid_coords(idx, rows, cols)
+        if args.node_id >= 0 and args.node_id != idx:
+            p.error(
+                f"--node-id {args.node_id} contradicts the grid "
+                f"coordinate {idx} ({row},{col}); drop one of them"
+            )
+        args.node_id = idx
+        print(
+            f"pod grid {rows}x{cols}: process {idx} -> coords "
+            f"({row},{col}), node id {idx}",
+            flush=True,
+        )
     _install_obs(args)
 
     import asyncio
@@ -2604,6 +2653,17 @@ def _drill_spawn(env):
     return spawn
 
 
+def _drill_pump(proc, into: list):
+    """Drain a drill subprocess's stdout into ``into`` from a daemon
+    thread (shared by the drills that watch for marker lines — TAKEOVER,
+    RESTORE — while the process keeps running)."""
+    import threading
+
+    t = threading.Thread(target=lambda: into.extend(proc.stdout), daemon=True)
+    t.start()
+    return t
+
+
 def _add_drill_gossip_flags(p: argparse.ArgumentParser) -> None:
     """Every chaos drill can run its cluster under SWIM gossip membership
     instead of hub heartbeats (the Makefile pins --gossip on all of them,
@@ -3504,7 +3564,6 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
     import shutil
     import signal as _signal
     import subprocess
-    import threading
 
     from akka_allreduce_tpu.control.chaos import CRASH_EXIT_CODE
 
@@ -3598,11 +3657,7 @@ def _cmd_chaos_recover(argv: list[str]) -> int:
         # while the cluster keeps running
         if not failures:
             reborn = spawn_node(seed_ep, victim)
-            pump = threading.Thread(
-                target=lambda: reborn_lines.extend(reborn.stdout),
-                daemon=True,
-            )
-            pump.start()
+            pump = _drill_pump(reborn, reborn_lines)
             await_phase(
                 lambda: any(
                     ln.startswith("RESTORE ") for ln in list(reborn_lines)
@@ -3921,6 +3976,336 @@ def _cmd_chaos_gossip(argv: list[str]) -> int:
     return 0 if not failures else 1
 
 
+def _cmd_chaos_scale(argv: list[str]) -> int:
+    """Pod-scale control-plane drill (RESILIENCE.md "Scale",
+    ``make chaos-scale``): the largest real-process grid the box allows —
+    a leader + warm standby + an RxC pod of nodes bootstrapped from GRID
+    COORDINATES (``--grid``/``--process-index``, node id = coordinate)
+    and sharded into ``--line-shards`` free-running LineMasters — runs a
+    partition + leader kill + node kill sequence:
+
+    - phase 1: EVERY shard completes rounds at its full membership
+      (per-shard round records under distinct line ids);
+    - phase 2: a seeded ONE-WAY partition cuts one node's master-bound
+      sends; gossip's indirect path must keep it in — zero re-shards;
+    - phase 3: the leader is SIGKILLed; the warm standby takes over
+      (epoch >= 2) and — because shard assignment is a pure function of
+      the view — rebuilds the SAME shard layout, every shard resuming
+      its own sequence;
+    - phase 4: a node is SIGKILLed; its coordinate-anchored shard
+      shrinks by exactly one while every other shard keeps its size and
+      rounds keep completing;
+    - phase 5: graceful SIGTERM end; node exits clean.
+
+    The summary JSON also records the deterministic Fabric's measured
+    sim rate on this box (nodes/sec — the 256..1024-node sim arms'
+    cost evidence, tests/test_gossip_scale.py).
+    """
+    p = argparse.ArgumentParser(
+        "chaos-scale",
+        description="grid-coordinate pod bootstrap + hierarchical shard "
+        "drill: partition, leader kill, node kill — per-shard rounds "
+        "must survive all three",
+    )
+    p.add_argument("--seed", type=int, default=1234, help="chaos seed")
+    p.add_argument(
+        "--grid", default="2x8", metavar="RxC",
+        help="pod layout; every coordinate is spawned as a real process",
+    )
+    p.add_argument("--line-shards", type=int, default=4)
+    p.add_argument(
+        "--partition-at", type=float, default=6.0,
+        help="seconds (per-process clock) until the one-way partition",
+    )
+    p.add_argument(
+        "--partition-for", type=float, default=6.0,
+        help="how long the bad link stays down",
+    )
+    p.add_argument(
+        "--min-shard-rounds", type=int, default=5,
+        help="full-membership rounds required per shard per phase",
+    )
+    p.add_argument(
+        "--min-post-rounds", type=int, default=8,
+        help="post-node-kill rounds required in the shrunken shard",
+    )
+    p.add_argument("--phase-timeout", type=float, default=240.0)
+    p.add_argument("--size", type=int, default=32768)
+    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--th", type=float, default=0.66)
+    p.add_argument("--heartbeat", type=float, default=0.1)
+    p.add_argument("--gossip-interval", type=float, default=0.25)
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="data-plane sockets per endpoint (distributed via Welcome)",
+    )
+    _add_drill_lever_flags(p)
+    p.add_argument("--out-dir", default="chaos_scale_run")
+    args = p.parse_args(argv)
+
+    import json
+    import os
+    import signal as _signal
+    import subprocess
+
+    from akka_allreduce_tpu.control import pod as _pod
+    from akka_allreduce_tpu.control.simfabric import sim_rate
+
+    try:
+        rows, cols = _pod.parse_grid(args.grid)
+    except ValueError as e:
+        p.error(str(e))
+    n_nodes = rows * cols
+    blocks = _pod.coordinate_shard_assignment(
+        range(n_nodes), rows, cols, args.line_shards
+    )
+    sizes = {lid: len(b) for lid, b in enumerate(blocks)}
+    if min(sizes.values()) < 3:
+        # th=0.66 must stay satisfiable inside the partitioned node's
+        # shard: ceil(0.66*size) <= size-1 needs size >= 3
+        p.error(
+            f"shard sizes {sorted(sizes.values())} too small for the "
+            "partition phase: need >= 3 nodes per shard (use a larger "
+            "--grid or fewer --line-shards)"
+        )
+    victim_link = blocks[0][-1]  # the bad-link node (stays healthy)
+    killed = n_nodes - 1  # the really-dead node (last shard shrinks)
+    killed_line = len(blocks) - 1
+    sizes_post_kill = dict(sizes)
+    sizes_post_kill[killed_line] -= 1
+    spec = (
+        f"partition:from={victim_link},to=m,"
+        f"at={args.partition_at:g}s,heal={args.partition_for:g}s"
+    )
+    os.makedirs(args.out_dir, exist_ok=True)
+    leader_metrics = os.path.join(args.out_dir, "rounds-leader.jsonl")
+    standby_metrics = os.path.join(args.out_dir, "rounds-standby.jsonl")
+    stale = [f for f in os.listdir(args.out_dir) if f.endswith(".jsonl")]
+    for f in stale:
+        os.remove(os.path.join(args.out_dir, f))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    spawn = _drill_spawn(env)
+    failures: list[str] = []
+    await_phase = _drill_phase_waiter(args.phase_timeout, failures)
+
+    def shard_rounds(path, expected: dict[int, int]) -> dict[int, int]:
+        """Per-line count of round records at the line's EXPECTED full
+        size (shard assignment is pure in the view, so line id -> size
+        is stable across reorganizations of the same membership)."""
+        per = {lid: 0 for lid in expected}
+        for rec in _drill_jsonl_records(path):
+            if rec.get("kind") != "round":
+                continue
+            lid = rec.get("line")
+            if lid in per and rec.get("workers") == expected[lid]:
+                per[lid] += 1
+        return per
+
+    def reshard_anomalies(path) -> int:
+        """Round records whose (line, size) does not match the full
+        layout — a healthy-node expulsion would show here first."""
+        return sum(
+            1
+            for rec in _drill_jsonl_records(path)
+            if rec.get("kind") == "round"
+            and rec.get("workers") != sizes.get(rec.get("line"))
+        )
+
+    leader = spawn(
+        "cluster-master", "--port", "0", "--nodes", str(n_nodes),
+        "--grid", args.grid, "--line-shards", str(args.line_shards),
+        "--rounds", "-1", "--size", str(args.size),
+        "--chunk", str(args.chunk), "--th", str(args.th),
+        "--heartbeat", str(args.heartbeat),
+        "--streams", str(args.streams),
+        *_drill_lever_args(args),
+        "--gossip", "--gossip-interval", str(args.gossip_interval),
+        "--chaos-seed", str(args.seed), "--chaos-spec", spec,
+        "--chaos-log", os.path.join(args.out_dir, "chaos-leader.jsonl"),
+        "--metrics-out", leader_metrics,
+    )
+    standby = None
+    nodes: list = []
+    standby_lines: list[str] = []
+    takeover = None
+    standby_done = False
+    rounds_before_partition: dict[int, int] = {}
+    rounds_after_heal: dict[int, int] = {}
+    anomalies_pre_kill = None
+    node_exits: dict = {}
+    try:
+        seed_ep = None
+        for line in leader.stdout:
+            if line.startswith("master listening on "):
+                seed_ep = line.split()[-1]
+                break
+        if seed_ep is None:
+            raise RuntimeError("leader never reported its endpoint")
+        standby = spawn(
+            "cluster-standby", "--seed", seed_ep,
+            "--heartbeat", str(args.heartbeat),
+            "--metrics-out", standby_metrics,
+        )
+        standby_ep = None
+        for line in standby.stdout:
+            if line.startswith("standby listening on "):
+                standby_ep = line.split()[3]
+                break
+        if standby_ep is None:
+            raise RuntimeError("standby never reported its endpoint")
+        standby_pump = _drill_pump(standby, standby_lines)
+        t_spawn = time.monotonic()
+        for k in range(n_nodes):
+            nodes.append(
+                spawn(
+                    "cluster-node", "--seed", seed_ep,
+                    "--grid", args.grid, "--process-index", str(k),
+                    "--chaos-log",
+                    os.path.join(args.out_dir, f"chaos-node{k}.jsonl"),
+                )
+            )
+        # phase 1: EVERY shard completes rounds at full membership
+        await_phase(
+            lambda: min(
+                shard_rounds(leader_metrics, sizes).values()
+            )
+            >= args.min_shard_rounds,
+            "pre-partition full-membership rounds on every shard",
+        )
+        rounds_before_partition = shard_rounds(leader_metrics, sizes)
+        # phase 2: rounds keep accumulating per shard THROUGH the one-way
+        # partition (round-record gated, like chaos-gossip), and no
+        # re-shard happens (the indirect path keeps the victim in)
+        def _partition_progress() -> int:
+            per = shard_rounds(leader_metrics, sizes)  # ONE parse per poll
+            return min(
+                per[lid] - rounds_before_partition.get(lid, 0)
+                for lid in sizes
+            )
+
+        await_phase(
+            lambda: _partition_progress() >= args.min_shard_rounds,
+            "per-shard rounds continuing through the one-way partition",
+        )
+        window_end = (
+            t_spawn + args.partition_at + args.partition_for
+            + 8 * args.gossip_interval
+        )
+        while time.monotonic() < window_end:
+            time.sleep(0.2)
+        rounds_after_heal = shard_rounds(leader_metrics, sizes)
+        anomalies_pre_kill = reshard_anomalies(leader_metrics)
+        if anomalies_pre_kill:
+            failures.append(
+                f"{anomalies_pre_kill} off-layout round record(s) during "
+                "the partition window: a healthy node was expelled or a "
+                "shard re-split"
+            )
+        # phase 3: SIGKILL the LEADER; the warm standby must take over
+        # and rebuild the SAME shard layout from the replicated view
+        leader.send_signal(_signal.SIGKILL)
+        leader.wait()
+        await_phase(
+            lambda: any(
+                ln.startswith("TAKEOVER ") for ln in list(standby_lines)
+            ),
+            "the standby's TAKEOVER line",
+        )
+        for ln in list(standby_lines):
+            if ln.startswith("TAKEOVER "):
+                takeover = json.loads(ln[len("TAKEOVER "):])
+        await_phase(
+            lambda: min(
+                shard_rounds(standby_metrics, sizes).values()
+            )
+            >= args.min_shard_rounds,
+            "post-takeover rounds on every shard (same layout)",
+        )
+        # phase 4: SIGKILL a node — its coordinate-anchored shard shrinks
+        # by one, the other shards keep their sizes, rounds continue
+        nodes[killed].send_signal(_signal.SIGKILL)
+        nodes[killed].wait()
+
+        def _post_kill_progress() -> int:
+            per = shard_rounds(standby_metrics, sizes_post_kill)
+            return min(per[lid] for lid in sizes_post_kill)
+
+        await_phase(
+            lambda: _post_kill_progress() >= args.min_post_rounds,
+            "post-node-kill rounds (shrunken shard included)",
+        )
+        # phase 5: graceful end at the promoted master
+        standby.send_signal(_signal.SIGTERM)
+        try:
+            standby.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            failures.append("promoted standby did not shut down on SIGTERM")
+        standby_pump.join(timeout=10)
+        standby_done = any("master done" in ln for ln in standby_lines)
+        for k, n in enumerate(nodes):
+            if k == killed:
+                node_exits[k] = n.returncode
+                continue
+            try:
+                n.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                # a survivor that wedges in its shutdown path is exactly
+                # the defect class this drill exists to catch — record
+                # it, don't let the cleanup kill() read as a clean exit
+                n.kill()
+                n.wait()
+                failures.append(
+                    f"node {k} did not exit within 30s of the Shutdown "
+                    "broadcast (killed)"
+                )
+            node_exits[k] = n.returncode
+            if n.returncode not in (0, None):
+                failures.append(f"node {k} exited {n.returncode}")
+    finally:
+        for proc in [leader, standby, *nodes]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    if takeover is None:
+        failures.append("standby never took over")
+    elif takeover.get("epoch", 0) < 2:
+        failures.append(f"takeover did not bump the epoch: {takeover}")
+    if not standby_done:
+        failures.append("run did not finish cleanly")
+    summary = {
+        "seed": args.seed,
+        "grid": args.grid,
+        "line_shards": args.line_shards,
+        "shard_sizes": {str(k): v for k, v in sorted(sizes.items())},
+        "spec": spec,
+        "shard_rounds_pre_partition": {
+            str(k): v for k, v in sorted(rounds_before_partition.items())
+        },
+        "shard_rounds_post_heal": {
+            str(k): v for k, v in sorted(rounds_after_heal.items())
+        },
+        "reshard_anomalies_pre_kill": anomalies_pre_kill,
+        "takeover": takeover,
+        "shard_rounds_under_standby": {
+            str(k): v
+            for k, v in sorted(shard_rounds(standby_metrics, sizes).items())
+        },
+        "shard_rounds_post_kill": {
+            str(k): v
+            for k, v in sorted(
+                shard_rounds(standby_metrics, sizes_post_kill).items()
+            )
+        },
+        "node_exits": {str(k): v for k, v in sorted(node_exits.items())},
+        "standby_done": standby_done,
+        "sim": sim_rate(256, 5.0),
+        "failures": failures,
+    }
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def _cmd_chaos_failover(argv: list[str]) -> int:
     """Master-kill failover drill (RESILIENCE.md "Tier 4", ISSUE 7
     acceptance): a real leader + warm standby + N state-armed nodes run an
@@ -3981,7 +4366,6 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
     import shutil
     import signal as _signal
     import subprocess
-    import threading
 
     from akka_allreduce_tpu.control.chaos import CRASH_EXIT_CODE, parse_spec
 
@@ -4014,12 +4398,7 @@ def _cmd_chaos_failover(argv: list[str]) -> int:
             "--state-every", str(args.state_every),
         )
 
-    def pump(proc, into: list):
-        t = threading.Thread(
-            target=lambda: into.extend(proc.stdout), daemon=True
-        )
-        t.start()
-        return t
+    pump = _drill_pump
 
     def full_rounds(path) -> int:
         return _drill_full_rounds(path, args.nodes)
@@ -5199,6 +5578,7 @@ COMMANDS = {
     "chaos-failover": _cmd_chaos_failover,
     "chaos-adapt": _cmd_chaos_adapt,
     "chaos-gossip": _cmd_chaos_gossip,
+    "chaos-scale": _cmd_chaos_scale,
     "chaos-train": _cmd_chaos_train,
     "chaos-train-node": _cmd_chaos_train_node,
 }

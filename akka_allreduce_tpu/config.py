@@ -419,6 +419,15 @@ class MasterConfig:
     # lines reduce within their subset (the grid reorganizes shards from
     # the membership view on every change).
     line_shards: int = 1
+    # pod-grid coordinate bootstrap (control/pod.py, RESILIENCE.md
+    # "Scale"): a configured RxC layout anchors node ids to grid
+    # coordinates (row-major; nodes derive their preferred id from
+    # process_index via ``--grid``), so shard membership and dims-2
+    # row/column lines follow the POD LAYOUT instead of join order, and
+    # every reorganize re-derives them from the current view with fixed
+    # boundaries. 0/0 = no grid (the historical join-order behavior).
+    grid_rows: int = 0
+    grid_cols: int = 0
     heartbeat_interval_s: float = 1.0
     heartbeat_timeout_s: float = 5.0
     # stall watchdog (obs.watchdog): a line round in flight longer than this
@@ -444,6 +453,21 @@ class MasterConfig:
                 "line_shards applies to dimensions=1 only (2D grids are "
                 f"already sharded into row/column lines), got dims="
                 f"{self.dimensions}"
+            )
+        if (self.grid_rows > 0) != (self.grid_cols > 0):
+            raise ValueError(
+                "grid_rows/grid_cols must be set together (an RxC pod "
+                f"layout), got {self.grid_rows}/{self.grid_cols}"
+            )
+        if self.grid_rows < 0 or self.grid_cols < 0:
+            raise ValueError(
+                f"grid sides must be >= 0, got "
+                f"{self.grid_rows}/{self.grid_cols}"
+            )
+        if self.grid_rows > 0 and self.node_num > self.grid_rows * self.grid_cols:
+            raise ValueError(
+                f"node_num {self.node_num} exceeds the "
+                f"{self.grid_rows}x{self.grid_cols} grid"
             )
 
 

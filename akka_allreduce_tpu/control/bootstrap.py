@@ -694,6 +694,13 @@ class MasterProcess:
                 "standbys": [
                     [ep.host, ep.port] for ep in self.standby_eps
                 ],
+                # per-shard replication (RESILIENCE.md "Scale"): each
+                # live line's worker set and the per-worker resume
+                # floors change only on reorganization — every
+                # reorganization path invalidates this cache, so the
+                # static half stays truthful
+                "lines": self.grid.lines_static_state(),
+                "floors": self.grid.resume_floor_state(),
             }
             if self.gossip is not None:
                 # the ring's judgement rides failover too: a promoted
@@ -710,6 +717,11 @@ class MasterProcess:
                 (lm.next_round for lm in self.grid.line_masters.values()),
                 default=self.grid.resume_round,
             ),
+            # per-shard round counters, one per live line: a promoted
+            # standby resumes EVERY shard past its own sequence instead
+            # of snapping all of them to the global max (the shard-blind
+            # path the PR-10 sharding left behind)
+            "shards": self.grid.lines_round_state(),
             "completed": self.grid.total_completed,
             "config_id": self.grid.config_id,
         }
@@ -889,6 +901,20 @@ class MasterProcess:
         self.grid.resume_round = int(rnd["next"])
         self.grid.config_id = int(rnd["config_id"])
         self.grid._completed_before_reorg = int(rnd["completed"])
+        # per-shard resume: the replicated floors + each replicated
+        # line's live next round over its worker set — the takeover's
+        # first reorganization resumes every shard past ITS OWN
+        # high-water (a digest without the fields restores the legacy
+        # global-max behavior through resume_round above)
+        self.grid.restore_shard_state(
+            state.get("floors"), state.get("lines"), rnd.get("shards"),
+            fallback_round=int(rnd["next"]),
+            fallback_workers=[
+                nid * self.config.master.dimensions + d
+                for nid in live
+                for d in range(self.config.master.dimensions)
+            ],
+        )
         # seed the detector with the members we expect back: one that
         # never re-joins is expelled by the normal poll path
         for nid in sorted(live):

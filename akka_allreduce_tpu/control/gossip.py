@@ -85,6 +85,11 @@ _SUSPICIONS = _metrics.counter("gossip.suspicions")
 _CONFIRMS = _metrics.counter("gossip.confirmed_dead")
 _REFUTATIONS = _metrics.counter("gossip.refutations")
 _DIGEST_ENTRIES = _metrics.counter("gossip.digest_entries")
+# digest-budget pressure (RESILIENCE.md "Scale"): how often a digest had
+# MORE spreadable news than digest_max slots — the ~3·log2(n) spread
+# bound is an assumption until this stays ~0; at n=1024 under churn it
+# is the first thing to watch
+_DIGEST_TRUNCATIONS = _metrics.counter("gossip.digest_truncations")
 
 
 def gossip_addr(node_id: int) -> str:
@@ -224,6 +229,15 @@ class GossipState:
         )
         self.members: dict[int, _Member] = {}
         self._cycle: list[int] = []  # shuffled probe order (round-robin)
+        # incremental indexes over `members` — what keeps tick()/digest()
+        # O(changes) instead of O(membership) so the 256..1024-node sims
+        # (and a real pod's per-message hot path) stay allocation-light:
+        # ids currently SUSPECT (the only records the confirm scan needs)
+        # and ids with remaining piggyback budget (the only digest
+        # candidates). Both are maintained at every status/spread edge
+        # and lazily validated where staleness is harmless.
+        self._suspects: set[int] = set()
+        self._fresh: set[int] = set()
         self._seq = 0
         self._pending: dict[int, _Probe] = {}  # my probe seq -> probe
         # relay bookkeeping: my relay-ping seq -> (origin id, origin seq,
@@ -243,6 +257,7 @@ class GossipState:
         self.suspicions = 0
         self.confirms = 0
         self.refutations = 0
+        self.digest_truncations = 0
 
     # -- membership roster (master-book-driven) --------------------------------
 
@@ -252,11 +267,29 @@ class GossipState:
         Existing records keep their state (a roster refresh must not
         amnesty a suspect)."""
         ids = {int(n) for n in node_ids if int(n) != self.node_id}
-        for nid in ids - set(self.members):
+        fresh_ids = ids - set(self.members)
+        for nid in fresh_ids:
             self.members[nid] = _Member()
-        for nid in set(self.members) - ids:
+        if fresh_ids:
+            # a roster addition is NOT gossip news: the master already
+            # broadcast the book to everyone (membership is hub-
+            # authoritative), and an ALIVE-at-inc-0 entry outranks
+            # nothing anywhere. Starting these settled is also what
+            # keeps a 1024-member boot from spending O(N) digest sorts
+            # per message on un-news until every budget drains —
+            # liveness NEWS (suspicion, refutation, readmission via
+            # reset_member's incarnation bump) still spreads from a
+            # fresh budget.
+            limit = self._spread_limit()
+            for nid in fresh_ids:
+                self.members[nid].spread = limit
+        gone = set(self.members) - ids
+        for nid in gone:
             self.members.pop(nid, None)
-            self._cycle = [n for n in self._cycle if n != nid]
+            self._suspects.discard(nid)
+            self._fresh.discard(nid)
+        if gone:
+            self._cycle = [n for n in self._cycle if n not in gone]
 
     def reset_member(self, node_id: int, incarnation: int = 0) -> None:
         """A (re)admitted member: fresh ALIVE record at the given
@@ -265,9 +298,13 @@ class GossipState:
         if node_id == self.node_id:
             return
         self.members[node_id] = _Member(incarnation=incarnation)
+        self._suspects.discard(node_id)
+        self._fresh.add(node_id)
 
     def remove_member(self, node_id: int) -> None:
         self.members.pop(node_id, None)
+        self._suspects.discard(node_id)
+        self._fresh.discard(node_id)
         self._cycle = [n for n in self._cycle if n != node_id]
 
     # -- views -----------------------------------------------------------------
@@ -313,6 +350,16 @@ class GossipState:
             # inherited suspicions restart their timer at takeover: the
             # digest has no clock, and a fresh window errs alive-ward
             rec.suspect_at = None
+            (self._suspects.add if rec.status == SUSPECT
+             else self._suspects.discard)(nid)
+            # the inherited judgement is NEWS from this identity: the
+            # takeover path runs set_members() first, which marks every
+            # roster record settled (the boot rule) — without a fresh
+            # budget here the promoted master would never gossip WHO was
+            # suspect/dead mid-incident, and members that missed the
+            # rumor would re-learn it only by their own probe timeouts
+            rec.spread = 0
+            self._fresh.add(nid)
 
     # -- the probe loop --------------------------------------------------------
 
@@ -347,15 +394,19 @@ class GossipState:
             if now >= probe.deadline:
                 self._pending.pop(seq, None)
                 self._suspect(probe.target, now)
-        for nid in sorted(self.members):
-            rec = self.members[nid]
-            if (
-                rec.status == SUSPECT
-                and rec.suspect_at is not None
-                and now - rec.suspect_at
-                >= cfg.suspicion_periods * cfg.probe_interval_s
-            ):
-                self._confirm_dead(nid, rec, now)
+        if self._suspects:
+            # only the SUSPECT records can confirm — scanning the whole
+            # membership here was the sims' O(N) * N-nodes per tick wall
+            for nid in sorted(self._suspects):
+                rec = self.members.get(nid)
+                if (
+                    rec is not None
+                    and rec.status == SUSPECT
+                    and rec.suspect_at is not None
+                    and now - rec.suspect_at
+                    >= cfg.suspicion_periods * cfg.probe_interval_s
+                ):
+                    self._confirm_dead(nid, rec, now)
         if now >= self._next_probe_at:
             self._next_probe_at = now + cfg.probe_interval_s
             target = self._next_target()
@@ -377,19 +428,26 @@ class GossipState:
     def _next_target(self) -> int | None:
         """Shuffled round-robin over the probe-able membership (SWIM §4.3:
         randomized cycling bounds worst-case time-to-probe by one cycle,
-        where pure random sampling only bounds the expectation)."""
-        candidates = {
-            n for n, r in self.members.items() if r.status != DEAD
-        }
-        if not candidates:
-            return None
-        while self._cycle:
-            nid = self._cycle.pop()
-            if nid in candidates:
-                return nid
-        self._cycle = sorted(candidates)
-        self._rng.shuffle(self._cycle)
-        return self._cycle.pop()
+        where pure random sampling only bounds the expectation).
+
+        Candidacy is checked per POP (an O(1) status read), and the full
+        membership is only walked when the cycle runs dry — amortized
+        O(1) per probe, where rebuilding the candidate set per call was
+        an O(N) allocation that multiplied into the sims' N² wall."""
+        for _ in range(2):
+            while self._cycle:
+                nid = self._cycle.pop()
+                rec = self.members.get(nid)
+                if rec is not None and rec.status != DEAD:
+                    return nid
+            candidates = sorted(
+                n for n, r in self.members.items() if r.status != DEAD
+            )
+            if not candidates:
+                return None
+            self._cycle = candidates
+            self._rng.shuffle(self._cycle)
+        return None  # unreachable: a rebuilt non-empty cycle always pops
 
     def _ping_reqs(self, probe: _Probe, seq: int) -> list[Envelope]:
         """K indirect probes through other members — the vantage-point
@@ -490,20 +548,51 @@ class GossipState:
             # and clearing suspicion on it would let a dead rejoiner be
             # vouched alive by its own ghost forever
             return
+        # a bump PAST A KNOWN token is news; learning a member's first
+        # real incarnation (record still at the 0 placeholder — nothing
+        # was ever claimed) is not, or every boot would flood digests
+        # with N un-news entries per node
+        bumped = (
+            incarnation is not None and 0 < rec.incarnation < incarnation
+        )
         if incarnation is not None and incarnation > rec.incarnation:
             rec.incarnation = incarnation
         was_dead = rec.status == DEAD
         if rec.status != ALIVE:
             rec.status = ALIVE
             rec.suspect_at = None
-            # local-only amnesty: spent spread budget, nothing to gossip
-            rec.spread = self._spread_limit()
+            self._suspects.discard(sender)
+            if bumped:
+                # a STRICTLY higher incarnation heard first-hand is a
+                # fresh fact, not a rumor we must leave to its owner:
+                # ALIVE@inc outranks every lower-incarnation state by
+                # the absorb precedence, so spreading it is safe — and
+                # without the spread, a promoted master's (or any
+                # rejoiner's) revival reaches each member only by
+                # DIRECT contact: O(N) probe periods of re-mesh time,
+                # the 256-node sims' measured 145 s wall (bounded at
+                # ~3·log2(n) periods with it — pinned at scale)
+                rec.spread = 0
+                self._fresh.add(sender)
+            else:
+                # equal incarnation: local-only amnesty — we hold
+                # first-hand proof, but only the member itself may
+                # refute the cluster-wide rumor (SWIM's ordering rule),
+                # so nothing is spread
+                self._fresh.discard(sender)
+                rec.spread = self._spread_limit()
             if was_dead:
                 # first-hand proof trumps a rumor we already acted on:
                 # surface the revival so the subscriber can re-admit
                 self.events.append(
                     GossipEvent(sender, ALIVE, rec.incarnation, now)
                 )
+        elif bumped:
+            # already alive, but the incarnation moved (a refutation or
+            # readmission we witnessed first-hand): the new token is
+            # news — spread it so stale lower-inc rumors die everywhere
+            rec.spread = 0
+            self._fresh.add(sender)
 
     def _suspect(self, node_id: int, now: float) -> None:
         rec = self.members.get(node_id)
@@ -512,6 +601,8 @@ class GossipState:
         rec.status = SUSPECT
         rec.suspect_at = now
         rec.spread = 0  # news: spend a fresh piggyback budget on it
+        self._suspects.add(node_id)
+        self._fresh.add(node_id)
         self.suspicions += 1
         _SUSPICIONS.inc()
         _flight.note(
@@ -524,6 +615,8 @@ class GossipState:
         rec.status = DEAD
         rec.suspect_at = None
         rec.spread = 0
+        self._suspects.discard(node_id)
+        self._fresh.add(node_id)
         self.confirms += 1
         _CONFIRMS.inc()
         _flight.note(
@@ -572,6 +665,9 @@ class GossipState:
             rec.incarnation = inc
             rec.status = status
             rec.spread = 0  # fresh news spreads onward from here
+            self._fresh.add(nid)
+            (self._suspects.add if status == SUSPECT
+             else self._suspects.discard)(nid)
             if status == SUSPECT:
                 if prev != SUSPECT:
                     # start OUR OWN suspicion clock: every process confirms
@@ -597,22 +693,42 @@ class GossipState:
     def _digest(self) -> tuple[DigestEntry, ...]:
         """Bounded membership digest: our own refutation first (when one
         is in flight), then the entries with the most remaining spread
-        budget — fresh news travels, settled state stays off the wire."""
-        limit = self._spread_limit()
+        budget — fresh news travels, settled state stays off the wire.
+
+        Only the ``_fresh`` index is walked (lazily pruned of entries
+        whose budget was spent through another path): in steady state it
+        is EMPTY, so the per-message cost is O(1), not O(membership).
+        News that did not fit the ``digest_max`` slots counts a
+        truncation — the observable form of digest-budget pressure the
+        ~3·log2(n) spread bound silently assumed away (OBSERVABILITY.md
+        ``gossip.digest_truncations``)."""
         out: list[DigestEntry] = []
         if self._refute_spread > 0:
             self._refute_spread -= 1
             out.append((self.node_id, self.incarnation, ALIVE))
-        fresh = [
-            (rec.spread, nid)
-            for nid, rec in self.members.items()
-            if rec.spread < limit
-        ]
-        fresh.sort()
-        for _, nid in fresh[: self.config.digest_max - len(out)]:
-            rec = self.members[nid]
-            rec.spread += 1
-            out.append((nid, rec.incarnation, rec.status))
+        if self._fresh:
+            limit = self._spread_limit()
+            fresh: list[tuple[int, int]] = []
+            stale: list[int] = []
+            for nid in self._fresh:
+                rec = self.members.get(nid)
+                if rec is None or rec.spread >= limit:
+                    stale.append(nid)
+                else:
+                    fresh.append((rec.spread, nid))
+            for nid in stale:
+                self._fresh.discard(nid)
+            fresh.sort()
+            budget = max(0, self.config.digest_max - len(out))
+            for _, nid in fresh[:budget]:
+                rec = self.members[nid]
+                rec.spread += 1
+                if rec.spread >= limit:
+                    self._fresh.discard(nid)
+                out.append((nid, rec.incarnation, rec.status))
+            if len(fresh) > budget:
+                self.digest_truncations += 1
+                _DIGEST_TRUNCATIONS.inc()
         if out:
             _DIGEST_ENTRIES.inc(len(out))
         return tuple(out)

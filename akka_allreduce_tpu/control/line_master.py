@@ -67,6 +67,13 @@ class LineMaster:
         # stamped onto every Prepare/Start so nodes can fence a zombie
         # master's round triggers after a failover (-1 = unfenced)
         self.epoch = epoch
+        # cross-shard barrier (RESILIENCE.md "Scale"): when set, round r
+        # may only START once gate(r) answers True. The ONLY user is the
+        # butterfly's dims-2 exchange — the grid master gates each
+        # column line on every row line having completed the round, so a
+        # column Start never outruns the chain data it consumes; dims-1
+        # shards carry no gate and free-run their own sequences.
+        self.start_gate: Callable[[int], bool] | None = None
         # the CURRENT RoundPolicy (control/adapt.py): stamped onto each
         # round's StartAllreduce AT START — the per-round record below is
         # what re-Starts re-send, so a re-issued Start can never disagree
@@ -377,6 +384,14 @@ class LineMaster:
 
     # -- round window --------------------------------------------------------
 
+    def refill(self) -> list[Envelope]:
+        """Re-check the window after an EXTERNAL event opened a start
+        gate (a row line completing the round a column line waits on) —
+        a no-op while the Prepare handshake is still in flight."""
+        if self._preparing:
+            return []
+        return self._fill_window()
+
     def _fill_window(self) -> list[Envelope]:
         out: list[Envelope] = []
         while len(self.started_rounds) < self.config.round_window:
@@ -387,6 +402,13 @@ class LineMaster:
                 + len(self.started_rounds)
                 >= self.config.max_rounds
             ):
+                break
+            if self.start_gate is not None and not self.start_gate(
+                self.next_round
+            ):
+                # gated: the window stops filling HERE (rounds start in
+                # order); the grid master refill()s us when the gate's
+                # upstream round completes
                 break
             r = self.next_round
             self.next_round += 1

@@ -1,0 +1,51 @@
+"""The pod-scale drill as a tier-1 test (RESILIENCE.md "Scale").
+
+``make chaos-scale`` runs the 2x8/4-shard variant; this runs the same
+sequence — grid-coordinate bootstrap, per-shard rounds, one-way
+partition, leader SIGKILL + standby takeover, node SIGKILL — at the
+2x3/2-shard scale a loaded CI box absorbs (8 real processes), with the
+same fixed seed. The deterministic 256..1024-node halves of the story
+live in tests/test_gossip_scale.py; this is the real-OS-process half.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_chaos_scale_drill_subprocess(tmp_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "akka_allreduce_tpu", "chaos-scale",
+            "--seed", "1234", "--grid", "2x3", "--line-shards", "2",
+            "--min-post-rounds", "5", "--phase-timeout", "180",
+            "--out-dir", str(tmp_path / "run"),
+        ],
+        cwd=root, env=env, capture_output=True, text=True, timeout=420,
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, proc.stderr[-2000:]
+    summary = json.loads(lines[-1])
+    assert proc.returncode == 0, summary
+    assert summary["failures"] == [], summary
+    # the coordinate layout: two shards of three, boundaries fixed
+    assert summary["shard_sizes"] == {"0": 3, "1": 3}
+    # the one-way partition expelled nobody and re-split nothing
+    assert summary["reshard_anomalies_pre_kill"] == 0
+    # the leader kill promoted the standby under a bumped epoch...
+    assert summary["takeover"]["epoch"] >= 2
+    # ...which rebuilt the SAME shard layout (rounds on both line ids)
+    assert all(
+        v > 0 for v in summary["shard_rounds_under_standby"].values()
+    ), summary["shard_rounds_under_standby"]
+    # the node kill shrank ONLY the last shard, and it kept completing
+    assert summary["shard_rounds_post_kill"]["1"] >= 5
+    assert summary["standby_done"] is True
+    # the sim-rate evidence rides the summary (the 256-node Fabric)
+    assert summary["sim"]["nodes"] == 256
+    assert summary["sim"]["node_ticks_per_s"] > 0

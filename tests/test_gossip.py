@@ -69,67 +69,11 @@ def make_config(**kw) -> GossipConfig:
     return GossipConfig(**base)
 
 
-class Fabric:
-    """Synchronous message fabric driving N member state machines on a
-    logical clock, with an optional per-role chaos injector compiled from
-    the REAL spec grammar (each role gets its own injector, exactly like
-    each OS process does over TCP)."""
-
-    def __init__(
-        self,
-        n_nodes: int,
-        *,
-        config: GossipConfig | None = None,
-        chaos_spec: str = "",
-        chaos_seed: int = 99,
-    ) -> None:
-        self.now = 0.0
-        cfg = config or make_config()
-        self.states: dict[int, GossipState] = {
-            MASTER_ID: GossipState(MASTER_ID, 1, cfg)
-        }
-        for i in range(n_nodes):
-            # distinct incarnations, like distinct processes
-            self.states[i] = GossipState(i, 1000 + i, cfg)
-        roster = set(self.states)
-        for st in self.states.values():
-            st.set_members(roster)  # set_members drops the self id
-        self.dead: set[int] = set()  # roles whose process is gone
-        self.injectors: dict[int, ChaosInjector] = {}
-        if chaos_spec:
-            for role in self.states:
-                self.injectors[role] = ChaosInjector(
-                    chaos_seed, chaos_spec, role=role,
-                    clock=lambda: self.now, t0=0.0,
-                )
-
-    def deliver(self, sender: int, envelopes: list[Envelope]) -> None:
-        for env in envelopes:
-            inj = self.injectors.get(sender)
-            if inj is not None:
-                act = inj.plan_send(env)
-                if act is not None and (act.drop or act.fail):
-                    continue  # the fabric's only mechanics: loss
-            target = int(env.dest.rpartition(":")[2])
-            st = self.states.get(target)
-            if st is None or target in self.dead:
-                continue
-            self.deliver(target, st.handle(env.msg, self.now))
-
-    def step(self, dt: float = 0.1) -> None:
-        self.now += dt
-        for role in sorted(self.states):
-            if role in self.dead:
-                continue
-            self.deliver(role, self.states[role].tick(self.now))
-
-    def run(self, seconds: float, dt: float = 0.1) -> None:
-        for _ in range(int(seconds / dt)):
-            self.step(dt)
-
-    @property
-    def master(self) -> GossipState:
-        return self.states[MASTER_ID]
+# the Fabric lives in the package now (control/simfabric.py) so the
+# chaos-scale drill and the 256..1024-node suite (test_gossip_scale.py)
+# share one definition; its default GossipConfig == make_config(). The
+# 64-node acceptance sims below keep exercising it at the original scale.
+from akka_allreduce_tpu.control.simfabric import Fabric  # noqa: E402
 
 
 # --- the acceptance sims ------------------------------------------------------
@@ -402,11 +346,29 @@ def test_digest_is_bounded_and_spread_budgeted():
     cfg = make_config(digest_max=5)
     st = GossipState(0, 100, cfg)
     st.set_members(range(1, 40))
-    # 39 members x ~3·log2(40) spread budget, 5 entries per digest
-    for _ in range(200):
+    # a master-distributed roster is NOT news: nothing to gossip at boot
+    assert st._digest() == ()
+    # 39 members' worth of NEWS (readmissions bump every record fresh):
+    # ~3·log2(40) spread budget each, 5 entries per digest
+    for nid in range(1, 40):
+        st.reset_member(nid, nid)
+    for _ in range(400):
         assert len(st._digest()) <= 5
     # every entry's budget is eventually spent: steady state = empty digest
     assert st._digest() == ()
+    # and the overflow was counted: far more news than digest slots
+    assert st.digest_truncations > 0
+
+
+def test_settled_roster_still_spreads_liveness_news():
+    """The boot optimization must not eat real news: a suspicion (or a
+    reset_member readmission) on a settled roster spreads immediately."""
+    st = GossipState(0, 100, make_config())
+    st.set_members({1, 2, 3})
+    assert st._digest() == ()
+    st._absorb(((2, 5, SUSPECT),), 1.0)
+    digest = st._digest()
+    assert (2, 5, SUSPECT) in digest
 
 
 def test_roster_is_master_authoritative():
@@ -441,6 +403,13 @@ def test_digest_state_roundtrips_through_restore():
     # inherited suspicions restart their clock at takeover (no instant
     # confirm from a clockless digest)
     assert st2.members[1].suspect_at is None
+    # and the inherited judgement SPREADS from the promoted identity:
+    # set_members marks roster records settled (the boot rule), so the
+    # restore must re-arm their budgets or the ring never hears WHO was
+    # suspect/dead mid-incident (regression: a settled restore was
+    # silent — members re-learned only via their own probe timeouts)
+    digest = st2._digest()
+    assert (1, 7, SUSPECT) in digest and (2, 9, DEAD) in digest
 
 
 # --- negotiate-down pins (both directions) ------------------------------------

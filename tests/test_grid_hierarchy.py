@@ -1,0 +1,445 @@
+"""Hierarchical control plane + pod bootstrap (RESILIENCE.md "Scale").
+
+The GridMaster is a two-level tree now: it owns cross-shard structure
+(membership, the shard layout, per-worker resume floors, the dims-2
+start gates), each shard's LineMaster owns its round sequence. These
+tests pin the contracts that make that safe:
+
+- **shard assignment is a pure function of the view** (control/pod.py):
+  contiguous, balanced, identical across rebuilds and takeovers — and
+  coordinate-anchored when a pod grid is configured, so an expulsion
+  shrinks a shard without moving anyone else;
+- **per-shard sequences free-run**: a re-shard resumes every new line
+  past only what ITS OWN workers have seen (never the global max), and
+  never hands a moved worker a round id at or below one it already saw;
+- **the butterfly barrier**: dims-2 column lines hold round r until
+  every row line COMPLETED r — the one load-bearing cross-shard
+  barrier; rows free-run;
+- **per-shard failover**: the replicated digest carries every line's
+  sequence + the floors, and a standby takeover resumes each shard past
+  its own high-water (the PR-10 sharding's shard-blind path, fixed);
+- **shard-aware watchdog/adapt evidence** (the ISSUE's audit): every
+  shard's rounds are watched under its own line id, and lag evidence
+  merges across shards.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    GossipConfig,
+    LineMasterConfig,
+    MasterConfig,
+    MetaDataConfig,
+    ThresholdConfig,
+)
+from akka_allreduce_tpu.control import pod
+from akka_allreduce_tpu.control.grid_master import GridMaster, dim_worker_id
+from akka_allreduce_tpu.obs.watchdog import RoundWatchdog
+from akka_allreduce_tpu.protocol import (
+    CompleteAllreduce,
+    ConfirmPreparation,
+    PrepareAllreduce,
+    StartAllreduce,
+)
+
+
+# --- pod.py: pure layout functions --------------------------------------------
+
+
+def test_parse_grid():
+    assert pod.parse_grid("2x8") == (2, 8)
+    assert pod.parse_grid("16X4") == (16, 4)
+    for bad in ("2x", "x8", "2x8x2", "ax2", "0x4", "2x-1", "8"):
+        with pytest.raises(ValueError):
+            pod.parse_grid(bad)
+
+
+def test_grid_coords_roundtrip():
+    rows, cols = 2, 8
+    seen = set()
+    for idx in range(rows * cols):
+        r, c = pod.grid_coords(idx, rows, cols)
+        assert 0 <= r < rows and 0 <= c < cols
+        assert pod.grid_node_id(r, c, cols) == idx
+        seen.add((r, c))
+    assert len(seen) == rows * cols
+    with pytest.raises(ValueError):
+        pod.grid_coords(16, 2, 8)
+
+
+def test_resolve_process_index_precedence(monkeypatch):
+    import sys
+
+    for var in pod.PROCESS_INDEX_ENV:
+        monkeypatch.delenv(var, raising=False)
+    # explicit wins over everything
+    monkeypatch.setenv("AKKA_PROCESS_INDEX", "7")
+    assert pod.resolve_process_index(3) == 3
+    # env next, in precedence order
+    assert pod.resolve_process_index() == 7
+    monkeypatch.setenv("SLURM_PROCID", "9")
+    assert pod.resolve_process_index() == 7  # AKKA_ still outranks
+    monkeypatch.delenv("AKKA_PROCESS_INDEX")
+    assert pod.resolve_process_index() == 9
+    monkeypatch.setenv("SLURM_PROCID", "zebra")
+    with pytest.raises(ValueError, match="SLURM_PROCID"):
+        pod.resolve_process_index()
+    monkeypatch.delenv("SLURM_PROCID")
+    # -1 explicit means "not given"; with no env AND no importable jax
+    # (blocked here — an in-process jax would volunteer index 0) the
+    # resolver raises instead of guessing a coordinate
+    monkeypatch.setitem(sys.modules, "jax", None)
+    with pytest.raises(ValueError, match="process index"):
+        pod.resolve_process_index(-1)
+
+
+def test_shard_assignment_contiguous_balanced_pure():
+    view = [9, 3, 0, 12, 7, 5, 1, 11]
+    shards = pod.shard_assignment(view, 3)
+    # pure: same view (any order) -> identical shards
+    assert shards == pod.shard_assignment(sorted(view), 3)
+    assert shards == pod.shard_assignment(list(reversed(view)), 3)
+    # contiguous over the sorted view, balanced within one
+    flat = [n for s in shards for n in s]
+    assert flat == sorted(view)
+    sizes = [len(s) for s in shards]
+    assert max(sizes) - min(sizes) <= 1
+    # degenerate shapes
+    assert pod.shard_assignment([], 4) == []
+    assert pod.shard_assignment([5], 4) == [[5]]
+    assert pod.shard_assignment([1, 2], 8) == [[1], [2]]
+
+
+def test_coordinate_shard_assignment_stable_boundaries():
+    # 2x8 pod, 4 shards: fixed blocks of 4 coordinates each
+    full = list(range(16))
+    blocks = pod.coordinate_shard_assignment(full, 2, 8, 4)
+    assert blocks == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11], [12, 13, 14, 15]]
+    # losing node 5 shrinks ITS block only — nobody moves shards (a
+    # balanced re-split would have pulled 8 across the boundary)
+    survivors = [n for n in full if n != 5]
+    after = pod.coordinate_shard_assignment(survivors, 2, 8, 4)
+    assert after == [[0, 1, 2, 3], [4, 6, 7], [8, 9, 10, 11], [12, 13, 14, 15]]
+    # pure in the view
+    assert after == pod.coordinate_shard_assignment(
+        list(reversed(survivors)), 2, 8, 4
+    )
+    # a non-pod joiner minted past the grid overflows into the LAST block
+    assert pod.coordinate_shard_assignment([0, 99], 2, 8, 4) == [[0], [99]]
+    # an emptied block drops out
+    assert pod.coordinate_shard_assignment([0, 1, 15], 2, 8, 4) == [
+        [0, 1],
+        [15],
+    ]
+
+
+# --- GridMaster: per-shard sequences ------------------------------------------
+
+
+def _grid(n: int, shards: int, **master_kw) -> GridMaster:
+    grid = GridMaster(
+        ThresholdConfig(1.0, 1.0, 1.0),
+        MasterConfig(
+            node_num=n, dimensions=1, line_shards=shards, **master_kw
+        ),
+        LineMasterConfig(round_window=2, max_rounds=-1),
+    )
+    return grid
+
+
+def _organize_and_confirm(grid: GridMaster, nodes) -> None:
+    out = []
+    for nid in nodes:
+        out.extend(grid.member_up(nid))
+    _confirm_all(grid, out)
+
+
+def _confirm_all(grid: GridMaster, envelopes) -> list:
+    started = []
+    for env in envelopes:
+        if isinstance(env.msg, PrepareAllreduce):
+            started.extend(
+                grid.handle(
+                    ConfirmPreparation(env.msg.config_id, env.msg.worker_id)
+                )
+            )
+    return started
+
+
+def _complete_round(grid: GridMaster, line_id: int, r: int) -> list:
+    out = []
+    lm = grid.line_masters[line_id]
+    for w in lm.worker_ids:
+        out.extend(grid.handle_for_line(line_id, CompleteAllreduce(w, r)))
+    return out
+
+
+def test_reshard_same_view_identical_across_rebuilds():
+    a, b = _grid(8, 3), _grid(8, 3)
+    _organize_and_confirm(a, range(8))
+    _organize_and_confirm(b, [5, 2, 7, 0, 3, 6, 1, 4])  # different join order
+    assert {
+        lid: lm.worker_ids for lid, lm in a.line_masters.items()
+    } == {lid: lm.worker_ids for lid, lm in b.line_masters.items()}
+
+
+def test_per_shard_sequences_free_run_and_resume_independently():
+    grid = _grid(4, 2)
+    _organize_and_confirm(grid, range(4))
+    assert len(grid.line_masters) == 2
+    # shard 0 races ahead: 5 completed rounds; shard 1 completes 1
+    for r in range(5):
+        _complete_round(grid, 0, r)
+    _complete_round(grid, 1, 0)
+    next0 = grid.line_masters[0].next_round
+    next1 = grid.line_masters[1].next_round
+    assert next0 > next1
+    # a reorganize that does NOT move workers between shards (a late
+    # joiner landing in shard 1) must let shard 0 resume past its own
+    # sequence and shard 1 past ITS OWN — never the global max
+    out = []
+    for env in grid.member_up(9):
+        out.append(env)
+    by_line = {
+        env.msg.line_id: env.msg.round_num
+        for env in out
+        if isinstance(env.msg, PrepareAllreduce)
+    }
+    assert by_line[0] == next0  # the fast shard continues its sequence
+    assert by_line[1] == next1  # the slow shard is NOT dragged forward
+    assert by_line[1] < by_line[0]
+
+
+def test_reshard_never_regresses_a_moved_workers_rounds():
+    grid = _grid(4, 2)
+    _organize_and_confirm(grid, range(4))  # shards [0,1], [2,3]
+    for r in range(6):
+        _complete_round(grid, 0, r)  # shard 0 at next_round 6+
+    fast_next = grid.line_masters[0].next_round
+    # losing node 0 re-balances to [[1, 2], [3]]: worker 1 (from the
+    # fast shard) now shares a line with worker 2 (slow shard) — the
+    # merged line must resume past the FAST worker's history
+    out = grid.member_unreachable(0)
+    by_line = {}
+    for env in out:
+        if isinstance(env.msg, PrepareAllreduce):
+            by_line[tuple(sorted(env.msg.peer_ids))] = env.msg.round_num
+    assert by_line[(1, 2)] >= fast_next
+    # ...while the survivor-only shard keeps its own (lower) sequence
+    assert by_line[(3,)] < fast_next
+
+
+def test_coordinate_shards_hold_boundaries_under_expulsion():
+    grid = _grid(8, 4, grid_rows=2, grid_cols=4)
+    _organize_and_confirm(grid, range(8))
+    assert [
+        lm.worker_ids for lm in grid.line_masters.values()
+    ] == [(0, 1), (2, 3), (4, 5), (6, 7)]
+    grid.member_unreachable(2)
+    assert [
+        sorted(lm.worker_ids) for lm in grid.line_masters.values()
+    ] == [[0, 1], [3], [4, 5], [6, 7]]
+
+
+# --- the dims-2 butterfly barrier ---------------------------------------------
+
+
+def _starts_by_dim(envelopes) -> dict[int, list[tuple[int, int]]]:
+    """{dim: [(worker, round)...]} of the StartAllreduce envelopes."""
+    out: dict[int, list[tuple[int, int]]] = {0: [], 1: []}
+    for env in envelopes:
+        if isinstance(env.msg, StartAllreduce):
+            wid = int(env.dest.rpartition(":")[2])
+            out[wid % 2].append((wid, env.msg.round_num))
+    return out
+
+
+def test_butterfly_columns_gate_on_row_completion():
+    grid = GridMaster(
+        ThresholdConfig(1.0, 1.0, 1.0),
+        MasterConfig(node_num=4, dimensions=2),
+        LineMasterConfig(round_window=2, max_rounds=-1),
+    )
+    out = []
+    for nid in range(4):
+        out.extend(grid.member_up(nid))
+    started = _confirm_all(grid, out)
+    by_dim = _starts_by_dim(started)
+    # rows (dim 0) free-run their window; columns (dim 1) are GATED:
+    # round 0 cannot start before every row completed round 0
+    assert by_dim[0] and all(r in (0, 1) for _, r in by_dim[0])
+    assert by_dim[1] == []
+    # row line 0 completes round 0 -> columns still gated (row 1 pending)
+    after_row0 = _complete_round(grid, 0, 0)
+    assert _starts_by_dim(after_row0)[1] == []
+    # row line 1 completes round 0 -> the gate opens and the SAME
+    # dispatch carries the column Starts for round 0
+    after_row1 = _complete_round(grid, 1, 0)
+    col_starts = _starts_by_dim(after_row1)[1]
+    assert col_starts, "column lines never started after rows completed"
+    assert {r for _, r in col_starts} == {0}
+    assert {w for w, _ in col_starts} == {
+        dim_worker_id(n, 1, 2) for n in range(4)
+    }
+    # round 1 stays gated until the rows complete it too
+    assert all(r == 0 for _, r in col_starts)
+
+
+# --- shard-aware watchdog + adapt evidence (the ISSUE audit) ------------------
+
+
+def test_watchdog_watches_every_shards_rounds():
+    clock = {"now": 100.0}
+    wd = RoundWatchdog(5.0, clock=lambda: clock["now"], dump=False)
+    grid = GridMaster(
+        ThresholdConfig(1.0, 1.0, 1.0),
+        MasterConfig(node_num=4, dimensions=1, line_shards=2),
+        LineMasterConfig(round_window=1, max_rounds=-1),
+        on_round_start=wd.round_started,
+        on_round_complete=lambda lid, r, lat, done, n: wd.round_completed(
+            lid, r
+        ),
+        on_reorganize=wd.reset,
+    )
+    _organize_and_confirm(grid, range(4))
+    # BOTH shards' in-flight rounds are registered under their line ids
+    assert set(wd._inflight) == {(0, 0), (1, 0)}
+    # shard 1 stalls; shard 0 completes (and starts its next round)
+    _complete_round(grid, 0, 0)
+    clock["now"] += 6.0
+    stalled = wd.check()
+    # the stalled shard's round is reported under ITS line id (shard 0's
+    # follow-on round legitimately trips too at this fake-clock jump —
+    # what matters is that no shard is blind)
+    assert (1, 0) in [(lid, r) for lid, r, _age in stalled]
+    assert (0, 0) not in [(lid, r) for lid, r, _age in stalled]
+    # and the per-shard restart path covers the stalled shard (the line
+    # masters run on the REAL clock — age 0 forces the check)
+    restarts = {
+        lid: lm.restart_stalled(0.0)
+        for lid, lm in grid.line_masters.items()
+    }
+    assert restarts[1], "the stalled shard was not re-Started"
+    assert all(
+        env.msg.round_num == 0 for env in restarts[1]
+    ), "wrong round re-Started for the stalled shard"
+
+
+def test_worker_lags_merge_across_shards():
+    grid = _grid(4, 2)
+    _organize_and_confirm(grid, range(4))
+    # shard 0: worker 1 chronically late (rounds complete without it —
+    # th would have to be < 1 for that for real; emulate via direct
+    # completion bookkeeping like the adapt suite does)
+    lm0, lm1 = grid.line_masters[0], grid.line_masters[1]
+    for r in range(4):
+        _complete_round(grid, 0, r)
+    lm0.worker_last_complete[1] = 0  # trails the completed horizon
+    lags = grid.worker_lags()
+    # evidence from BOTH shards in one merged map
+    assert set(lags) == {0, 1, 2, 3}
+    assert lags[1] > 0 and lags[2] == 0
+
+
+# --- per-shard failover (digest -> takeover) ----------------------------------
+
+
+def _master_cfg(shards: int = 2) -> AllreduceConfig:
+    return AllreduceConfig(
+        threshold=ThresholdConfig(1.0, 1.0, 1.0),
+        metadata=MetaDataConfig(data_size=256, max_chunk_size=128),
+        line_master=LineMasterConfig(round_window=2, max_rounds=-1),
+        master=MasterConfig(
+            node_num=4, dimensions=1, line_shards=shards,
+            heartbeat_interval_s=0.2,
+        ),
+        gossip=GossipConfig(),
+    )
+
+
+def test_takeover_resumes_each_shard_past_its_own_sequence():
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    leader = MasterProcess(_master_cfg(), port=0, clock=lambda: 100.0)
+    for i in range(4):
+        leader._on_cluster_msg(
+            cl.JoinCluster(f"10.0.0.{i + 1}", 7000 + i, -1, 100 + i)
+        )
+    assert len(leader.grid.line_masters) == 2
+    # drive shard 0 far ahead of shard 1 (the digest must carry BOTH)
+    for env_unused in range(0):
+        pass
+    lm0, lm1 = leader.grid.line_masters[0], leader.grid.line_masters[1]
+    lm0._preparing = False
+    lm1._preparing = False
+    for r in range(7):
+        for w in lm0.worker_ids:
+            leader.grid.handle_for_line(0, CompleteAllreduce(w, r))
+        lm0._fill_window()
+    for w in lm1.worker_ids:
+        leader.grid.handle_for_line(1, CompleteAllreduce(w, 0))
+    lm1._fill_window()
+    next0, next1 = lm0.next_round, lm1.next_round
+    assert next0 > next1
+    digest_json = leader._digest_state()
+    state = json.loads(digest_json)
+    assert state["round"]["shards"] == {"0": next0, "1": next1}
+    assert state["lines"]["0"] == sorted(lm0.worker_ids)
+    # a standby absorbs the digest and takes over
+    standby = MasterProcess(
+        _master_cfg(), port=0, clock=lambda: 200.0,
+        standby_of=cl.Endpoint("10.0.0.99", 6999),
+    )
+    standby._last_digest = cl.StateDigest(
+        leader.epoch, 1, "10.0.0.98", 6998, digest_json
+    )
+    standby._takeover(200.0)
+    # the takeover's first reorganization resumes EVERY shard past its
+    # OWN high-water: the slow shard is not snapped to the global max
+    out = standby.grid.reorganize()
+    by_line = {
+        env.msg.line_id: env.msg.round_num
+        for env in out
+        if isinstance(env.msg, PrepareAllreduce)
+    }
+    assert by_line[0] >= next0
+    assert next1 <= by_line[1] < next0
+
+
+def test_legacy_digest_without_shard_fields_falls_back_to_global_max():
+    from akka_allreduce_tpu.control import cluster as cl
+    from akka_allreduce_tpu.control.bootstrap import MasterProcess
+
+    leader = MasterProcess(_master_cfg(), port=0, clock=lambda: 100.0)
+    for i in range(4):
+        leader._on_cluster_msg(
+            cl.JoinCluster(f"10.0.0.{i + 1}", 7000 + i, -1, 100 + i)
+        )
+    state = json.loads(leader._digest_state())
+    # simulate a PR-14-era leader: no per-shard fields anywhere
+    state.pop("lines", None)
+    state.pop("floors", None)
+    state["round"].pop("shards", None)
+    state["round"]["next"] = 42
+    standby = MasterProcess(
+        _master_cfg(), port=0, clock=lambda: 200.0,
+        standby_of=cl.Endpoint("10.0.0.99", 6999),
+    )
+    standby._last_digest = cl.StateDigest(
+        leader.epoch, 1, "10.0.0.98", 6998, json.dumps(state)
+    )
+    standby._takeover(200.0)
+    out = standby.grid.reorganize()
+    rounds = {
+        env.msg.round_num
+        for env in out
+        if isinstance(env.msg, PrepareAllreduce)
+    }
+    # every shard resumes at the legacy global max — never a regression
+    assert rounds == {42}
