@@ -3,21 +3,31 @@
 
 PYTHON ?= python3
 
-.PHONY: lint lint-json baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip chaos-scale chaos-train
+.PHONY: lint lint-json lint-sarif baseline native test tier1 trace-demo bench-wire chaos chaos-recover chaos-failover chaos-adapt chaos-gossip chaos-scale chaos-train
 
-# arlint: async-safety / buffer-aliasing / wire-exhaustiveness analyzer
-# (ANALYSIS.md). Exit 1 on any unsuppressed finding — same gate as
-# tests/test_arlint.py, so CI and a local `make lint` always agree.
+# arlint scan surface: the package, the entry shims at the repo root, and the
+# tests' subprocess worker helpers (async/thread code runs there too). Narrow
+# it per-path with the [tool.arlint] exclude list, never by trimming this.
+LINT_PATHS = akka_allreduce_tpu/ bench.py $(wildcard tests/*_worker.py)
+
+# arlint: async-safety / buffer-aliasing / wire-contract / thread-race /
+# determinism analyzer (ANALYSIS.md). Exit 1 on any unsuppressed finding —
+# same gate as tests/test_arlint.py, so CI and a local `make lint` agree.
 lint:
-	$(PYTHON) -m akka_allreduce_tpu.analysis akka_allreduce_tpu/
+	$(PYTHON) -m akka_allreduce_tpu.analysis $(LINT_PATHS)
 
 lint-json:
-	$(PYTHON) -m akka_allreduce_tpu.analysis akka_allreduce_tpu/ --json
+	$(PYTHON) -m akka_allreduce_tpu.analysis $(LINT_PATHS) --json
+
+# SARIF 2.1.0 log for code-scanning upload in any CI (plus the normal text
+# report); exit code contract identical to `make lint`
+lint-sarif:
+	$(PYTHON) -m akka_allreduce_tpu.analysis $(LINT_PATHS) --sarif arlint.sarif
 
 # refresh arlint_baseline.json from the current tree — use ONLY for findings
 # that are deliberate and justified; prefer fixing, then inline suppression
 baseline:
-	$(PYTHON) -m akka_allreduce_tpu.analysis akka_allreduce_tpu/ --write-baseline
+	$(PYTHON) -m akka_allreduce_tpu.analysis $(LINT_PATHS) --write-baseline
 
 native:
 	$(MAKE) -C native

@@ -1,5 +1,6 @@
-"""arlint — the repo's own async-safety / buffer-aliasing / wire-exhaustiveness
-static analyzer (``python -m akka_allreduce_tpu.analysis``).
+"""arlint — the repo's own async-safety / buffer-aliasing / wire-contract /
+thread-race / determinism static analyzer (``python -m
+akka_allreduce_tpu.analysis``).
 
 Every rule targets a defect class this codebase has already paid for by hand
 (ANALYSIS.md tells each story):
@@ -17,9 +18,27 @@ Every rule targets a defect class this codebase has already paid for by hand
 - **BUF001** — ``np.frombuffer``/``memoryview`` view of a pooled/recycled
   buffer escaping its recycle scope (returned or stored on ``self``): the
   recv-ring aliasing class.
+- **DET001/002/003** — wall-clock reads, unseeded RNG, and unsorted-set
+  iteration inside the modules declared deterministic via ``[tool.arlint]
+  det-modules``: the byte-identical-replay discipline as a gate.
+- **LIFE001** — ``observed_task`` handles / ``Thread`` objects / executors
+  stored on ``self`` that no ``stop()``/``close()``-family method ever
+  references: the PR-13 sender-thread leak class.
+- **OBS001** — two-way drift between literal Registry metric names and the
+  OBSERVABILITY.md metric table (``obs-doc`` config key).
+- **THRD001/002** — v2's cross-function pass: an intra-package call graph
+  classifies every function's execution context (event-loop / thread /
+  sync-anywhere), then flags ``self``-attribute or module-global mutation
+  from both contexts without a lock on every site, and unsnapshotted
+  iteration over cross-context-mutated collections (the PR-9
+  endpoint-telemetry race and collector fix).
 - **WIRE001** — wire-tag exhaustiveness: every tag in ``control/wire._TAGS``
   must have an encode arm, a decode arm, and an ``isinstance`` dispatch arm
   somewhere in the analyzed tree — and no arm may exist for an unknown tag.
+- **WIRE002** — version-skew contract: decode arms tolerate trailing bytes
+  (no exact ``len(buf)`` equality), wire dataclasses keep new fields
+  trailing-with-default, and tag ranges stay unique/contiguous and
+  module-owned (``wire-owned`` config key).
 
 No third-party dependencies: stdlib ``ast`` only, so it runs anywhere the
 package imports. Suppress a finding inline with ``# arlint: disable=RULE``
@@ -35,8 +54,12 @@ from akka_allreduce_tpu.analysis.core import (
     analyze_paths,
     analyze_source,
 )
+from akka_allreduce_tpu.analysis.contexts import ContextMap, build_context_map
 from akka_allreduce_tpu.analysis.rules import FILE_RULES
-from akka_allreduce_tpu.analysis.wire_rule import check_wire_exhaustiveness
+from akka_allreduce_tpu.analysis.wire_rule import (
+    check_wire_exhaustiveness,
+    check_wire_skew,
+)
 
 ALL_RULES = (
     "ASYNC001",
@@ -44,16 +67,27 @@ ALL_RULES = (
     "ASYNC003",
     "ASYNC004",
     "BUF001",
+    "DET001",
+    "DET002",
+    "DET003",
+    "LIFE001",
+    "OBS001",
+    "THRD001",
+    "THRD002",
     "WIRE001",
+    "WIRE002",
 )
 
 __all__ = [
     "ALL_RULES",
     "ArlintConfig",
+    "ContextMap",
     "FILE_RULES",
     "Finding",
     "analyze_paths",
     "analyze_source",
+    "build_context_map",
     "check_wire_exhaustiveness",
+    "check_wire_skew",
     "load_config",
 ]

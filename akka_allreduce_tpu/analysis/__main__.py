@@ -1,8 +1,13 @@
 """CLI: ``python -m akka_allreduce_tpu.analysis [paths...]``.
 
 Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage or
-configuration error. Default output is ``file:line: RULE message`` per
-finding; ``--json`` emits a machine-readable report instead.
+configuration error — identical across every output mode, so CI gates on the
+code and picks presentation freely. ``--format=text`` (default) prints
+``file:line: RULE message`` per finding; ``--format=json`` (alias ``--json``)
+emits a machine-readable report; ``--format=github`` emits workflow-command
+annotations (``::error file=...``) that annotate diffs in GitHub CI.
+``--sarif OUT.json`` additionally writes a SARIF 2.1.0 log alongside any
+format, for code-scanning upload in any CI.
 """
 
 from __future__ import annotations
@@ -35,7 +40,25 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "paths", nargs="+", type=Path, help="files or directories to analyze"
     )
-    p.add_argument("--json", action="store_true", help="JSON report on stdout")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="JSON report on stdout (alias for --format=json)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "github"),
+        default=None,
+        help="output mode: text (default), json, or github "
+        "workflow-command annotations",
+    )
+    p.add_argument(
+        "--sarif",
+        type=Path,
+        default=None,
+        metavar="OUT.json",
+        help="also write a SARIF 2.1.0 log to this path (any --format)",
+    )
     p.add_argument(
         "--rules",
         help="comma-separated rule subset (default: all, or [tool.arlint] "
@@ -65,6 +88,14 @@ def main(argv: list[str] | None = None) -> int:
         help="rewrite the baseline file from the current findings and exit 0",
     )
     args = p.parse_args(argv)
+    if args.format is None:
+        args.format = "json" if args.json else "text"
+    elif args.json and args.format != "json":
+        print(
+            "arlint: --json conflicts with --format="
+            f"{args.format}", file=sys.stderr
+        )
+        return 2
 
     for path in args.paths:
         if not path.exists():
@@ -120,7 +151,13 @@ def main(argv: list[str] | None = None) -> int:
             findings, load_baseline(baseline_path)
         )
 
-    if args.json:
+    if args.sarif is not None:
+        args.sarif.write_text(
+            json.dumps(_sarif_log(findings), indent=2) + "\n",
+            encoding="utf-8",
+        )
+
+    if args.format == "json":
         print(
             json.dumps(
                 {
@@ -131,6 +168,18 @@ def main(argv: list[str] | None = None) -> int:
                 indent=2,
             )
         )
+    elif args.format == "github":
+        for f in findings:
+            print(
+                f"::error file={f.path},line={f.line},"
+                f"endLine={max(f.line, f.end_line)},title={f.rule}::"
+                f"{_gh_escape(f.message)}"
+            )
+        note = f", {len(baselined)} baselined" if baselined else ""
+        print(
+            f"arlint: {len(findings)} unsuppressed finding(s){note}",
+            file=sys.stderr,
+        )
     else:
         for f in findings:
             print(f.render())
@@ -140,6 +189,59 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
     return 1 if findings else 0
+
+
+def _gh_escape(text: str) -> str:
+    """Workflow-command data escaping (the %0A/%0D/%25 triple GitHub's
+    runner unescapes; a raw newline would terminate the command)."""
+    return text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _sarif_log(findings: list) -> dict:
+    """Minimal SARIF 2.1.0 log — one run, one result per finding, rule ids
+    registered in the driver so code-scanning UIs group by rule."""
+    from akka_allreduce_tpu.analysis import ALL_RULES
+
+    seen_rules = sorted(
+        {f.rule for f in findings} | set(ALL_RULES)
+    )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "arlint",
+                        "informationUri": "ANALYSIS.md",
+                        "rules": [{"id": r} for r in seen_rules],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": f.line,
+                                        "endLine": max(f.line, f.end_line),
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
 
 
 if __name__ == "__main__":

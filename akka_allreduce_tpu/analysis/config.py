@@ -36,6 +36,15 @@ class ArlintConfig:
     #: whole underscore-separated segments of the name ("ring" hits
     #: ``_ring``/``ring_buf`` but never ``_instring``)
     buf001_markers: tuple[str, ...] = ("ring", "pool", "recycled")
+    #: path suffixes of modules declared deterministic — DET001/002/003 run
+    #: only inside these (empty = the DET rules are silent)
+    det_modules: tuple[str, ...] = ()
+    #: metric-table document OBS001 checks Registry names against, relative
+    #: to the pyproject that named it (None = rule is silent)
+    obs_doc: str | None = None
+    #: module-owned wire-tag ranges for WIRE002, parsed from
+    #: ``"path/suffix.py:lo-hi"`` entries
+    wire_owned: tuple[tuple[str, int, int], ...] = ()
     #: where the config came from (for error messages / baseline resolution)
     source: Path | None = None
 
@@ -188,9 +197,34 @@ def config_from_table(table: dict, *, source: Path | None = None) -> ArlintConfi
             cfg.async001_blocking = _str_tuple(value, key=key)
         elif norm == "buf001_markers":
             cfg.buf001_markers = _str_tuple(value, key=key)
+        elif norm == "det_modules":
+            cfg.det_modules = _str_tuple(value, key=key)
+        elif norm == "obs_doc":
+            if not isinstance(value, str):
+                raise ConfigError("[tool.arlint] obs-doc: expected a string")
+            cfg.obs_doc = value
+        elif norm == "wire_owned":
+            cfg.wire_owned = tuple(
+                _parse_wire_owned(v) for v in _str_tuple(value, key=key)
+            )
         else:
             raise ConfigError(f"[tool.arlint]: unknown key {key!r}")
     return cfg
+
+
+def _parse_wire_owned(entry: str) -> tuple[str, int, int]:
+    m = re.fullmatch(r"(?P<suffix>[^:]+):(?P<lo>\d+)-(?P<hi>\d+)", entry)
+    if m is None:
+        raise ConfigError(
+            f"[tool.arlint] wire-owned: expected 'path/suffix.py:lo-hi', "
+            f"got {entry!r}"
+        )
+    lo, hi = int(m.group("lo")), int(m.group("hi"))
+    if lo > hi:
+        raise ConfigError(
+            f"[tool.arlint] wire-owned: empty range in {entry!r}"
+        )
+    return (m.group("suffix"), lo, hi)
 
 
 def find_pyproject(start: Path) -> Path | None:

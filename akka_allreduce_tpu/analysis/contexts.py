@@ -1,0 +1,460 @@
+"""Execution-context classification over a bounded intra-package call graph.
+
+The v1 rules are syntactically local; the defect classes PR 9/13/15 paid for
+are not: a ``self`` attribute is benign until one mutation site runs on a
+sender thread while another runs on the event loop. This module is the
+substrate those rules (THRD001/002, and future epoch-fence/tenant-isolation
+rules) sit on. It stays deliberately *bounded*:
+
+- the call graph is intra-package only (edges resolve through module-level
+  functions, ``self.method``, imported-module attributes, and nested defs —
+  never through dynamic dispatch, instance attributes, or containers);
+- context propagation is a plain BFS with two colors:
+
+  * **loop** — every ``async def`` plus the sync functions they (transitively)
+    call, plus callbacks handed to ``add_done_callback`` /
+    ``call_soon[_threadsafe]`` / ``call_later`` / ``call_at`` (all run on the
+    loop thread);
+  * **thread** — every ``threading.Thread(target=...)`` target,
+    ``executor.submit``/``loop.run_in_executor``/``asyncio.to_thread``
+    callable (the learner-thread pattern included), and their transitive sync
+    callees.
+
+A function reached from neither color is *sync-anywhere*: it runs in its
+caller's context, and nothing is known — the rules stay silent on it rather
+than guess. Over-approximation is asymmetric on purpose: an unresolvable
+callee drops the edge (missing an edge can only *miss* a finding, never
+invent one).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from akka_allreduce_tpu.analysis.astutil import dotted_name, terminal_name
+
+LOOP = "loop"
+THREAD = "thread"
+
+#: ``(path, qualname)`` — the identity of a function in the graph
+FuncKey = tuple[str, str]
+
+_THREAD_POOL_METHODS = ("submit",)
+_LOOP_CALLBACK_METHODS = (
+    "add_done_callback",
+    "call_soon",
+    "call_soon_threadsafe",
+    "call_later",
+    "call_at",
+)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    path: str
+    qualname: str
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    #: qualname of the nearest enclosing class (``self`` in a closure nested
+    #: inside a method still binds to that method's instance)
+    cls: str | None
+    is_async: bool
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.path, self.qualname)
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Per-module function/scope/import tables built in one pass."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._stack: list[tuple[str, str]] = []  # ("class"|"func", name)
+        self.funcs: dict[str, FuncInfo] = {}  # qualname -> info
+        self.top_level: dict[str, str] = {}  # bare name -> qualname
+        self.class_methods: dict[str, dict[str, str]] = {}
+        self.by_name: dict[str, list[str]] = {}
+        #: alias -> ("import", dotted) | ("from", base_module, name)
+        self.aliases: dict[str, tuple] = {}
+        #: names assigned at module level (global-collection candidates)
+        self.module_names: set[str] = set()
+
+    def _qual(self, name: str) -> str:
+        return ".".join([n for _, n in self._stack] + [name])
+
+    def _enclosing_class(self) -> str | None:
+        parts: list[str] = []
+        cls: str | None = None
+        for kind, name in self._stack:
+            parts.append(name)
+            if kind == "class":
+                cls = ".".join(parts)
+        return cls
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if not self._stack:
+            self.module_names.add(node.name)
+        self._stack.append(("class", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_func(self, node) -> None:
+        qual = self._qual(node.name)
+        direct_cls = (
+            ".".join(n for _, n in self._stack)
+            if self._stack and self._stack[-1][0] == "class"
+            else None
+        )
+        info = FuncInfo(
+            path=self.path,
+            qualname=qual,
+            node=node,
+            cls=self._enclosing_class(),
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+        )
+        self.funcs[qual] = info
+        self.by_name.setdefault(node.name, []).append(qual)
+        if not self._stack:
+            self.top_level[node.name] = qual
+            self.module_names.add(node.name)
+        if direct_cls is not None:
+            self.class_methods.setdefault(direct_cls, {})[node.name] = qual
+        self._stack.append(("func", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".", 1)[0]
+            dotted = alias.name if alias.asname else alias.name.split(".")[0]
+            self.aliases[name] = ("import", dotted)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:
+            # relative import: resolve against this module's package parts
+            parts = self.path[:-3].split("/")  # strip ".py"
+            if parts and parts[-1] == "__init__":
+                parts = parts[:-1]
+            parts = parts[: -node.level] if node.level <= len(parts) else []
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.aliases[alias.asname or alias.name] = (
+                "from",
+                base,
+                alias.name,
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self._stack:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.module_names.add(t.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if not self._stack and isinstance(node.target, ast.Name):
+            self.module_names.add(node.target.id)
+        self.generic_visit(node)
+
+
+def _module_dotted(path: str) -> str:
+    parts = path[:-3].split("/") if path.endswith(".py") else path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _locked_body_walk(
+    func: ast.AST, lock_hints: tuple[str, ...] = ("lock", "cond", "mutex", "sem")
+) -> Iterator[tuple[ast.AST, bool]]:
+    """Like ``_direct_body_walk`` but yields ``(node, locked)`` where
+    ``locked`` is True inside a ``with <something named like a lock>:``
+    body. The guard test is the context expression's *terminal* name
+    (``self._lock`` / ``sender.cond`` / ``ep.tx_mutex`` all count)."""
+
+    def _is_lock(expr: ast.AST) -> bool:
+        # `with lock:` and `with await lock.acquire_ctx():` style both
+        # resolve through the terminal identifier of the expression
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Await):
+            return _is_lock(expr.value)
+        name = terminal_name(expr)
+        if name is None:
+            return False
+        low = name.lower()
+        return any(h in low for h in lock_hints)
+
+    stack: list[tuple[ast.AST, bool]] = [
+        (child, False) for child in ast.iter_child_nodes(func)
+    ]
+    while stack:
+        node, locked = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node, locked
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            guarded = locked or any(
+                _is_lock(item.context_expr) for item in node.items
+            )
+            for item in node.items:
+                stack.append((item, locked))
+            for child in node.body:
+                stack.append((child, guarded))
+        else:
+            stack.extend(
+                (child, locked) for child in ast.iter_child_nodes(node)
+            )
+
+
+@dataclasses.dataclass
+class ContextMap:
+    """The classified call graph for one analyzed tree."""
+
+    indexes: dict[str, _ModuleIndex]
+    funcs: dict[FuncKey, FuncInfo]
+    edges: dict[FuncKey, set[FuncKey]]
+    loop: set[FuncKey]
+    thread: set[FuncKey]
+    #: seed provenance for messages: key -> short reason string
+    seeds: dict[FuncKey, str]
+
+    def contexts_of(self, key: FuncKey) -> frozenset[str]:
+        out = set()
+        if key in self.loop:
+            out.add(LOOP)
+        if key in self.thread:
+            out.add(THREAD)
+        return frozenset(out)
+
+    def info_for_node(self, path: str, node: ast.AST) -> FuncInfo | None:
+        idx = self.indexes.get(path)
+        if idx is None:
+            return None
+        for info in idx.funcs.values():
+            if info.node is node:
+                return info
+        return None
+
+
+def _resolve(
+    expr: ast.AST,
+    caller: FuncInfo | None,
+    idx: _ModuleIndex,
+    indexes: dict[str, _ModuleIndex],
+    modmap: dict[str, str],
+) -> FuncKey | None:
+    """Resolve a callable expression to a function key, or None (bounded)."""
+    if isinstance(expr, ast.Call):
+        # functools.partial(f, ...) — the eventual callable is args[0]
+        if terminal_name(expr.func) == "partial" and expr.args:
+            return _resolve(expr.args[0], caller, idx, indexes, modmap)
+        return None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+        if caller is not None:
+            prefix = caller.qualname + "."
+            nested = [
+                q for q in idx.by_name.get(name, []) if q.startswith(prefix)
+            ]
+            if nested:
+                return (idx.path, min(nested, key=len))
+        if name in idx.top_level:
+            return (idx.path, idx.top_level[name])
+        cands = idx.by_name.get(name, [])
+        if len(cands) == 1:
+            return (idx.path, cands[0])
+        alias = idx.aliases.get(name)
+        if alias is not None and alias[0] == "from":
+            _, base, orig = alias
+            tpath = modmap.get(base)
+            if tpath is not None and orig in indexes[tpath].top_level:
+                return (tpath, indexes[tpath].top_level[orig])
+        return None
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base == "self" and caller is not None and caller.cls is not None:
+                methods = idx.class_methods.get(caller.cls, {})
+                qual = methods.get(expr.attr)
+                return (idx.path, qual) if qual is not None else None
+            alias = idx.aliases.get(base)
+            if alias is not None:
+                dotted = (
+                    alias[1]
+                    if alias[0] == "import"
+                    else (f"{alias[1]}.{alias[2]}" if alias[1] else alias[2])
+                )
+                tpath = modmap.get(dotted)
+                if tpath is not None:
+                    tidx = indexes[tpath]
+                    qual = tidx.top_level.get(expr.attr)
+                    return (tpath, qual) if qual is not None else None
+            return None
+        dn = dotted_name(expr)
+        if dn is not None and "." in dn:
+            mod, _, fname = dn.rpartition(".")
+            tpath = modmap.get(mod)
+            if tpath is not None:
+                qual = indexes[tpath].top_level.get(fname)
+                return (tpath, qual) if qual is not None else None
+    return None
+
+
+def _callable_seeds(
+    expr: ast.AST,
+    caller: FuncInfo | None,
+    idx: _ModuleIndex,
+    indexes: dict[str, _ModuleIndex],
+    modmap: dict[str, str],
+) -> list[FuncKey]:
+    """Resolve a spawn/callback target; a lambda target seeds every function
+    its body calls (the body RUNS in the spawned context)."""
+    if isinstance(expr, ast.Lambda):
+        out: list[FuncKey] = []
+        for sub in ast.walk(expr.body):
+            if isinstance(sub, ast.Call):
+                key = _resolve(sub.func, caller, idx, indexes, modmap)
+                if key is not None:
+                    out.append(key)
+        return out
+    key = _resolve(expr, caller, idx, indexes, modmap)
+    return [key] if key is not None else []
+
+
+def _spawn_targets(
+    call: ast.Call,
+) -> tuple[str, list[ast.AST]] | None:
+    """``(color, target exprs)`` when ``call`` hands a callable to another
+    execution context, else None."""
+    name = dotted_name(call.func)
+    tail = terminal_name(call.func)
+    if tail == "Thread" or (name is not None and name.endswith("threading.Thread")):
+        targets = [kw.value for kw in call.keywords if kw.arg == "target"]
+        return (THREAD, targets) if targets else None
+    if tail == "to_thread" and call.args:
+        return (THREAD, [call.args[0]])
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _THREAD_POOL_METHODS
+        and call.args
+    ):
+        return (THREAD, [call.args[0]])
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "run_in_executor"
+        and len(call.args) >= 2
+    ):
+        return (THREAD, [call.args[1]])
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _LOOP_CALLBACK_METHODS:
+        pos = 1 if call.func.attr in ("call_later", "call_at") else 0
+        if len(call.args) > pos:
+            return (LOOP, [call.args[pos]])
+    return None
+
+
+def _direct_calls(func: ast.AST) -> Iterator[ast.Call]:
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def build_context_map(trees: dict[str, ast.AST]) -> ContextMap:
+    indexes: dict[str, _ModuleIndex] = {}
+    for path, tree in trees.items():
+        idx = _ModuleIndex(path)
+        idx.visit(tree)
+        indexes[path] = idx
+    modmap = {_module_dotted(path): path for path in trees}
+
+    funcs: dict[FuncKey, FuncInfo] = {}
+    for idx in indexes.values():
+        for info in idx.funcs.values():
+            funcs[info.key] = info
+
+    edges: dict[FuncKey, set[FuncKey]] = {k: set() for k in funcs}
+    loop_seeds: dict[FuncKey, str] = {}
+    thread_seeds: dict[FuncKey, str] = {}
+
+    for path, tree in trees.items():
+        idx = indexes[path]
+        scopes: list[tuple[FuncInfo | None, ast.AST]] = [(None, tree)]
+        scopes.extend((info, info.node) for info in idx.funcs.values())
+        for caller, scope in scopes:
+            # module-level scope must not descend into defs (they have their
+            # own rows); _direct_calls already guarantees that for both.
+            for call in _direct_calls(scope):
+                spawn = _spawn_targets(call)
+                if spawn is not None:
+                    color, exprs = spawn
+                    seeds = thread_seeds if color == THREAD else loop_seeds
+                    for expr in exprs:
+                        for key in _callable_seeds(
+                            expr, caller, idx, indexes, modmap
+                        ):
+                            seeds.setdefault(
+                                key,
+                                f"{'thread target' if color == THREAD else 'loop callback'}"
+                                f" at {path}:{call.lineno}",
+                            )
+                    continue
+                if caller is None:
+                    continue  # plain module-level call: import-time, no color
+                key = _resolve(call.func, caller, idx, indexes, modmap)
+                if key is not None:
+                    edges[caller.key].add(key)
+
+    for info in funcs.values():
+        if info.is_async:
+            loop_seeds.setdefault(info.key, "async def")
+
+    def _closure(seeds: dict[FuncKey, str], color: str) -> set[FuncKey]:
+        seen: set[FuncKey] = set()
+        frontier = [k for k in seeds if k in funcs]
+        seen.update(frontier)
+        while frontier:
+            cur = frontier.pop()
+            if funcs[cur].is_async and color == THREAD:
+                # an async def reached from thread context is not RUN there
+                # (calling it only builds a coroutine object) — don't spread
+                continue
+            for nxt in edges.get(cur, ()):
+                if nxt in seen:
+                    continue
+                if funcs[nxt].is_async:
+                    # sync->async edge builds a coroutine; the async body
+                    # itself is already a loop seed
+                    continue
+                seen.add(nxt)
+                frontier.append(nxt)
+        return seen
+
+    loop = _closure(loop_seeds, LOOP)
+    thread = _closure(thread_seeds, THREAD)
+    # async defs spawned AS thread targets were skipped above; drop them from
+    # the thread set entirely so contexts_of never reports the impossible
+    thread = {k for k in thread if not funcs[k].is_async}
+
+    seeds = dict(loop_seeds)
+    seeds.update(thread_seeds)
+    return ContextMap(
+        indexes=indexes,
+        funcs=funcs,
+        edges=edges,
+        loop=loop,
+        thread=thread,
+        seeds={k: v for k, v in seeds.items() if k in funcs},
+    )

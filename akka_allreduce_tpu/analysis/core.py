@@ -219,7 +219,7 @@ def analyze_source(
     if apply_suppressions:
         sup = suppressed_lines(source)
         findings = [f for f in findings if not is_suppressed(f, sup)]
-    return sorted(findings, key=lambda f: (f.line, f.rule))
+    return sorted(findings, key=lambda f: (f.line, f.rule, f.message))
 
 
 def iter_python_files(paths: list[Path], config: ArlintConfig) -> list[Path]:
@@ -240,21 +240,42 @@ def iter_python_files(paths: list[Path], config: ArlintConfig) -> list[Path]:
     return list(dict.fromkeys(out))
 
 
+def _project_checks():
+    """Registry of cross-file checks: ``(rule ids, fn)`` where ``fn`` has the
+    uniform signature ``(trees, config, *, root) -> list[Finding]``. A check
+    runs when any of its rule ids is selected; its output is then filtered to
+    the selected ids (one walker can serve several rules). Lazy imports keep
+    the core free of rule-module cycles."""
+    from akka_allreduce_tpu.analysis.obs_rule import check_obs_doc_drift
+    from akka_allreduce_tpu.analysis.thread_rules import check_thread_safety
+    from akka_allreduce_tpu.analysis.wire_rule import (
+        check_wire_exhaustiveness,
+        check_wire_skew,
+    )
+
+    return (
+        (("THRD001", "THRD002"), check_thread_safety),
+        (("WIRE001",), check_wire_exhaustiveness),
+        (("WIRE002",), check_wire_skew),
+        (("OBS001",), check_obs_doc_drift),
+    )
+
+
 def analyze_paths(
     paths: list[Path],
     config: ArlintConfig | None = None,
     *,
     root: Path | None = None,
 ) -> list[Finding]:
-    """Analyze files/trees: per-file rules + project-wide WIRE001.
+    """Analyze files/trees: per-file rules + the project-wide checks
+    (WIRE001/WIRE002 codec contracts, THRD001/002 over the call-graph
+    context classifier, OBS001 doc drift).
 
     ``root`` anchors the relative paths used in output and baseline
     fingerprints (default: the config's pyproject directory, else cwd).
     Inline suppressions are already applied; baseline filtering is the
     caller's second step (the CLI and the enforcement test both do it).
     """
-    from akka_allreduce_tpu.analysis.wire_rule import check_wire_exhaustiveness
-
     config = config or ArlintConfig()
     if root is None:
         root = (
@@ -278,25 +299,33 @@ def analyze_paths(
         findings.extend(analyze_source(source, rel, config, tree=tree))
         parsed[rel] = (tree, source)
         suppressions[rel] = suppressed_lines(source)
-    if config.rules is None or "WIRE001" in config.rules:
-        wire_findings = check_wire_exhaustiveness(
-            {rel: tree for rel, (tree, _) in parsed.items()}, config
-        )
-        wire_findings = [
+    trees = {rel: tree for rel, (tree, _) in parsed.items()}
+    for rule_ids, check in _project_checks():
+        if config.rules is not None and not set(rule_ids) & set(config.rules):
+            continue
+        project_findings = [
+            f
+            for f in check(trees, config, root=root)
+            if config.rules is None or f.rule in config.rules
+        ]
+        project_findings = [
             dataclasses.replace(
                 f,
                 line_content=(
                     parsed[f.path][1].splitlines()[f.line - 1].strip()
-                    if f.path in parsed
-                    and 0 < f.line <= len(parsed[f.path][1].splitlines())
+                    if 0 < f.line <= len(parsed[f.path][1].splitlines())
                     else ""
                 ),
             )
-            for f in wire_findings
+            if not f.line_content and f.path in parsed
+            else f
+            for f in project_findings
         ]
         findings.extend(
             f
-            for f in wire_findings
+            for f in project_findings
             if not is_suppressed(f, suppressions.get(f.path, {}))
         )
-    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    # message participates in the sort key so two same-line findings order
+    # deterministically — the analyzer's own output is replay-pinned too
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))

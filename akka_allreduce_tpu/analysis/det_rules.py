@@ -1,0 +1,288 @@
+"""DET001/002/003 — byte-identical-replay discipline as enforced rules.
+
+The repo's strongest correctness evidence is deterministic replay: the chaos
+drills pin byte-identical event JSONL across runs, the 256-node control-plane
+sims assert exact round trajectories, and PR 15 re-litigated (by review) that
+``GossipState`` stays clock-free and seeded. These rules turn that convention
+into a gate for the modules declared deterministic via the ``[tool.arlint]``
+``det-modules`` config key (path suffixes; ``gossip.py``, ``stripes.py``,
+``chaos.py``, ``simfabric.py``, ``adapt.py`` in this repo):
+
+- **DET001** — wall-clock reads: ``time.time()``/``time.monotonic()``/
+  ``datetime.now()`` and friends called inside a det-module. Deterministic
+  code takes an injected clock (the ``clock: Callable[[], float] =
+  time.monotonic`` *default-argument reference* is the sanctioned idiom and
+  is not a call, so it never fires). ``time.perf_counter`` is exempt: the
+  sim fabric measures its own wall-cost with it, which never feeds state.
+- **DET002** — unseeded RNG: module-level ``random.*`` calls and
+  ``np.random.*`` legacy-global calls. Seeded construction —
+  ``random.Random(seed)``, ``np.random.default_rng(seed)`` /
+  ``PCG64``/``Philox``/``SeedSequence`` with arguments — is the sanctioned
+  idiom and is exempt.
+- **DET003** — iteration over a ``set``/``frozenset`` in a context where
+  order can escape (a ``for`` loop, a list/generator/dict comprehension, or
+  a generator fed to an order-sensitive consumer): set order varies with
+  PYTHONHASHSEED and insertion history, so anything it feeds — emitted
+  events, probe order, rumor order — diverges across replays. ``sorted(...)``
+  is the fix; ``list(...)`` is NOT (it freezes the nondeterministic order).
+  Set comprehensions and order-insensitive consumers (``sorted``, ``set``,
+  ``min``/``max``, ``any``/``all``, ``len``, ``sum``, ``frozenset``) are
+  exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+from akka_allreduce_tpu.analysis.core import Finding
+from akka_allreduce_tpu.analysis.astutil import dotted_name, terminal_name
+
+_WALL_CLOCK = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "date.today",
+    "datetime.date.today",
+}
+
+_SEEDED_NP = {"default_rng", "PCG64", "Philox", "SeedSequence", "Generator"}
+
+_ORDER_INSENSITIVE = {
+    "sorted",
+    "set",
+    "frozenset",
+    "min",
+    "max",
+    "any",
+    "all",
+    "len",
+    "sum",
+}
+
+
+def _is_det_module(path: str, config: ArlintConfig) -> bool:
+    return any(path.endswith(suffix) for suffix in config.det_modules)
+
+
+def rule_det001(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    if not _is_det_module(path, config):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in _WALL_CLOCK:
+            findings.append(
+                Finding(
+                    path,
+                    node.lineno,
+                    "DET001",
+                    f"wall-clock read {name}() inside a deterministic module "
+                    f"— replay diverges the moment real time leaks into "
+                    f"state; take an injected clock callable instead "
+                    f"(default-arg 'clock=time.monotonic' reference is the "
+                    f"sanctioned idiom)",
+                    end_line=node.end_lineno or node.lineno,
+                )
+            )
+    return findings
+
+
+def rule_det002(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    if not _is_det_module(path, config):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        hit: str | None = None
+        if name.startswith("random."):
+            tail = name.split(".", 1)[1]
+            if not (tail == "Random" and (node.args or node.keywords)):
+                hit = name
+        elif name.startswith(("np.random.", "numpy.random.")):
+            tail = name.rsplit(".", 1)[1]
+            if not (
+                tail in _SEEDED_NP and (node.args or node.keywords)
+            ):
+                hit = name
+        if hit is not None:
+            findings.append(
+                Finding(
+                    path,
+                    node.lineno,
+                    "DET002",
+                    f"unseeded RNG call {hit}() inside a deterministic "
+                    f"module — the process-global generator breaks seeded "
+                    f"replay; construct random.Random(seed) / "
+                    f"np.random.default_rng(seed) from a derived seed and "
+                    f"thread it through",
+                    end_line=node.end_lineno or node.lineno,
+                )
+            )
+    return findings
+
+
+def _set_names(tree: ast.AST) -> set[str]:
+    """Names (locals, module globals, and ``self`` attrs by terminal name)
+    that are bound to a set/frozenset anywhere in the file — by literal,
+    comprehension, constructor call, set-algebra BinOp over a known set, or
+    a ``set[...]`` annotation."""
+    names: set[str] = set()
+
+    def is_set_expr(expr: ast.AST | None) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call):
+            fname = terminal_name(expr.func)
+            if fname in ("set", "frozenset"):
+                return True
+            if (
+                isinstance(expr.func, ast.Attribute)
+                and expr.func.attr
+                in (
+                    "difference",
+                    "union",
+                    "intersection",
+                    "symmetric_difference",
+                    "copy",
+                )
+                and terminal_name(expr.func.value) in names
+            ):
+                return True
+            return False
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)
+        ):
+            return (
+                terminal_name(expr.left) in names
+                or terminal_name(expr.right) in names
+            )
+        if isinstance(expr, ast.Name) or isinstance(expr, ast.Attribute):
+            return terminal_name(expr) in names
+        return False
+
+    def ann_is_set(ann: ast.AST | None) -> bool:
+        if ann is None:
+            return False
+        base = ann.value if isinstance(ann, ast.Subscript) else ann
+        return terminal_name(base) in ("set", "Set", "frozenset", "FrozenSet")
+
+    # two passes so `b = a` after `a = set()` still registers
+    for _ in range(2):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and is_set_expr(node.value):
+                for t in node.targets:
+                    name = terminal_name(t)
+                    if name is not None:
+                        names.add(name)
+            elif isinstance(node, ast.AnnAssign):
+                if ann_is_set(node.annotation) or is_set_expr(node.value):
+                    name = terminal_name(node.target)
+                    if name is not None:
+                        names.add(name)
+            elif isinstance(node, ast.arg) and ann_is_set(node.annotation):
+                names.add(node.arg)
+    return names
+
+
+def rule_det003(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    if not _is_det_module(path, config):
+        return []
+    names = _set_names(tree)
+    if not names:
+        return []
+
+    # parent links so a GeneratorExp can see its consuming call
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def set_base(expr: ast.AST) -> str | None:
+        """The set name ``expr`` iterates, resolving through list()/tuple()
+        (which do NOT fix set order) but treating sorted() as sanctioned."""
+        while isinstance(expr, ast.Call):
+            fname = (
+                expr.func.id if isinstance(expr.func, ast.Name) else None
+            )
+            if fname == "sorted":
+                return None
+            if fname in ("list", "tuple") and expr.args:
+                expr = expr.args[0]
+                continue
+            return None
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)
+        ):
+            left = terminal_name(expr.left)
+            right = terminal_name(expr.right)
+            if left in names:
+                return left
+            if right in names:
+                return right
+            return None
+        name = terminal_name(expr)
+        return name if name in names else None
+
+    findings = []
+
+    def flag(name: str, node: ast.AST) -> None:
+        findings.append(
+            Finding(
+                path,
+                node.lineno,
+                "DET003",
+                f"iteration over set '{name}' in a deterministic module — "
+                f"set order varies with hashing/insertion history, so "
+                f"anything this loop emits diverges across replays; iterate "
+                f"sorted({name}) (list() only freezes the nondeterministic "
+                f"order)",
+                end_line=node.end_lineno or node.lineno,
+            )
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            name = set_base(node.iter)
+            if name is not None:
+                flag(name, node)
+        elif isinstance(node, (ast.ListComp, ast.DictComp)):
+            for gen in node.generators:
+                name = set_base(gen.iter)
+                if name is not None:
+                    flag(name, node)
+        elif isinstance(node, ast.GeneratorExp):
+            parent = parents.get(node)
+            if (
+                isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Name)
+                and parent.func.id in _ORDER_INSENSITIVE
+            ):
+                continue
+            for gen in node.generators:
+                name = set_base(gen.iter)
+                if name is not None:
+                    flag(name, node)
+        # SetComp is exempt: a set built from a set has no observable order
+    return findings

@@ -1,0 +1,166 @@
+"""LIFE001 — teardown completeness for spawned resources stored on ``self``.
+
+PR 13's review found sender threads that outlived their transport because
+``stop()`` tore down the streams but never joined the thread objects stored
+on ``self`` — the process exited only because the threads were daemons, and
+in-flight frames were silently dropped. The same shape recurs with
+``observed_task`` handles (a task ``stop()`` never cancels keeps running
+into torn-down state) and executors (``ThreadPoolExecutor`` without
+``shutdown()`` leaks its worker threads).
+
+The rule: an assignment ``self.X = observed_task(...)`` / ``create_task`` /
+``ensure_future`` / ``threading.Thread(...)`` / ``ThreadPoolExecutor(...)``
+inside a class requires ``self.X`` to be *referenced* in at least one
+teardown-named method of the same class (``stop``/``close``/``shutdown``/
+``teardown``/``aclose``/``__exit__``/``__aexit__``, prefix-matched, so
+``stop_sync``/``close_now`` count). Referencing is enough — the rule does
+not prove the reference cancels/joins correctly (a human can judge that at
+the anchor line); it proves teardown *knows the resource exists*, which is
+the invariant the PR-13 bug violated. The dynamic teardown idiom
+``for a in ("_x_task", "_y_task"): getattr(self, a).cancel()`` counts too:
+when a teardown calls getattr/setattr on ``self``, its string constants
+are treated as attribute references. A class with no teardown method at all
+is flagged at the spawn site: a spawned resource with no lifecycle owner is
+exactly the defect.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+from akka_allreduce_tpu.analysis.core import Finding
+from akka_allreduce_tpu.analysis.astutil import terminal_name
+
+_SPAWN_CALLS = {
+    "observed_task": "cancel (and optionally await) it",
+    "create_task": "cancel (and optionally await) it",
+    "ensure_future": "cancel (and optionally await) it",
+    "Thread": "signal its loop to exit and join() it",
+    "ThreadPoolExecutor": "shutdown() it",
+    "ProcessPoolExecutor": "shutdown() it",
+}
+
+_TEARDOWN_PREFIXES = (
+    "stop",
+    "close",
+    "shutdown",
+    "teardown",
+    "aclose",
+    "dispose",
+)
+_TEARDOWN_EXACT = {"__exit__", "__aexit__", "__del__", "cancel_all"}
+
+
+def _is_teardown_name(name: str) -> bool:
+    return name in _TEARDOWN_EXACT or any(
+        name.startswith(p) or name.startswith("_" + p)
+        for p in _TEARDOWN_PREFIXES
+    )
+
+
+def _spawn_in(value: ast.AST) -> str | None:
+    """Terminal spawn-call name found anywhere in an assigned value."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            tail = terminal_name(node.func)
+            if tail in _SPAWN_CALLS:
+                return tail
+    return None
+
+
+def rule_life001(
+    tree: ast.AST, path: str, config: ArlintConfig
+) -> list[Finding]:
+    findings = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # attr -> (line, end_line, spawn kind) of the first offending store
+        spawns: dict[str, tuple[int, int, str]] = {}
+        teardown_refs: set[str] = set()
+        has_teardown = False
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _is_teardown_name(method.name):
+                has_teardown = True
+                # dynamic-attr teardown idiom: `for a in ("_x", "_y"):
+                # getattr(self, a).cancel()` references attributes by
+                # string. When a getattr/setattr-on-self appears anywhere
+                # in the teardown, every string constant in the method
+                # counts as a reference — flow-tracking the loop variable
+                # is not worth the machinery, and over-counting here can
+                # only miss a finding, never invent one.
+                dynamic_attr = False
+                consts: set[str] = set()
+                for node in ast.walk(method):
+                    if (
+                        isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                    ):
+                        teardown_refs.add(node.attr)
+                    elif (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id
+                        in ("getattr", "setattr", "delattr", "hasattr")
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id == "self"
+                    ):
+                        dynamic_attr = True
+                    elif isinstance(node, ast.Constant) and isinstance(
+                        node.value, str
+                    ):
+                        consts.add(node.value)
+                if dynamic_attr:
+                    teardown_refs |= consts
+                continue
+            for node in ast.walk(method):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if node.value is None:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    kind = _spawn_in(node.value)
+                    if kind is not None and t.attr not in spawns:
+                        spawns[t.attr] = (
+                            node.lineno,
+                            node.end_lineno or node.lineno,
+                            kind,
+                        )
+        for attr, (line, end_line, kind) in sorted(spawns.items()):
+            if attr in teardown_refs:
+                continue
+            why = (
+                f"no stop()/close()-family method of class {cls.name} "
+                f"references self.{attr}"
+                if has_teardown
+                else f"class {cls.name} has no stop()/close()-family "
+                f"teardown method at all"
+            )
+            findings.append(
+                Finding(
+                    path,
+                    line,
+                    "LIFE001",
+                    f"self.{attr} stores a {kind}(...) but {why} — teardown "
+                    f"must {_SPAWN_CALLS[kind]} (PR-13 sender-thread leak "
+                    f"class)",
+                    end_line=end_line,
+                )
+            )
+    return findings

@@ -1,0 +1,230 @@
+"""OBS001 — two-way drift check between Registry metric names and the doc.
+
+OBSERVABILITY.md's metric table is the operator contract: dashboards and the
+chaos drills' assertions are written against it. Nothing ties it to the code
+— a metric renamed in PR 13 or added in PR 15 drifts silently until someone
+greps. This is the WIRE001 pattern applied to the obs layer:
+
+- **forward**: every *literal* metric name created on a Registry —
+  ``counter("x.y")`` / ``gauge`` / ``histogram`` / ``series``, including
+  f-string names whose formatted fields become ``*`` wildcards — must match
+  a row of the metric table (``<placeholder>`` and ``*`` in doc rows match
+  any suffix);
+- **reverse**: every documented row must have a creation site in the
+  analyzed tree, except rows typed as collector-provided (pull-time names
+  like ``transport.stage_seconds.<stage>`` have no creation call at all).
+
+Names built from variables (``self.counter(name)`` pass-throughs inside the
+registry) are invisible to the rule by design — the contract is enforced at
+the literal call sites, which is where this repo creates every metric.
+
+The rule activates only when ``[tool.arlint] obs-doc`` names the document;
+the reverse check additionally needs a whole-tree scan (a single-file run
+proves nothing about absence).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+from akka_allreduce_tpu.analysis.core import Finding
+
+_FACTORIES = ("counter", "gauge", "histogram", "series")
+_TOKEN = re.compile(r"`([^`]+)`")
+_METRIC_SHAPE = re.compile(r"^[A-Za-z0-9_.*<>:-]+$")
+
+
+def _pattern_regex(token: str) -> re.Pattern:
+    out = []
+    i = 0
+    while i < len(token):
+        ch = token[i]
+        if ch == "<":
+            end = token.find(">", i)
+            if end == -1:
+                out.append(re.escape(ch))
+                i += 1
+                continue
+            out.append(".+")
+            i = end + 1
+        elif ch == "*":
+            out.append(".+")
+            i += 1
+        else:
+            out.append(re.escape(ch))
+            i += 1
+    return re.compile("".join(out))
+
+
+def _probe(token: str) -> str:
+    """Placeholders/wildcards replaced by a literal segment, for matching a
+    doc pattern against a creation pattern (or vice versa)."""
+    return re.sub(r"<[^>]*>|\*", "x", token)
+
+
+def _creation_sites(
+    trees: dict[str, ast.AST],
+) -> list[tuple[str, bool, str, int]]:
+    """(name_or_pattern, is_pattern, path, line) for every literal metric
+    creation; f-string names contribute a ``*``-wildcard pattern."""
+    out: list[tuple[str, bool, str, int]] = []
+    for path in sorted(trees):
+        for node in ast.walk(trees[path]):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fname = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id
+                if isinstance(node.func, ast.Name)
+                else None
+            )
+            if fname not in _FACTORIES:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if "." in arg.value:  # dotted names only: skips unrelated
+                    out.append((arg.value, False, path, node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                for piece in arg.values:
+                    if isinstance(piece, ast.Constant):
+                        parts.append(str(piece.value))
+                    else:
+                        parts.append("*")
+                pattern = "".join(parts)
+                if "." in pattern:
+                    out.append((pattern, True, path, node.lineno))
+    return out
+
+
+def _doc_rows(text: str) -> list[tuple[str, int, str, bool]]:
+    """(token, line_number, stripped_line, is_collector) for every metric
+    token in the FIRST cell of a table row. A ``.suffix`` continuation token
+    inherits the previous token's prefix (``a.b.tx`` / ``.rx`` documents
+    ``a.b.rx``)."""
+    rows: list[tuple[str, int, str, bool]] = []
+    last_full: str | None = None
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        stripped = raw.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells or set(cells[0]) <= {"-", ":", " "}:
+            continue
+        is_collector = "collector" in stripped.lower()
+        for token in _TOKEN.findall(cells[0]):
+            token = token.strip()
+            if not _METRIC_SHAPE.match(token):
+                continue
+            if token.startswith("."):
+                if last_full is None:
+                    continue
+                suffix_parts = token[1:].split(".")
+                base_parts = last_full.split(".")
+                if len(base_parts) <= len(suffix_parts):
+                    continue
+                token = ".".join(
+                    base_parts[: -len(suffix_parts)] + suffix_parts
+                )
+            else:
+                if "." not in token and "*" not in token:
+                    continue
+                last_full = token
+            rows.append((token, lineno, stripped, is_collector))
+    return rows
+
+
+def check_obs_doc_drift(
+    trees: dict[str, ast.AST],
+    config: ArlintConfig,
+    *,
+    root: Path | None = None,
+) -> list[Finding]:
+    if config.obs_doc is None:
+        return []
+    doc_path = Path(config.obs_doc)
+    if not doc_path.is_absolute():
+        base = (
+            config.source.parent
+            if config.source is not None
+            else (root if root is not None else Path.cwd())
+        )
+        doc_path = base / doc_path
+    creations = _creation_sites(trees)
+    if not doc_path.is_file():
+        if not creations:
+            return []
+        name, _, path, line = creations[0]
+        return [
+            Finding(
+                path,
+                line,
+                "OBS001",
+                f"[tool.arlint] obs-doc names {config.obs_doc!r} but the "
+                f"file does not exist — metric-name drift cannot be checked",
+            )
+        ]
+    text = doc_path.read_text(encoding="utf-8")
+    rows = _doc_rows(text)
+    doc_regexes = [(tok, _pattern_regex(tok)) for tok, _, _, _ in rows]
+    doc_name = doc_path.name
+    try:
+        doc_rel = doc_path.resolve().relative_to(
+            (root or Path.cwd()).resolve()
+        ).as_posix()
+    except ValueError:
+        doc_rel = doc_path.as_posix()
+
+    findings: list[Finding] = []
+
+    # forward: every creation matches some doc row
+    for name, is_pattern, path, line in creations:
+        subject = _probe(name) if is_pattern else name
+        if any(rx.fullmatch(subject) for _, rx in doc_regexes):
+            continue
+        findings.append(
+            Finding(
+                path,
+                line,
+                "OBS001",
+                f"metric '{name}' is created here but no {doc_name} metric-"
+                f"table row matches it — document it (placeholder rows like "
+                f"'a.b.<kind>' cover dynamic suffixes), or rename to a "
+                f"documented family",
+            )
+        )
+
+    # reverse: every non-collector doc row has a creation site — only
+    # meaningful on a whole-tree scan (single-file absence proves nothing)
+    if len(trees) > 1:
+        exacts = {name for name, is_p, _, _ in creations if not is_p}
+        pattern_rx = [
+            _pattern_regex(name) for name, is_p, _, _ in creations if is_p
+        ]
+        for token, lineno, stripped, is_collector in rows:
+            if is_collector:
+                continue
+            rx = _pattern_regex(token)
+            probe = _probe(token)
+            if (
+                token in exacts
+                or any(rx.fullmatch(e) for e in exacts)
+                or any(prx.fullmatch(probe) for prx in pattern_rx)
+            ):
+                continue
+            findings.append(
+                Finding(
+                    doc_rel,
+                    lineno,
+                    "OBS001",
+                    f"documented metric '{token}' has no creation site in "
+                    f"the analyzed tree — remove the row, fix the name, or "
+                    f"mark the row collector-provided",
+                    line_content=stripped,
+                )
+            )
+    return findings

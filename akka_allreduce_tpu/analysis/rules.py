@@ -11,58 +11,18 @@ isolation, and a suppression comment on that line is the whole escape hatch.
 from __future__ import annotations
 
 import ast
-from typing import Iterator
 
 from akka_allreduce_tpu.analysis.config import ArlintConfig
 from akka_allreduce_tpu.analysis.core import Finding
 
-# -- shared helpers -----------------------------------------------------------
-
-
-def dotted_name(node: ast.AST) -> str | None:
-    """``a.b.c`` for a Name/Attribute chain, else None."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def terminal_name(node: ast.AST) -> str | None:
-    """The last identifier of a Name/Attribute/Subscript chain:
-    ``self._recv_pool[i]`` -> ``_recv_pool``."""
-    while isinstance(node, ast.Subscript):
-        node = node.value
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-def _functions(tree: ast.AST) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
-
-
-def _direct_body_walk(func: ast.AST) -> Iterator[ast.AST]:
-    """Walk ``func``'s body WITHOUT descending into nested function
-    definitions (code in a nested def does not run in this frame — an
-    ``except`` or blocking call there belongs to the nested function's own
-    execution context, which the rules visit separately)."""
-    stack: list[ast.AST] = list(ast.iter_child_nodes(func))
-    while stack:
-        node = stack.pop()
-        if isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        ):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
+# -- shared helpers (astutil is the canonical home; re-exported here because
+#    rule modules and tests historically import them from this module) -------
+from akka_allreduce_tpu.analysis.astutil import (
+    direct_body_walk as _direct_body_walk,
+    dotted_name,
+    functions as _functions,
+    terminal_name,
+)
 
 
 # -- ASYNC001: blocking call inside a coroutine -------------------------------
@@ -432,10 +392,23 @@ def rule_buf001(
     return findings
 
 
+# imported at the bottom on purpose: det_rules/life_rule use the shared
+# helpers above, so importing them any earlier would be circular
+from akka_allreduce_tpu.analysis.det_rules import (  # noqa: E402
+    rule_det001,
+    rule_det002,
+    rule_det003,
+)
+from akka_allreduce_tpu.analysis.life_rule import rule_life001  # noqa: E402
+
 FILE_RULES = {
     "ASYNC001": rule_async001,
     "ASYNC002": rule_async002,
     "ASYNC003": rule_async003,
     "ASYNC004": rule_async004,
     "BUF001": rule_buf001,
+    "DET001": rule_det001,
+    "DET002": rule_det002,
+    "DET003": rule_det003,
+    "LIFE001": rule_life001,
 }

@@ -1,0 +1,357 @@
+"""THRD001/THRD002 — thread-vs-event-loop shared-state races.
+
+The PR-9 review fixed, by hand, a class of bug the transport keeps inviting:
+endpoint telemetry dicts mutated from sender *threads* while the event loop
+read or mutated them concurrently, and pull-time collectors iterating those
+dicts mid-mutation (fixed with a ``list()`` snapshot). These rules make that
+review pass mechanical, on top of the ``contexts`` call-graph classifier:
+
+- **THRD001** — a ``self`` attribute or module global is mutated from both a
+  thread context and the event-loop context, and at least one mutation site
+  is not inside a ``with <lock>:`` guard. Every cross-context site must hold
+  the owning lock: one unguarded writer is enough to corrupt the rest.
+- **THRD002** — iteration over a ``self`` collection that a *different*
+  execution context mutates, without a ``list()``/``sorted()`` snapshot or a
+  lock around the iteration (``RuntimeError: dictionary changed size`` is the
+  friendly failure mode; silently skipping an entry is the real one).
+
+Both rules only speak when the call graph *proves* two contexts touch the
+same state — a function the classifier cannot reach from a thread target or
+a coroutine stays silent (sync-anywhere), so an unresolvable callee can only
+miss a finding, never invent one. ``__init__``-family constructors are
+exempt: they run before any thread exists.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from akka_allreduce_tpu.analysis.config import ArlintConfig
+from akka_allreduce_tpu.analysis.contexts import (
+    LOOP,
+    THREAD,
+    ContextMap,
+    FuncInfo,
+    _locked_body_walk,
+    build_context_map,
+)
+from akka_allreduce_tpu.analysis.core import Finding
+
+# collection-mutating method names: calling one of these on shared state IS
+# a write, even though no assignment statement appears
+_MUTATORS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "add",
+        "extend",
+        "insert",
+        "remove",
+        "discard",
+        "pop",
+        "popleft",
+        "popitem",
+        "clear",
+        "update",
+        "setdefault",
+    }
+)
+
+_SNAPSHOT_FUNCS = frozenset({"list", "tuple", "sorted", "set", "frozenset"})
+
+_CONSTRUCTORS = frozenset({"__init__", "__post_init__", "__new__", "__init_subclass__"})
+
+
+@dataclasses.dataclass(frozen=True)
+class _Site:
+    func: FuncInfo
+    line: int
+    locked: bool
+    #: "assign" (rebind), "item" (subscript store/del), "method" (mutator call)
+    kind: str
+
+
+def _self_attr_base(node: ast.AST) -> str | None:
+    """First attribute above ``self`` in a ``self.X[...]...`` chain."""
+    chain: list[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and chain:
+        return chain[-1]
+    return None
+
+
+def _flat_targets(target: ast.AST) -> Iterator[ast.AST]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _flat_targets(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _flat_targets(target.value)
+    else:
+        yield target
+
+
+def _local_names(func: ast.AST) -> set[str]:
+    """Names bound locally in ``func`` (so a bare-Name mutator call on one is
+    not misread as touching a same-named module global)."""
+    out: set[str] = set()
+    args = getattr(func, "args", None)
+    if args is not None:
+        for a in (
+            *args.posonlyargs,
+            *args.args,
+            *args.kwonlyargs,
+            *( [args.vararg] if args.vararg else [] ),
+            *( [args.kwarg] if args.kwarg else [] ),
+        ):
+            out.add(a.arg)
+    for node, _ in _locked_body_walk(func):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                for leaf in _flat_targets(t):
+                    if isinstance(leaf, ast.Name):
+                        out.add(leaf.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for leaf in _flat_targets(node.target):
+                if isinstance(leaf, ast.Name):
+                    out.add(leaf.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for leaf in _flat_targets(item.optional_vars):
+                        if isinstance(leaf, ast.Name):
+                            out.add(leaf.id)
+    return out
+
+
+def _collect_sites(
+    info: FuncInfo,
+    module_names: set[str],
+) -> tuple[
+    dict[str, list[_Site]],  # self attr -> mutation sites
+    dict[str, list[_Site]],  # module global -> mutation sites
+    dict[str, list[_Site]],  # self attr -> iteration sites
+]:
+    attr_muts: dict[str, list[_Site]] = {}
+    global_muts: dict[str, list[_Site]] = {}
+    iters: dict[str, list[_Site]] = {}
+
+    declared_globals: set[str] = set()
+    for node, _ in _locked_body_walk(info.node):
+        if isinstance(node, ast.Global):
+            declared_globals.update(node.names)
+    locals_ = _local_names(info.node)
+
+    def mut_attr(name: str, line: int, locked: bool, kind: str) -> None:
+        attr_muts.setdefault(name, []).append(_Site(info, line, locked, kind))
+
+    def mut_global(name: str, line: int, locked: bool, kind: str) -> None:
+        global_muts.setdefault(name, []).append(_Site(info, line, locked, kind))
+
+    def target_mut(t: ast.AST, line: int, locked: bool) -> None:
+        if isinstance(t, ast.Attribute):
+            base = _self_attr_base(t)
+            if base is not None:
+                mut_attr(base, line, locked, "assign")
+        elif isinstance(t, ast.Subscript):
+            base = _self_attr_base(t)
+            if base is not None:
+                mut_attr(base, line, locked, "item")
+            elif isinstance(t.value, ast.Name) and (
+                t.value.id in declared_globals
+                or (t.value.id in module_names and t.value.id not in locals_)
+            ):
+                mut_global(t.value.id, line, locked, "item")
+        elif isinstance(t, ast.Name) and t.id in declared_globals:
+            mut_global(t.id, line, locked, "assign")
+
+    def iter_site(expr: ast.AST, line: int, locked: bool) -> None:
+        if isinstance(expr, ast.Call):
+            fname = expr.func.id if isinstance(expr.func, ast.Name) else None
+            if fname in _SNAPSHOT_FUNCS:
+                return  # snapshotted — the PR-9 fix shape
+            if (
+                isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in ("items", "values", "keys")
+            ):
+                expr = expr.func.value
+            else:
+                return
+        base = _self_attr_base(expr)
+        if base is not None:
+            iters.setdefault(base, []).append(_Site(info, line, locked, "iter"))
+
+    for node, locked in _locked_body_walk(info.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for leaf in _flat_targets(t):
+                    target_mut(leaf, node.lineno, locked)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node, ast.AnnAssign) and node.value is None:
+                continue
+            target_mut(node.target, node.lineno, locked)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                target_mut(t, node.lineno, locked)
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+            ):
+                obj = node.func.value
+                base = _self_attr_base(obj)
+                if base is not None:
+                    mut_attr(base, node.lineno, locked, "method")
+                elif isinstance(obj, ast.Name) and (
+                    obj.id in declared_globals
+                    or (obj.id in module_names and obj.id not in locals_)
+                ):
+                    mut_global(obj.id, node.lineno, locked, "method")
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            iter_site(node.iter, node.lineno, locked)
+        elif isinstance(
+            node, (ast.ListComp, ast.GeneratorExp, ast.DictComp, ast.SetComp)
+        ):
+            for gen in node.generators:
+                iter_site(gen.iter, node.lineno, locked)
+    return attr_muts, global_muts, iters
+
+
+def _ctx_desc(ctx: frozenset[str]) -> str:
+    if THREAD in ctx and LOOP in ctx:
+        return "both thread and event-loop"
+    if THREAD in ctx:
+        return "thread"
+    return "event-loop"
+
+
+def _cross_context(sites: list[tuple[_Site, frozenset[str]]]) -> bool:
+    has_thread = any(THREAD in ctx for _, ctx in sites)
+    has_loop = any(LOOP in ctx for _, ctx in sites)
+    return has_thread and has_loop
+
+
+def check_thread_safety(
+    trees: dict[str, ast.AST],
+    config: ArlintConfig,
+    *,
+    root=None,
+) -> list[Finding]:
+    cmap: ContextMap = build_context_map(trees)
+    findings: list[Finding] = []
+
+    # -- group mutation/iteration sites by shared variable -------------------
+    #    self attrs are shared per (path, class); globals per (path, name)
+    attr_muts: dict[tuple[str, str, str], list[tuple[_Site, frozenset[str]]]] = {}
+    glob_muts: dict[tuple[str, str], list[tuple[_Site, frozenset[str]]]] = {}
+    attr_iters: dict[tuple[str, str, str], list[tuple[_Site, frozenset[str]]]] = {}
+
+    for path in sorted(trees):
+        idx = cmap.indexes[path]
+        for qual in sorted(idx.funcs):
+            info = idx.funcs[qual]
+            if info.node.name in _CONSTRUCTORS:
+                continue
+            ctx = cmap.contexts_of(info.key)
+            a_muts, g_muts, iters = _collect_sites(info, idx.module_names)
+            if info.cls is not None:
+                for name, sites in a_muts.items():
+                    attr_muts.setdefault((path, info.cls, name), []).extend(
+                        (s, ctx) for s in sites
+                    )
+                for name, sites in iters.items():
+                    attr_iters.setdefault((path, info.cls, name), []).extend(
+                        (s, ctx) for s in sites
+                    )
+            for name, sites in g_muts.items():
+                glob_muts.setdefault((path, name), []).extend(
+                    (s, ctx) for s in sites
+                )
+
+    # -- THRD001: unguarded cross-context mutation ----------------------------
+    def thrd001(what: str, path: str, sites) -> None:
+        colored = [(s, ctx) for s, ctx in sites if ctx]
+        if not _cross_context(colored):
+            return
+        unguarded = sorted(
+            ((s, ctx) for s, ctx in colored if not s.locked),
+            key=lambda sc: sc[0].line,
+        )
+        for s, ctx in unguarded:
+            other_color = LOOP if THREAD in ctx else THREAD
+            others = sorted(
+                (o for o, octx in colored if other_color in octx and o is not s),
+                key=lambda o: o.line,
+            )
+            if others:
+                other = others[0]
+                counterpart = (
+                    f"also mutated from {_ctx_desc(cmap.contexts_of(other.func.key))} "
+                    f"context in {other.func.qualname} (line {other.line})"
+                )
+            else:
+                counterpart = (
+                    f"{s.func.qualname} is reachable from both contexts"
+                )
+            findings.append(
+                Finding(
+                    path,
+                    s.line,
+                    "THRD001",
+                    f"{what} is mutated from both thread and event-loop "
+                    f"context, and this {_ctx_desc(ctx)}-context site holds "
+                    f"no lock ({counterpart}) — wrap every cross-context "
+                    f"mutation in 'with <lock>:' (PR-9 endpoint-telemetry "
+                    f"race class)",
+                )
+            )
+
+    for (path, cls, name), sites in sorted(attr_muts.items()):
+        thrd001(f"self.{name} (class {cls})", path, sites)
+    for (path, name), sites in sorted(glob_muts.items()):
+        thrd001(f"module global '{name}'", path, sites)
+
+    # -- THRD002: unguarded iteration over cross-context-mutated state -------
+    for (path, cls, name), isites in sorted(attr_iters.items()):
+        msites = [
+            (s, ctx)
+            for s, ctx in attr_muts.get((path, cls, name), [])
+            if ctx and s.kind in ("item", "method")
+        ]
+        if not msites:
+            continue
+        for it, ictx in sorted(isites, key=lambda sc: sc[0].line):
+            if not ictx:
+                continue
+            cross = [
+                (m, mctx)
+                for m, mctx in msites
+                if (THREAD in mctx and LOOP in ictx)
+                or (LOOP in mctx and THREAD in ictx)
+            ]
+            if not cross:
+                continue
+            if it.locked and all(m.locked for m, _ in cross):
+                continue
+            m, mctx = min(cross, key=lambda mc: mc[0].line)
+            findings.append(
+                Finding(
+                    path,
+                    it.line,
+                    "THRD002",
+                    f"iteration over self.{name} (class {cls}) in "
+                    f"{_ctx_desc(ictx)} context while {m.func.qualname} "
+                    f"(line {m.line}) mutates it from {_ctx_desc(mctx)} "
+                    f"context — snapshot with list(...) under the lock, or "
+                    f"hold the lock across the loop (PR-9 collector fix "
+                    f"class)",
+                )
+            )
+    return findings

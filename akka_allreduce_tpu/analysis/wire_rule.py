@@ -1,4 +1,4 @@
-"""WIRE001 — wire-tag exhaustiveness, checked across the analyzed tree.
+"""WIRE001/WIRE002 — wire-tag exhaustiveness and the version-skew contract.
 
 The control plane's binary codec (``control/wire.py``) is a hand-rolled
 tag-dispatch pair: ``_TAGS`` maps message type -> tag byte, ``_encode_parts``
@@ -19,6 +19,25 @@ the tie mechanical:
 The rule activates on any analyzed module that assigns a dict literal named
 ``_TAGS`` with int values and defines ``decode`` — i.e. the wire module
 itself; trees without one simply skip the rule.
+
+**WIRE002** is the *version-skew* half of the contract, pinned today only
+dynamically (``test_wire_roundtrip``'s trailing-bytes cases, ``test_chaos``'s
+tag-range pin). A rolling upgrade has old and new nodes on the wire at once,
+so the codec's compatibility rules become static checks:
+
+- no decode-family function may compare ``len(<buffer>)`` for exact equality
+  (``==``/``!=``): trailing bytes from a newer peer — the trace trailer is
+  the shipped example — must be *tolerated*, so bounds are ``<=``, never
+  ``==`` (emptiness checks against ``0`` are exempt);
+- wire dataclasses (types in ``_TAGS``, plus dataclasses the wire module
+  references, e.g. ``RoundPolicy``) must keep new fields trailing-with-
+  default: a defaultless field after a defaulted one — including the
+  ``field(kw_only=True)`` escape hatch Python requires for that shape —
+  breaks old decoders that construct with fewer fields;
+- ``_TAGS`` values stay unique and contiguous from 1 (the ``test_chaos``
+  pin, statically), and tag ranges declared module-owned via
+  ``[tool.arlint] wire-owned`` (``"control/gossip.py:24-26"``) must match
+  exactly the tags of the types that module defines, both directions.
 """
 
 from __future__ import annotations
@@ -27,7 +46,7 @@ import ast
 
 from akka_allreduce_tpu.analysis.config import ArlintConfig
 from akka_allreduce_tpu.analysis.core import Finding
-from akka_allreduce_tpu.analysis.rules import terminal_name
+from akka_allreduce_tpu.analysis.astutil import terminal_name
 
 _ENCODE_FUNCS = ("_encode_parts", "encode")
 _DECODE_FUNCS = ("decode",)
@@ -139,7 +158,7 @@ def _dispatched_type_names(trees: dict[str, ast.AST]) -> set[str]:
 
 
 def check_wire_exhaustiveness(
-    trees: dict[str, ast.AST], config: ArlintConfig
+    trees: dict[str, ast.AST], config: ArlintConfig, *, root=None
 ) -> list[Finding]:
     wire_path: str | None = None
     tags_node: ast.Dict | None = None
@@ -220,4 +239,252 @@ def check_wire_exhaustiveness(
                     f"handles it — receivers will raise TypeError",
                 )
             )
+    return findings
+
+
+# -- WIRE002: version-skew contract -------------------------------------------
+
+
+def _buffer_param(func: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+    for a in (*func.args.posonlyargs, *func.args.args):
+        if a.arg not in ("self", "cls"):
+            return a.arg
+    return None
+
+
+def _exact_length_findings(tree: ast.AST, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "decode" not in func.name:
+            continue
+        buf = _buffer_param(func)
+        if buf is None:
+            continue
+        for node in ast.walk(func):
+            if not (
+                isinstance(node, ast.Compare)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Eq, ast.NotEq))
+            ):
+                continue
+            sides = [node.left, node.comparators[0]]
+            is_len_of_buf = [
+                isinstance(s, ast.Call)
+                and isinstance(s.func, ast.Name)
+                and s.func.id == "len"
+                and len(s.args) == 1
+                and isinstance(s.args[0], ast.Name)
+                and s.args[0].id == buf
+                for s in sides
+            ]
+            if not any(is_len_of_buf):
+                continue
+            other = sides[0 if is_len_of_buf[1] else 1]
+            if isinstance(other, ast.Constant) and other.value == 0:
+                continue  # emptiness check, not a consumed-length assertion
+            findings.append(
+                Finding(
+                    path,
+                    node.lineno,
+                    "WIRE002",
+                    f"exact-length comparison against len({buf}) in decode "
+                    f"function '{func.name}' — a newer peer's trailing bytes "
+                    f"(trace-trailer class) must be tolerated: bound with "
+                    f"'<=', never '=='",
+                    end_line=node.end_lineno or node.lineno,
+                )
+            )
+    return findings
+
+
+def _dataclass_decorator(cls: ast.ClassDef) -> tuple[bool, bool]:
+    """(is_dataclass, decorator_kw_only)."""
+    for dec in cls.decorator_list:
+        call = dec if not isinstance(dec, ast.Call) else dec.func
+        if terminal_name(call) == "dataclass":
+            kw_only = isinstance(dec, ast.Call) and any(
+                kw.arg == "kw_only"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in dec.keywords
+            )
+            return True, kw_only
+    return False, False
+
+
+def _field_shapes(cls: ast.ClassDef) -> list[tuple[str, int, bool, bool]]:
+    """(name, line, has_default, kw_only_escape) per dataclass field, in
+    declaration order. ClassVar annotations are not fields."""
+    out: list[tuple[str, int, bool, bool]] = []
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+            stmt.target, ast.Name
+        ):
+            continue
+        ann = stmt.annotation
+        ann_base = ann.value if isinstance(ann, ast.Subscript) else ann
+        if terminal_name(ann_base) == "ClassVar":
+            continue
+        has_default = stmt.value is not None
+        kw_escape = False
+        if (
+            isinstance(stmt.value, ast.Call)
+            and terminal_name(stmt.value.func) == "field"
+        ):
+            kwargs = {kw.arg for kw in stmt.value.keywords}
+            has_default = bool(kwargs & {"default", "default_factory"})
+            kw_escape = any(
+                kw.arg == "kw_only"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in stmt.value.keywords
+            )
+        out.append((stmt.target.id, stmt.lineno, has_default, kw_escape))
+    return out
+
+
+def _trailing_default_findings(
+    trees: dict[str, ast.AST], wire_tree: ast.AST, tags: dict[str, int]
+) -> list[Finding]:
+    """Trailing-with-default contract over wire dataclasses: the ``_TAGS``
+    types plus any dataclass the wire module references by name
+    (``RoundPolicy`` rides inside frames without its own tag)."""
+    referenced = {
+        node.id for node in ast.walk(wire_tree) if isinstance(node, ast.Name)
+    }
+    wanted = set(tags) | referenced
+    findings: list[Finding] = []
+    for path in sorted(trees):
+        for cls in ast.walk(trees[path]):
+            if not isinstance(cls, ast.ClassDef) or cls.name not in wanted:
+                continue
+            is_dc, dec_kw_only = _dataclass_decorator(cls)
+            if not is_dc:
+                continue
+            fields = _field_shapes(cls)
+            seen_default = False
+            for name, line, has_default, kw_escape in fields:
+                if has_default:
+                    seen_default = True
+                    continue
+                # defaultless-after-defaulted, or the field(kw_only=True)
+                # escape hatch anywhere (it exists only to permit that shape)
+                if seen_default or kw_escape:
+                    findings.append(
+                        Finding(
+                            path,
+                            line,
+                            "WIRE002",
+                            f"wire dataclass {cls.name}: field '{name}' has "
+                            f"no default but follows defaulted fields"
+                            + (" (via the kw_only escape)" if kw_escape else "")
+                            + " — an old decoder constructing with fewer "
+                            "fields breaks; new fields must be trailing-"
+                            "with-default (RoundPolicy skew contract)",
+                        )
+                    )
+            if dec_kw_only and any(not d for _, _, d, _ in fields):
+                findings.append(
+                    Finding(
+                        path,
+                        cls.lineno,
+                        "WIRE002",
+                        f"wire dataclass {cls.name} uses @dataclass("
+                        f"kw_only=True) with defaultless fields — this "
+                        f"defeats the trailing-with-default skew contract; "
+                        f"give every post-v1 field a default",
+                    )
+                )
+    return findings
+
+
+def _owned_range_findings(
+    trees: dict[str, ast.AST],
+    tags: dict[str, int],
+    tags_node: ast.Dict,
+    wire_path: str,
+    config: ArlintConfig,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for suffix, lo, hi in config.wire_owned:
+        owner_paths = [p for p in sorted(trees) if p.endswith(suffix)]
+        if not owner_paths:
+            continue  # owner module not in this scan (single-file run)
+        owned_types = set()
+        for p in owner_paths:
+            for cls in ast.walk(trees[p]):
+                if isinstance(cls, ast.ClassDef) and cls.name in tags:
+                    owned_types.add(cls.name)
+        actual = sorted(tags[t] for t in owned_types)
+        expected = list(range(lo, hi + 1))
+        if actual == expected:
+            continue
+        stray = [t for t in actual if t not in expected]
+        missing = [t for t in expected if t not in actual]
+        detail = []
+        if stray:
+            detail.append(
+                f"types defined in {suffix} hold out-of-range tag(s) "
+                f"{stray}"
+            )
+        if missing:
+            holders = sorted(
+                name for name, tag in tags.items() if tag in missing
+            )
+            detail.append(
+                f"tag(s) {missing} in the owned range belong to types "
+                f"defined elsewhere ({', '.join(holders) or 'none'})"
+            )
+        findings.append(
+            Finding(
+                wire_path,
+                tags_node.lineno,
+                "WIRE002",
+                f"wire-owned range {suffix}:{lo}-{hi} violated — "
+                f"{'; '.join(detail)} (module-owned tag ranges are the "
+                f"rolling-upgrade coordination contract)",
+            )
+        )
+    return findings
+
+
+def check_wire_skew(
+    trees: dict[str, ast.AST], config: ArlintConfig, *, root=None
+) -> list[Finding]:
+    wire_path: str | None = None
+    tags_node: ast.Dict | None = None
+    tags: dict[str, int] | None = None
+    for path, tree in trees.items():
+        found = _find_tags(tree)
+        if found is not None:
+            wire_path, (tags_node, tags) = path, found
+            break
+    if wire_path is None or tags_node is None:
+        return []  # no wire module in this tree: rule does not apply
+    wire_tree = trees[wire_path]
+    findings = _exact_length_findings(wire_tree, wire_path)
+    if tags is None:
+        # WIRE001 already reports the unreadable-_TAGS case; the skew checks
+        # that need the mapping simply cannot run
+        return findings
+    values = sorted(tags.values())
+    if values != list(range(1, len(values) + 1)):
+        dupes = sorted({v for v in values if values.count(v) > 1})
+        findings.append(
+            Finding(
+                wire_path,
+                tags_node.lineno,
+                "WIRE002",
+                f"_TAGS values must be unique and contiguous from 1 (the "
+                f"test_chaos pin, statically): got {values}"
+                + (f" with duplicate(s) {dupes}" if dupes else "")
+                + " — retiring a tag means reserving it, not renumbering",
+            )
+        )
+    findings.extend(_trailing_default_findings(trees, wire_tree, tags))
+    findings.extend(
+        _owned_range_findings(trees, tags, tags_node, wire_path, config)
+    )
     return findings

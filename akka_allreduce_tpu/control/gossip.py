@@ -267,7 +267,7 @@ class GossipState:
         Existing records keep their state (a roster refresh must not
         amnesty a suspect)."""
         ids = {int(n) for n in node_ids if int(n) != self.node_id}
-        fresh_ids = ids - set(self.members)
+        fresh_ids = sorted(ids - set(self.members))
         for nid in fresh_ids:
             self.members[nid] = _Member()
         if fresh_ids:
@@ -283,7 +283,7 @@ class GossipState:
             limit = self._spread_limit()
             for nid in fresh_ids:
                 self.members[nid].spread = limit
-        gone = set(self.members) - ids
+        gone = sorted(set(self.members) - ids)
         for nid in gone:
             self.members.pop(nid, None)
             self._suspects.discard(nid)
@@ -710,7 +710,7 @@ class GossipState:
             limit = self._spread_limit()
             fresh: list[tuple[int, int]] = []
             stale: list[int] = []
-            for nid in self._fresh:
+            for nid in sorted(self._fresh):
                 rec = self.members.get(nid)
                 if rec is None or rec.spread >= limit:
                     stale.append(nid)

@@ -812,7 +812,11 @@ class _FrameReceiver(asyncio.BufferedProtocol):
             # behavior-identical.
             if self._decode_q is None:
                 self._decode_q = asyncio.Queue()
-                self._decode_task = observed_task(
+                # lifecycle is owner-transferred, not protocol-owned: the
+                # task joins owner._decoder_tasks (cancelled in the
+                # transport's stop()) and connection_lost() ends the pump
+                # by queueing the None sentinel
+                self._decode_task = observed_task(  # arlint: disable=LIFE001 -- owner-transferred
                     owner._decode_pump(self._decode_q, self),
                     name=f"decode-{self._peer_key}-s{self._stream_id}",
                 )
@@ -1716,9 +1720,12 @@ class RemoteTransport:
         sender.queued_bytes = 0
         sender.close_sock()
         # the burst is over: a LATER send to this endpoint starts a fresh
-        # retry budget (the peer may have come back)
+        # retry budget (the peer may have come back); locked like
+        # _note_retry's read-modify-write — sender threads and the stats
+        # collector touch the same dict
         sender.attempts = 0
-        self.endpoint_backoff[ep] = 0.0
+        with self._stats_lock:
+            self.endpoint_backoff[ep] = 0.0
         sender.wake_waiters()
         for frame in frames:
             for env in frame.envs:
@@ -1821,7 +1828,8 @@ class RemoteTransport:
                     _flight.set_state("transport.last_stage", "socket_write")
                 if sender.attempts:
                     sender.attempts = 0  # a sent batch ends the burst
-                    self.endpoint_backoff[ep] = 0.0
+                    with self._stats_lock:
+                        self.endpoint_backoff[ep] = 0.0
                 key = f"{ep.host}:{ep.port}"
                 # locked like the thread-side update: payload sender
                 # threads increment the same key for this endpoint
@@ -1927,7 +1935,8 @@ class RemoteTransport:
                     return
                 if sender.attempts:
                     sender.attempts = 0  # a sent batch ends the burst
-                    self.endpoint_backoff[ep] = 0.0
+                    with self._stats_lock:
+                        self.endpoint_backoff[ep] = 0.0
                 key = f"{ep.host}:{ep.port}"
                 with self._stats_lock:
                     self.endpoint_tx[key] = (
@@ -1999,7 +2008,10 @@ class RemoteTransport:
             sender.closed = True
         sender.close_sock()
         sender.attempts = 0
-        self.endpoint_backoff[ep] = 0.0
+        # sender-thread side of the same dict _note_retry and the loop's
+        # _fail_sender write: every cross-context mutation holds the lock
+        with self._stats_lock:
+            self.endpoint_backoff[ep] = 0.0
         self._note_stripe_dropped(
             ep, sender, sum(f.nbytes for f in frames)
         )
@@ -2308,7 +2320,12 @@ class RemoteTransport:
                 try:
                     t0 = time.perf_counter()
                     out = handler(msg)
-                    self.stage_seconds["handler"] += time.perf_counter() - t0
+                    # pump-pool threads charge stage_seconds["decode"] under
+                    # this lock; the loop's handler timer must match
+                    with self._stats_lock:
+                        self.stage_seconds["handler"] += (
+                            time.perf_counter() - t0
+                        )
                     _flight.set_state("transport.last_stage", "handler")
                 except asyncio.CancelledError:
                     # defense-in-depth for the arlint ASYNC004 shape: today

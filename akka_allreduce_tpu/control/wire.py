@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import struct
+import threading
 from typing import Any
 
 import numpy as np
@@ -176,6 +177,13 @@ _f16_clipped = 0
 _f16_clip_warned = False
 _F16_CLIPPED = _obs_metrics.counter("wire.f16_clipped")
 
+#: encode runs on the event loop (control frames) AND on payload sender
+#: threads (deferred stream encode), so the module-global accounting above
+#: is cross-context shared state: every read-modify-write holds this lock
+#: (the arlint THRD001 contract; the obs-registry counters beside them are
+#: GIL-atomic ``.inc()`` and need none)
+_telemetry_lock = threading.Lock()
+
 #: int8 wire-mode error accounting, mirroring the f16 counter pair: the
 #: accumulated L1 magnitude of quantization residuals this process put on
 #: the wire (``wire.int8_residual_l1`` — what the send-side EF carries
@@ -200,10 +208,12 @@ def int8_residual_l1() -> float:
 
 def _note_clipped(n: int) -> None:
     global _f16_clipped, _f16_clip_warned
-    _f16_clipped += n
-    _F16_CLIPPED.inc(n)
-    if not _f16_clip_warned:
+    with _telemetry_lock:
+        _f16_clipped += n
+        first = not _f16_clip_warned
         _f16_clip_warned = True
+    _F16_CLIPPED.inc(n)
+    if first:
         _log.warning(
             "f16 wire mode saturated %d out-of-range payload element(s) at "
             "+-65504; values were altered on the wire (further saturation "
@@ -275,7 +285,8 @@ def _pack_floats(value: np.ndarray, mode: str = "f32") -> tuple[memoryview, int]
         resid = float(
             np.abs(arr32 - q.astype(np.float32) * np.float32(scale)).sum()
         )
-        _int8_residual_l1 += resid
+        with _telemetry_lock:
+            _int8_residual_l1 += resid
         _INT8_RESIDUAL.inc(resid)
         _INT8_PAYLOADS.inc()
         payload = struct.pack("<f", scale) + q.tobytes()

@@ -71,7 +71,10 @@ def record_span(rec: dict) -> None:
 
 
 def set_state(key: str, value: Any) -> None:
-    _state[key] = value
+    # Deliberately lock-free: one dict store per update, last-writer-wins.
+    # dump() must stay callable from signal handlers and excepthooks, and a
+    # lock here could deadlock a handler that fires mid-update.
+    _state[key] = value  # arlint: disable=THRD001 -- single-opcode store
 
 
 def get_state(key: str, default: Any = None) -> Any:

@@ -191,7 +191,11 @@ class ElasticClusterNode:
         if batch is None:
             return "end"
         x, y = batch
-        incoming, self._incoming = self._incoming, None
+        # hand-off cell contract (see __init__): one atomic reference swap,
+        # deliberately lock-free so the binder's deposit never blocks a round
+        incoming, self._incoming = (  # arlint: disable=THRD001 -- cell swap
+            self._incoming, None,
+        )
         if incoming is not None:
             self.trainer.set_flat_params(incoming)
         m = self.trainer.train_step(x, y)
@@ -264,7 +268,10 @@ class ElasticClusterNode:
                 # remaining members re-line without detector latency
                 await self.node.leave()
             # fold the final round's average in before reporting weights
-            incoming, self._incoming = self._incoming, None
+            # (same lock-free hand-off cell swap as _train_one)
+            incoming, self._incoming = (  # arlint: disable=THRD001 -- cell swap
+                self._incoming, None,
+            )
             if incoming is not None:
                 self.trainer.set_flat_params(incoming)
         finally:
